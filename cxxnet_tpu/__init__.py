@@ -1,0 +1,13 @@
+"""cxxnet-tpu: a TPU-native, config-driven convolutional network trainer.
+
+A brand-new JAX/XLA/pjit framework with the capabilities of the cxxnet
+reference (``/root/reference``): ``.conf``-file driven layer graphs, a full
+CNN layer zoo, SGD/NAG/Adam updaters with learning-rate schedules, a
+composable threaded input pipeline, round-based checkpointing, multi-metric
+evaluation, and data parallelism over a TPU device mesh in place of the
+reference's multi-GPU parameter server.
+"""
+
+__version__ = "0.1.0"
+
+from . import config  # noqa: F401

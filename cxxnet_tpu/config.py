@@ -1,0 +1,238 @@
+"""cxxnet-style ``.conf`` grammar: tokenizer, pair stream, section splitting.
+
+The whole framework is driven by a single *ordered* stream of ``name = value``
+pairs read from a config file plus CLI overrides.  Order is semantic:
+
+* ``data = <tag>`` / ``eval = <tag>`` / ``pred = <file>`` open an iterator
+  section that runs until ``iter = end``; everything inside belongs to that
+  iterator chain.
+* ``netconfig = start`` .. ``netconfig = end`` delimits the layer graph;
+  inside it, keys following a ``layer[...] = ...`` line bind to that layer.
+* everything else is a global default applied to every layer / updater /
+  iterator.
+
+Grammar parity with the reference implementation
+(``/root/reference/src/utils/config.h:20-141``):
+
+* tokens are separated by spaces / tabs / newlines
+* ``#`` starts a comment running to end of line
+* ``"..."`` is a single-line string token (backslash escapes, no newlines)
+* ``'...'`` is a multi-line string token (backslash escapes)
+* ``=`` is always its own token
+* a setting is the token triplet ``name = value`` on one logical line
+
+Section-splitting parity: ``/root/reference/src/cxxnet_main.cpp:214-264``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Sequence, Tuple
+
+ConfigEntry = Tuple[str, str]
+
+
+class ConfigError(ValueError):
+    """Malformed configuration text."""
+
+
+_EQ = object()       # sentinel token: bare '='
+_NEWLINE = object()  # sentinel token: logical line break
+
+
+def _tokenize(text: str) -> Iterator[object]:
+    """Yield string tokens, ``_EQ`` for '=', and ``_NEWLINE`` markers.
+
+    Newline markers are emitted between lines (collapsed) so the pair
+    assembler can enforce that ``name = value`` does not span lines, the
+    same restriction the reference tokenizer enforces via its ``new_line``
+    flag (``config.h:97-140``).
+    """
+    i, n = 0, len(text)
+    buf: List[str] = []
+    pending_newline = False
+    out: List[object] = []  # emit queue drained by the outer loop
+
+    def emit(tok: object) -> None:
+        nonlocal pending_newline
+        if pending_newline:
+            out.append(_NEWLINE)
+            pending_newline = False
+        out.append(tok)
+
+    def flush() -> None:
+        nonlocal buf
+        if buf:
+            emit("".join(buf))
+            buf = []
+
+    while i < n:
+        ch = text[i]
+        if ch == "#":
+            # comment to end of line
+            while i < n and text[i] not in "\r\n":
+                i += 1
+            continue
+        if ch in "\r\n":
+            flush()
+            pending_newline = True
+            i += 1
+        elif ch in " \t":
+            flush()
+            i += 1
+        elif ch == "=":
+            flush()
+            emit(_EQ)
+            i += 1
+        elif ch in "\"'":
+            if buf:
+                raise ConfigError("string literal may not directly follow a token")
+            quote = ch
+            i += 1
+            s: List[str] = []
+            while True:
+                if i >= n:
+                    raise ConfigError("unterminated string literal")
+                c = text[i]
+                if c == "\\":
+                    if i + 1 >= n:
+                        raise ConfigError("unterminated string escape")
+                    s.append(text[i + 1])
+                    i += 2
+                    continue
+                if c == quote:
+                    i += 1
+                    break
+                if quote == '"' and c in "\r\n":
+                    raise ConfigError("unterminated single-line string")
+                s.append(c)
+                i += 1
+            emit("".join(s))
+        else:
+            buf.append(ch)
+            i += 1
+        yield from out
+        out.clear()
+    flush()
+    yield from out
+
+
+def parse_pairs(text: str) -> List[ConfigEntry]:
+    """Parse config text into an ordered list of ``(name, value)`` pairs."""
+    out: List[ConfigEntry] = []
+    toks = _tokenize(text)
+    # stream assembler: NAME '=' VALUE with no newline between them
+    name = None          # current pending name token
+    have_eq = False
+    for tok in toks:
+        if tok is _NEWLINE:
+            if name is not None and not have_eq:
+                raise ConfigError(f"dangling token {name!r}: expected '=' on same line")
+            if have_eq:
+                raise ConfigError(f"missing value for {name!r}")
+            continue
+        if tok is _EQ:
+            if name is None:
+                raise ConfigError("'=' without a preceding name")
+            if have_eq:
+                raise ConfigError(f"duplicate '=' after {name!r}")
+            have_eq = True
+            continue
+        # plain token
+        if name is None:
+            name = tok
+        elif have_eq:
+            out.append((name, tok))
+            name, have_eq = None, False
+        else:
+            raise ConfigError(f"expected '=' after {name!r}, got {tok!r}")
+    if name is not None:
+        raise ConfigError(f"dangling token {name!r} at end of config")
+    return out
+
+
+def parse_file(path: str) -> List[ConfigEntry]:
+    with open(path, "r", encoding="utf-8") as f:
+        return parse_pairs(f.read())
+
+
+def parse_cli_overrides(args: Sequence[str]) -> List[ConfigEntry]:
+    """``name=value`` command-line overrides, appended after the file entries.
+
+    Parity: ``/root/reference/src/cxxnet_main.cpp:67-72``.
+    """
+    out: List[ConfigEntry] = []
+    for a in args:
+        if "=" in a:
+            name, val = a.split("=", 1)
+            if name and val:
+                out.append((name.strip(), val.strip()))
+    return out
+
+
+@dataclasses.dataclass
+class IteratorSection:
+    """One ``data``/``eval``/``pred`` iterator section from the config."""
+
+    kind: str                  # 'data' | 'eval' | 'pred'
+    tag: str                   # eval name, or pred output filename
+    entries: List[ConfigEntry]
+
+
+@dataclasses.dataclass
+class SplitConfig:
+    """Config split into iterator sections and the remaining global stream."""
+
+    global_entries: List[ConfigEntry]
+    sections: List[IteratorSection]
+
+    def find(self, kind: str) -> List[IteratorSection]:
+        return [s for s in self.sections if s.kind == kind]
+
+
+def split_sections(cfg: Sequence[ConfigEntry]) -> SplitConfig:
+    """Split the ordered stream into iterator sections and global entries.
+
+    Matches the flag machine of the reference driver
+    (``cxxnet_main.cpp:214-254``): ``data``/``eval``/``pred`` set the mode,
+    ``iter = end`` closes the open section, everything outside sections is a
+    global entry (including the whole netconfig block).
+    """
+    global_entries: List[ConfigEntry] = []
+    sections: List[IteratorSection] = []
+    mode = 0  # 0 global, else open section
+    tag = ""
+    cur: List[ConfigEntry] = []
+    kind_of = {1: "data", 2: "eval", 3: "pred"}
+    for name, val in cfg:
+        if name in ("data", "eval", "pred"):
+            if mode != 0:
+                raise ConfigError(
+                    f"'{name} = {val}' opens a new iterator section while the "
+                    f"previous '{kind_of[mode]}' section is missing 'iter = end'"
+                )
+            mode = {"data": 1, "eval": 2, "pred": 3}[name]
+            tag, cur = val, []
+            continue
+        if name == "iter" and val == "end":
+            if mode == 0:
+                raise ConfigError("'iter = end' outside an iterator section")
+            sections.append(IteratorSection(kind_of[mode], tag, cur))
+            mode, tag, cur = 0, "", []
+            continue
+        if mode == 0:
+            global_entries.append((name, val))
+        else:
+            cur.append((name, val))
+    if mode != 0:
+        raise ConfigError("iterator section not closed by 'iter = end'")
+    return SplitConfig(global_entries, sections)
+
+
+def cfg_get(cfg: Sequence[ConfigEntry], name: str, default: str | None = None) -> str | None:
+    """Last-wins lookup of a key in an ordered entry stream."""
+    out = default
+    for n, v in cfg:
+        if n == name:
+            out = v
+    return out

@@ -1,0 +1,156 @@
+"""Config grammar tests: tokenizer, pair stream, section splitting.
+
+Fixtures mirror the reference example configs (MNIST.conf, ImageNet.conf,
+bowl.conf) to prove the grammar handles every construct they use.
+"""
+
+import pytest
+
+from cxxnet_tpu import config as C
+
+
+def test_basic_pairs():
+    assert C.parse_pairs("a = 1\nb=2\n c =3") == [("a", "1"), ("b", "2"), ("c", "3")]
+
+
+def test_comments_and_blanks():
+    text = """
+# leading comment
+a = 1  # trailing comment
+# another
+
+b = 2
+"""
+    assert C.parse_pairs(text) == [("a", "1"), ("b", "2")]
+
+
+def test_quoted_strings():
+    text = 'path_img = "./data/train images.idx"\nx = "a=b#c"'
+    assert C.parse_pairs(text) == [
+        ("path_img", "./data/train images.idx"),
+        ("x", "a=b#c"),
+    ]
+
+
+def test_multiline_string():
+    text = "doc = 'line1\nline2'\nb = 2"
+    assert C.parse_pairs(text) == [("doc", "line1\nline2"), ("b", "2")]
+
+
+def test_escape_in_string():
+    assert C.parse_pairs(r'x = "a\"b"') == [("x", 'a"b')]
+
+
+def test_equals_own_token_no_spaces():
+    assert C.parse_pairs("layer[0->1]=conv:cv1") == [("layer[0->1]", "conv:cv1")]
+
+
+def test_name_value_must_share_line():
+    with pytest.raises(C.ConfigError):
+        C.parse_pairs("a\n= 1")
+    with pytest.raises(C.ConfigError):
+        C.parse_pairs("a =\n1")
+
+
+def test_dangling_token_raises():
+    with pytest.raises(C.ConfigError):
+        C.parse_pairs("a = 1\nstray")
+
+
+def test_mnist_conf_like():
+    text = """
+data = train
+iter = mnist
+    path_img = "./data/train-images-idx3-ubyte"
+    shuffle = 1
+iter = end
+eval = test
+iter = mnist
+    path_img = "./data/t10k-images-idx3-ubyte"
+iter = end
+
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 100
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,784
+batch_size = 100
+eta = 0.1
+metric[label] = error
+"""
+    cfg = C.parse_pairs(text)
+    split = C.split_sections(cfg)
+    assert [s.kind for s in split.sections] == ["data", "eval"]
+    assert split.sections[0].tag == "train"
+    assert split.sections[0].entries[0] == ("iter", "mnist")
+    assert ("shuffle", "1") in split.sections[0].entries
+    assert split.sections[1].tag == "test"
+    names = [n for n, _ in split.global_entries]
+    assert "netconfig" in names and "batch_size" in names
+    assert C.cfg_get(split.global_entries, "input_shape") == "1,1,784"
+    assert ("metric[label]", "error") in split.global_entries
+
+
+def test_pred_section():
+    cfg = C.parse_pairs("pred = out.txt\niter = csv\niter = end\n")
+    split = C.split_sections(cfg)
+    assert split.sections[0].kind == "pred"
+    assert split.sections[0].tag == "out.txt"
+
+
+def test_unclosed_section_raises():
+    with pytest.raises(C.ConfigError):
+        C.split_sections(C.parse_pairs("data = train\niter = mnist"))
+
+
+def test_threadbuffer_chain_kept_in_order():
+    cfg = C.parse_pairs("data = train\niter = imgbin\nrand_crop=1\niter = threadbuffer\niter = end\n")
+    split = C.split_sections(cfg)
+    ent = split.sections[0].entries
+    assert ent == [("iter", "imgbin"), ("rand_crop", "1"), ("iter", "threadbuffer")]
+
+
+def test_cli_overrides():
+    assert C.parse_cli_overrides(["num_round=3", "notakv", "dev=tpu:0-3"]) == [
+        ("num_round", "3"),
+        ("dev", "tpu:0-3"),
+    ]
+
+
+def test_cfg_get_last_wins():
+    cfg = [("dev", "cpu"), ("dev", "gpu:1")]
+    assert C.cfg_get(cfg, "dev") == "gpu:1"
+    assert C.cfg_get(cfg, "missing", "d") == "d"
+
+
+def test_reopened_section_raises():
+    with pytest.raises(C.ConfigError):
+        C.split_sections(
+            C.parse_pairs("data = train\niter = mnist\neval = test\niter = end\n")
+        )
+
+
+def test_reference_example_confs_parse():
+    """The shipped reference configs must tokenize and split cleanly."""
+    import os
+
+    if not os.path.isdir("/root/reference/example"):
+        pytest.skip("reference checkout not available")
+    parsed = 0
+    for rel in (
+        "example/MNIST/MNIST.conf",
+        "example/MNIST/MNIST_CONV.conf",
+        "example/ImageNet/ImageNet.conf",
+        "example/kaggle_bowl/bowl.conf",
+    ):
+        path = os.path.join("/root/reference", rel)
+        if not os.path.exists(path):
+            continue
+        cfg = C.parse_file(path)
+        split = C.split_sections(cfg)
+        assert len(split.sections) >= 1
+        assert any(n == "netconfig" and v == "start" for n, v in split.global_entries)
+        parsed += 1
+    assert parsed >= 1, "no reference configs were actually parsed"
