@@ -1,0 +1,17 @@
+"""The layer zoo: every layer type of the reference, as pure JAX functions.
+
+Importing this package populates the registry; use ``create_layer(name)``.
+"""
+
+from .base import (  # noqa: F401
+    Layer,
+    LayerParam,
+    LossLayer,
+    Params,
+    Shape,
+    create_layer,
+    layer_types,
+    register,
+)
+from . import conv, elemwise, linear, loss, structure  # noqa: F401
+from .pairtest import PairTestLayer  # noqa: F401

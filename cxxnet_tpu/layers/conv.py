@@ -1,0 +1,300 @@
+"""Spatial layers: conv, pooling family, LRN, batch-norm.
+
+All operate on NHWC arrays and lower to XLA's native TPU ops
+(``lax.conv_general_dilated`` → MXU, ``lax.reduce_window`` → vector unit)
+instead of the reference's im2col-GEMM / mshadow ``pool`` expressions.
+
+Parity sources:
+* conv — ``/root/reference/src/layer/convolution_layer-inl.hpp``
+  (grouped im2col GEMM; output shape ``(in + 2p - k) // s + 1``; weights
+  init with fan_in = Cin/g*kh*kw, fan_out = Cout/g)
+* pooling — ``/root/reference/src/layer/pooling_layer-inl.hpp`` (max /
+  sum / avg / relu+max; **ceil** output shape
+  ``min(in - k + s - 1, in - 1) // s + 1`` with partial edge windows;
+  avg always divides by k*k regardless of window truncation)
+* insanity_max_pooling — ``/root/reference/src/layer/
+  insanity_pooling_layer-inl.hpp`` (train: each source pixel is replaced,
+  with prob (1-keep)/4 each, by its up/down/left/right neighbour before a
+  normal ceil max-pool; eval: plain max-pool)
+* lrn — ``/root/reference/src/layer/lrn_layer-inl.hpp`` (cross-channel:
+  ``out = x * (knorm + alpha/n * sum_win(x^2))^-beta``)
+* batch_norm — ``/root/reference/src/layer/batch_norm_layer-inl.hpp``
+  (per-channel batch stats; **eval also uses current-minibatch stats** —
+  a documented reference quirk, doc/layer.md:235-240 — kept for parity)
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import Layer, Params, Shape, register
+
+
+def _ceil_pool_shape(in_size: int, k: int, s: int) -> int:
+    """Reference pooling output size (pooling_layer-inl.hpp:100-104)."""
+    return min(in_size - k + s - 1, in_size - 1) // s + 1
+
+
+def _pool_pad(in_size: int, k: int, s: int) -> int:
+    """Right/bottom padding so VALID windows realize the ceil shape."""
+    out = _ceil_pool_shape(in_size, k, s)
+    return max(0, (out - 1) * s + k - in_size)
+
+
+@register
+class ConvolutionLayer(Layer):
+    type_name = "conv"
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        self._check_arity(in_shapes, 1)
+        (shape,) = in_shapes
+        if len(shape) != 4:
+            raise ValueError("ConvolutionLayer: input must be an NHWC image node")
+        p = self.param
+        n, h, w, c = shape
+        if c % p.num_group != 0:
+            raise ValueError("input channels must divide group size")
+        if p.num_channel <= 0 or p.num_channel % p.num_group != 0:
+            raise ValueError("must set nchannel correctly (divisible by ngroup)")
+        if p.kernel_height <= 0 or p.kernel_width <= 0:
+            raise ValueError("must set kernel_size correctly")
+        if p.kernel_width > w or p.kernel_height > h:
+            raise ValueError("kernel size exceeds input")
+        if p.num_input_channel == 0:
+            p.num_input_channel = c
+        elif p.num_input_channel != c:
+            raise ValueError("ConvolutionLayer: inconsistent input channels")
+        oh = (h + 2 * p.pad_y - p.kernel_height) // p.stride + 1
+        ow = (w + 2 * p.pad_x - p.kernel_width) // p.stride + 1
+        return [(n, oh, ow, p.num_channel)]
+
+    def init_params(self, key, in_shapes) -> Params:
+        p = self.param
+        cin_g = in_shapes[0][3] // p.num_group
+        # HWIO layout, O grouped in ngroup blocks (XLA feature_group_count)
+        shape = (p.kernel_height, p.kernel_width, cin_g, p.num_channel)
+        in_num = cin_g * p.kernel_height * p.kernel_width
+        out_num = p.num_channel // p.num_group
+        out: Params = {"wmat": p.rand_init_weight(key, shape, in_num, out_num)}
+        if p.no_bias == 0:
+            out["bias"] = jnp.full((p.num_channel,), p.init_bias, jnp.float32)
+        return out
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        p = self.param
+        x = inputs[0]
+        y = lax.conv_general_dilated(
+            x,
+            params["wmat"].astype(x.dtype),
+            window_strides=(p.stride, p.stride),
+            padding=((p.pad_y, p.pad_y), (p.pad_x, p.pad_x)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=p.num_group,
+        )
+        if "bias" in params:
+            y = y + params["bias"].astype(x.dtype)
+        return [y]
+
+
+class _PoolBase(Layer):
+    """Shared ceil-shape pooling over NHWC via ``lax.reduce_window``."""
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        self._check_arity(in_shapes, 1)
+        (shape,) = in_shapes
+        if len(shape) != 4:
+            raise ValueError(f"{self.type_name}: input must be an NHWC image node")
+        p = self.param
+        if p.kernel_height <= 0 or p.kernel_width <= 0:
+            raise ValueError("must set kernel_size correctly")
+        n, h, w, c = shape
+        if p.kernel_width > w or p.kernel_height > h:
+            raise ValueError("kernel size exceeds input")
+        return [
+            (
+                n,
+                _ceil_pool_shape(h, p.kernel_height, p.stride),
+                _ceil_pool_shape(w, p.kernel_width, p.stride),
+                c,
+            )
+        ]
+
+    def _pool(self, x: jnp.ndarray, reducer, init_val) -> jnp.ndarray:
+        p = self.param
+        h, w = x.shape[1], x.shape[2]
+        pad_h = _pool_pad(h, p.kernel_height, p.stride)
+        pad_w = _pool_pad(w, p.kernel_width, p.stride)
+        return lax.reduce_window(
+            x,
+            jnp.asarray(init_val, x.dtype),
+            reducer,
+            window_dimensions=(1, p.kernel_height, p.kernel_width, 1),
+            window_strides=(1, p.stride, p.stride, 1),
+            padding=((0, 0), (0, pad_h), (0, pad_w), (0, 0)),
+        )
+
+
+@register
+class MaxPoolingLayer(_PoolBase):
+    type_name = "max_pooling"
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        return [self._pool(inputs[0], lax.max, -jnp.inf)]
+
+
+@register
+class SumPoolingLayer(_PoolBase):
+    type_name = "sum_pooling"
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        return [self._pool(inputs[0], lax.add, 0.0)]
+
+
+@register
+class AvgPoolingLayer(_PoolBase):
+    type_name = "avg_pooling"
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        p = self.param
+        # parity: divide by full k*k even for truncated edge windows
+        scale = 1.0 / (p.kernel_height * p.kernel_width)
+        return [self._pool(inputs[0], lax.add, 0.0) * scale]
+
+
+@register
+class ReluMaxPoolingLayer(_PoolBase):
+    type_name = "relu_max_pooling"
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        return [self._pool(jax.nn.relu(inputs[0]), lax.max, -jnp.inf)]
+
+
+@register
+class InsanityPoolingLayer(_PoolBase):
+    type_name = "insanity_max_pooling"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.p_keep = 1.0
+
+    def set_param(self, name, val):
+        if name == "keep":
+            self.p_keep = float(val)
+        else:
+            super().set_param(name, val)
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        x = inputs[0]
+        if train and rng is not None and self.p_keep < 1.0:
+            # jitter each source pixel to a neighbour with prob (1-keep)/4
+            # per direction, border-clamped (insanity_pooling:70-100)
+            flag = jax.random.uniform(rng, x.shape, x.dtype)
+            up = jnp.concatenate([x[:, :1], x[:, :-1]], axis=1)
+            down = jnp.concatenate([x[:, 1:], x[:, -1:]], axis=1)
+            left = jnp.concatenate([x[:, :, :1], x[:, :, :-1]], axis=2)
+            right = jnp.concatenate([x[:, :, 1:], x[:, :, -1:]], axis=2)
+            d = (1.0 - self.p_keep) / 4.0
+            x = jnp.where(
+                flag < self.p_keep,
+                x,
+                jnp.where(
+                    flag < self.p_keep + d,
+                    up,
+                    jnp.where(
+                        flag < self.p_keep + 2 * d,
+                        down,
+                        jnp.where(flag < self.p_keep + 3 * d, left, right),
+                    ),
+                ),
+            )
+        return [self._pool(x, lax.max, -jnp.inf)]
+
+
+@register
+class LRNLayer(Layer):
+    type_name = "lrn"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.nsize = 3
+        self.alpha = 0.001
+        self.beta = 0.75
+        self.knorm = 1.0
+
+    def set_param(self, name, val):
+        if name == "local_size":
+            self.nsize = int(val)
+        elif name == "alpha":
+            self.alpha = float(val)
+        elif name == "beta":
+            self.beta = float(val)
+        elif name == "knorm":
+            self.knorm = float(val)
+        else:
+            super().set_param(name, val)
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        self._check_arity(in_shapes, 1)
+        return [tuple(in_shapes[0])]
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        x = inputs[0]
+        half = self.nsize // 2
+        # cross-channel sum of squares over a window of nsize channels
+        sq = x * x
+        norm_win = lax.reduce_window(
+            sq,
+            jnp.asarray(0.0, x.dtype),
+            lax.add,
+            window_dimensions=(1, 1, 1, self.nsize),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (0, 0), (0, 0), (half, self.nsize - 1 - half)),
+        )
+        norm = self.knorm + (self.alpha / self.nsize) * norm_win
+        return [x * norm ** (-self.beta)]
+
+
+@register
+class BatchNormLayer(Layer):
+    type_name = "batch_norm"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.init_slope = 1.0
+        self.init_bias_bn = 0.0
+        self.eps = 1e-10
+
+    def set_param(self, name, val):
+        if name == "init_slope":
+            self.init_slope = float(val)
+        elif name == "init_bias":
+            self.init_bias_bn = float(val)
+        elif name == "eps":
+            self.eps = float(val)
+        else:
+            super().set_param(name, val)
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        self._check_arity(in_shapes, 1)
+        return [tuple(in_shapes[0])]
+
+    def init_params(self, key, in_shapes) -> Params:
+        ch = in_shapes[0][-1]
+        return {
+            "wmat": jnp.full((ch,), self.init_slope, jnp.float32),
+            "bias": jnp.full((ch,), self.init_bias_bn, jnp.float32),
+        }
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        x = inputs[0]
+        axes = tuple(range(x.ndim - 1))  # all but channel
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.mean((x - mean) ** 2, axis=axes)
+        inv = lax.rsqrt(var + jnp.asarray(self.eps, var.dtype))
+        slope = params["wmat"].astype(x.dtype)
+        bias = params["bias"].astype(x.dtype)
+        return [(x - mean) * inv * slope + bias]

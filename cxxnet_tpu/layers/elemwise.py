@@ -1,0 +1,241 @@
+"""Elementwise layers: activations, leaky-ReLU family, dropout, bias.
+
+Parity sources:
+* activations — ``/root/reference/src/layer/activation_layer-inl.hpp`` +
+  functor definitions in ``/root/reference/src/layer/op.h:21-103``
+* xelu — ``/root/reference/src/layer/xelu_layer-inl.hpp`` (slope 1/b, b=5)
+* prelu — ``/root/reference/src/layer/prelu_layer-inl.hpp`` (learnable
+  per-channel slope, train-time multiplicative slope noise, slope mask
+  clamped to [0, 1])
+* insanity — ``/root/reference/src/layer/insanity_layer-inl.hpp``
+  (randomized leaky ReLU: per-element slope 1/u, u ~ U[lb, ub] at train,
+  midpoint at eval, annealed toward the midpoint over
+  [calm_start, calm_end])
+* dropout — ``/root/reference/src/layer/dropout_layer-inl.hpp`` (inverted
+  dropout, ``threshold`` = drop probability, self-loop)
+* bias — ``/root/reference/src/layer/bias_layer-inl.hpp`` (additive bias
+  over flat nodes, self-loop capable)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .base import Layer, Params, Shape, register
+
+
+class _UnaryLayer(Layer):
+    """1-in/1-out shape-preserving elementwise layer."""
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        self._check_arity(in_shapes, 1)
+        return [tuple(in_shapes[0])]
+
+
+@register
+class SigmoidLayer(_UnaryLayer):
+    type_name = "sigmoid"
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        return [jax.nn.sigmoid(inputs[0])]
+
+
+@register
+class TanhLayer(_UnaryLayer):
+    type_name = "tanh"
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        return [jnp.tanh(inputs[0])]
+
+
+@register
+class ReluLayer(_UnaryLayer):
+    type_name = "relu"
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        return [jax.nn.relu(inputs[0])]
+
+
+@register
+class SoftplusLayer(_UnaryLayer):
+    """``softplus`` parses in the reference (layer.h:331) but its factory
+    has no case and errors out (layer_impl-inl.hpp:76); here it works."""
+
+    type_name = "softplus"
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        return [jax.nn.softplus(inputs[0])]
+
+
+@register
+class XeluLayer(_UnaryLayer):
+    """Leaky ReLU with negative slope ``1/b`` (xelu_layer-inl.hpp:17-45)."""
+
+    type_name = "xelu"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.b = 5.0
+
+    def set_param(self, name, val):
+        if name == "b":
+            self.b = float(val)
+        else:
+            super().set_param(name, val)
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        x = inputs[0]
+        return [jnp.where(x > 0, x, x / self.b)]
+
+
+def _channel_axis(shape: Shape) -> int:
+    """Per-channel axis: C for NHWC images, feature for flat nodes.
+
+    Mirrors the reference's ``size(1) == 1 ? size(3) : size(1)`` dispatch
+    (prelu_layer-inl.hpp:68-73) translated to NHWC/flat layouts.
+    """
+    return len(shape) - 1
+
+
+@register
+class PReluLayer(_UnaryLayer):
+    type_name = "prelu"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.init_slope = 0.25
+        self.init_random = 0
+        self.random = 0.0
+
+    def set_param(self, name, val):
+        if name == "init_slope":
+            self.init_slope = float(val)
+        elif name == "random_slope":
+            self.init_random = int(val)
+        elif name == "random":
+            self.random = float(val)
+        else:
+            super().set_param(name, val)
+
+    def init_params(self, key, in_shapes) -> Params:
+        ch = in_shapes[0][_channel_axis(in_shapes[0])]
+        if self.init_random:
+            slope = self.init_slope * jax.random.uniform(key, (ch,), jnp.float32)
+        else:
+            slope = jnp.full((ch,), self.init_slope, jnp.float32)
+        # tagged "bias" so bias:lr / bias:wd overrides apply, matching the
+        # reference's ApplyVisitor tag (prelu_layer-inl.hpp:60-62)
+        return {"bias": slope}
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        x = inputs[0]
+        slope = params["bias"].astype(x.dtype)
+        bshape = [1] * x.ndim
+        bshape[_channel_axis(x.shape)] = -1
+        mask = jnp.broadcast_to(slope.reshape(bshape), x.shape)
+        if train and self.random > 0 and rng is not None:
+            noise = 1.0 + (jax.random.uniform(rng, x.shape, x.dtype) * 2.0 - 1.0) * self.random
+            mask = mask * noise
+        mask = jnp.clip(mask, 0.0, 1.0)
+        return [jnp.where(x > 0, x, x * mask)]
+
+
+@register
+class InsanityLayer(_UnaryLayer):
+    """Randomized leaky ReLU (RReLU).
+
+    Train: per-element slope ``1/u`` with ``u ~ U[lb, ub]``; eval: slope
+    ``2/(lb+ub)``.  The reference anneals ``[lb, ub]`` toward the midpoint
+    between ``calm_start`` and ``calm_end`` forward calls via an in-place
+    recurrence (insanity_layer-inl.hpp:60-75); here the anneal is the
+    equivalent *linear* ramp of the interval endpoints over the same step
+    range, expressed as a pure function of the step counter so it can live
+    inside ``jit``.
+    """
+
+    type_name = "insanity"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.lb = 5.0
+        self.ub = 10.0
+        self.calm_start = 0
+        self.calm_end = 0
+
+    def set_param(self, name, val):
+        if name == "lb":
+            self.lb = float(val)
+        elif name == "ub":
+            self.ub = float(val)
+        elif name == "calm_start":
+            self.calm_start = int(val)
+        elif name == "calm_end":
+            self.calm_end = int(val)
+        else:
+            super().set_param(name, val)
+
+    def _interval(self, step: Optional[jnp.ndarray]):
+        lb, ub = self.lb, self.ub
+        if self.calm_end <= self.calm_start or step is None:
+            return jnp.float32(lb), jnp.float32(ub)
+        mid = (lb + ub) / 2.0
+        t = jnp.clip(
+            (step - self.calm_start) / (self.calm_end - self.calm_start), 0.0, 1.0
+        ).astype(jnp.float32)
+        return lb + (mid - lb) * t, ub + (mid - ub) * t
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        x = inputs[0]
+        lb, ub = self._interval(step)
+        if train and rng is not None:
+            u = jax.random.uniform(rng, x.shape, x.dtype) * (ub - lb) + lb
+        else:
+            u = (lb + ub) / 2.0
+        return [jnp.where(x > 0, x, x / u)]
+
+
+@register
+class DropoutLayer(_UnaryLayer):
+    type_name = "dropout"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.threshold = 0.0
+
+    def set_param(self, name, val):
+        if name == "threshold":
+            self.threshold = float(val)
+            if not (0.0 <= self.threshold < 1.0):
+                raise ValueError("DropoutLayer: invalid dropout threshold")
+        else:
+            super().set_param(name, val)
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        x = inputs[0]
+        if not train or self.threshold <= 0.0 or rng is None:
+            return [x]
+        pkeep = 1.0 - self.threshold
+        mask = jax.random.bernoulli(rng, pkeep, x.shape)
+        return [jnp.where(mask, x / pkeep, jnp.zeros_like(x))]
+
+
+@register
+class BiasLayer(_UnaryLayer):
+    """Additive per-feature bias over flat nodes (bias_layer-inl.hpp)."""
+
+    type_name = "bias"
+
+    def infer_shape(self, in_shapes):
+        self._check_arity(in_shapes, 1)
+        if len(in_shapes[0]) != 2:
+            raise ValueError("BiasLayer: input must be a flat matrix node")
+        return [tuple(in_shapes[0])]
+
+    def init_params(self, key, in_shapes) -> Params:
+        return {"bias": jnp.full((in_shapes[0][1],), self.param.init_bias, jnp.float32)}
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        return [inputs[0] + params["bias"].astype(inputs[0].dtype)]

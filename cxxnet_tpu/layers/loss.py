@@ -1,0 +1,62 @@
+"""Loss layers: softmax, l2_loss, multi_logistic.
+
+These are self-loop layers in reference configs (``layer[+0] = softmax``).
+Each defines ``transform`` (the prediction-time output) and ``loss`` (a
+summed scalar) such that ``d loss / d input`` equals the gradient the
+reference injects in ``SetGradCPU``:
+
+* softmax — probs; grad ``p - onehot(y)``
+  (``loss/softmax_layer-inl.hpp:23-31``)  → loss = Σ cross-entropy
+* l2_loss — identity; grad ``x - y``
+  (``loss/l2_loss_layer-inl.hpp:22-32``)  → loss = ½ Σ (x-y)²
+* multi_logistic — sigmoid; grad ``σ(x) - y``
+  (``loss/multi_logistic_layer-inl.hpp``) → loss = Σ BCE-with-logits
+
+The trainer multiplies each loss by ``grad_scale / (batch_size *
+update_period)`` (``loss/loss_layer_base-inl.hpp:60-63``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import LossLayer, register
+
+
+@register
+class SoftmaxLayer(LossLayer):
+    type_name = "softmax"
+
+    def transform(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def loss(self, x, labels):
+        # labels: (N,) or (N,1) integer class ids
+        lab = labels.reshape(labels.shape[0]).astype(jnp.int32)
+        logp = jax.nn.log_softmax(x, axis=-1)
+        return -jnp.sum(jnp.take_along_axis(logp, lab[:, None], axis=-1))
+
+
+@register
+class L2LossLayer(LossLayer):
+    type_name = "l2_loss"
+
+    def loss(self, x, labels):
+        lab = labels.reshape(x.shape).astype(x.dtype)
+        return 0.5 * jnp.sum((x - lab) ** 2)
+
+
+@register
+class MultiLogisticLayer(LossLayer):
+    type_name = "multi_logistic"
+
+    def transform(self, x):
+        return jax.nn.sigmoid(x)
+
+    def loss(self, x, labels):
+        lab = labels.reshape(x.shape).astype(x.dtype)
+        # BCE with logits; gradient wrt x is sigmoid(x) - lab
+        return jnp.sum(
+            jnp.maximum(x, 0) - x * lab + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        )

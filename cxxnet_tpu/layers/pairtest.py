@@ -1,0 +1,58 @@
+"""PairTest layer: differential testing of two layer implementations.
+
+Parity: ``/root/reference/src/layer/pairtest_layer-inl.hpp`` — config name
+``pairtest-<master>-<slave>`` runs both implementations on the same input
+with synchronized weights and compares outputs (rel-err 1e-5).  In the
+reference this is a runtime harness; here it doubles as a real test
+utility: ``compare`` returns the max relative error between master and
+slave outputs, and the graph forwards the master's output.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+from .base import Layer, Params, Shape
+
+
+class PairTestLayer(Layer):
+    type_name = "pairtest"
+
+    def __init__(self, master: Layer, slave: Layer) -> None:
+        super().__init__()
+        self.master = master
+        self.slave = slave
+        self.is_loss = master.is_loss
+
+    def set_param(self, name, val):
+        self.master.set_param(name, val)
+        self.slave.set_param(name, val)
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        out_m = self.master.infer_shape(in_shapes)
+        out_s = self.slave.infer_shape(in_shapes)
+        if out_m != out_s:
+            raise ValueError(
+                f"pairtest: master/slave shape mismatch {out_m} vs {out_s}"
+            )
+        return out_m
+
+    def init_params(self, key, in_shapes) -> Params:
+        # master's params are shared with the slave (weight sync at init,
+        # pairtest_layer-inl.hpp:40-55)
+        return self.master.init_params(key, in_shapes)
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        return self.master.apply(params, inputs, train=train, rng=rng, step=step)
+
+    def compare(self, params, inputs, *, rtol_floor: float = 1e-8) -> jnp.ndarray:
+        """Max relative error between master and slave outputs (eval mode)."""
+        out_m = self.master.apply(params, inputs, train=False)
+        out_s = self.slave.apply(params, inputs, train=False)
+        errs = []
+        for m, s in zip(out_m, out_s):
+            denom = jnp.maximum(jnp.abs(m), rtol_floor)
+            errs.append(jnp.max(jnp.abs(m - s) / denom))
+        return jnp.stack(errs).max()

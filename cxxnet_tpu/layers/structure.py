@@ -1,0 +1,78 @@
+"""Graph-structural layers: split, concat, ch_concat.
+
+Parity sources:
+* split — ``/root/reference/src/layer/split_layer-inl.hpp`` (1→n copy
+  forward; gradient sum handled by autodiff here)
+* concat / ch_concat — ``/root/reference/src/layer/concat_layer-inl.hpp``
+  (2–4 inputs; ``concat`` joins the mshadow dim-3 axis — the feature axis
+  of flat nodes / width of images; ``ch_concat`` joins channels)
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+from .base import Layer, Shape, register
+
+
+@register
+class SplitLayer(Layer):
+    type_name = "split"
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        self._check_arity(in_shapes, 1)
+        # number of outputs is set by the graph builder via n_split
+        n = getattr(self, "n_split", 1)
+        return [tuple(in_shapes[0]) for _ in range(n)]
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        n = getattr(self, "n_split", 1)
+        return [inputs[0] for _ in range(n)]
+
+
+class _ConcatBase(Layer):
+    def _axis(self, shape: Shape) -> int:
+        raise NotImplementedError
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        if not (2 <= len(in_shapes) <= 4):
+            raise ValueError(f"{self.type_name}: supports 2-4 inputs, got {len(in_shapes)}")
+        ax = self._axis(in_shapes[0])
+        base = list(in_shapes[0])
+        total = 0
+        for s in in_shapes:
+            if len(s) != len(base):
+                raise ValueError(f"{self.type_name}: rank mismatch")
+            for j in range(len(base)):
+                if j != ax and s[j] != base[j]:
+                    raise ValueError(f"{self.type_name}: shape mismatch on axis {j}")
+            total += s[ax]
+        base[ax] = total
+        return [tuple(base)]
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        return [jnp.concatenate(list(inputs), axis=self._axis(inputs[0].shape))]
+
+
+@register
+class ConcatLayer(_ConcatBase):
+    """Feature concat: last axis of flat nodes, width axis of images."""
+
+    type_name = "concat"
+
+    def _axis(self, shape: Shape) -> int:
+        return 1 if len(shape) == 2 else 2  # (N,D) features | NHWC width
+
+
+@register
+class ChConcatLayer(_ConcatBase):
+    """Channel concat (NHWC last axis) — the inception-block join."""
+
+    type_name = "ch_concat"
+
+    def _axis(self, shape: Shape) -> int:
+        if len(shape) != 4:
+            raise ValueError("ch_concat: input must be an NHWC image node")
+        return 3

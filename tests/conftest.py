@@ -7,7 +7,10 @@ hardware.  Must be set before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the container exports JAX_PLATFORMS=axon (the real TPU tunnel),
+# which must never be used for tests — it is single-client and slow to
+# compile. setdefault would keep the axon value; tests hard-override.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,6 +20,22 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# The container's sitecustomize (PYTHONPATH=/root/.axon_site) registers the
+# 'axon' TPU-tunnel PJRT plugin in every interpreter; initializing it from
+# tests would contend for (or hang on) the single-client relay.  Deregister
+# the factory before any backend is initialized so tests are pure-CPU.
+import jax  # noqa: E402
+
+try:
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:  # pragma: no cover - jax internals moved; cpu-forcing env remains
+    pass
+# sitecustomize's register() overrides jax_platforms to "axon,cpu" via
+# jax.config, which wins over the env var — force it back.
+jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture

@@ -1,0 +1,398 @@
+"""Golden tests: every layer vs a straightforward numpy reference.
+
+This is the PairTest discipline of the reference (SURVEY §4.1) turned into
+a real test suite: master = the JAX layer, slave = naive numpy.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu import layers as L
+
+
+def mk(name, cfg=()):
+    lay = L.create_layer(name)
+    for k, v in cfg:
+        lay.set_param(k, v)
+    return lay
+
+
+def run1(lay, x, train=False, rng=None, extra_inputs=None, step=None):
+    inputs = [jnp.asarray(x)] + [jnp.asarray(e) for e in (extra_inputs or [])]
+    shapes = [i.shape for i in inputs]
+    out_shapes = lay.infer_shape(shapes)
+    params = lay.init_params(jax.random.PRNGKey(0), shapes)
+    outs = lay.apply(params, inputs, train=train, rng=rng, step=step)
+    for o, s in zip(outs, out_shapes):
+        assert tuple(o.shape) == tuple(s), f"{lay.type_name}: inferred {s} got {o.shape}"
+    return [np.asarray(o) for o in outs], params
+
+
+# ---------------------------------------------------------------- dense
+
+
+def test_fullc_forward(rng):
+    x = rng.randn(4, 7).astype(np.float32)
+    lay = mk("fullc", [("nhidden", "5"), ("init_sigma", "0.1")])
+    (out,), params = run1(lay, x)
+    want = x @ np.asarray(params["wmat"]).T + np.asarray(params["bias"])
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_fullc_no_bias(rng):
+    x = rng.randn(3, 4).astype(np.float32)
+    lay = mk("fullc", [("nhidden", "2"), ("no_bias", "1")])
+    (out,), params = run1(lay, x)
+    assert "bias" not in params
+    np.testing.assert_allclose(out, x @ np.asarray(params["wmat"]).T, rtol=1e-5)
+
+
+def test_fullc_rejects_image_input():
+    lay = mk("fullc", [("nhidden", "2")])
+    with pytest.raises(ValueError):
+        lay.infer_shape([(2, 3, 3, 1)])
+
+
+def test_flatten(rng):
+    x = rng.randn(2, 3, 4, 5).astype(np.float32)
+    lay = mk("flatten")
+    (out,), _ = run1(lay, x)
+    np.testing.assert_allclose(out, x.reshape(2, -1))
+
+
+def test_fixconn(tmp_path, rng):
+    w = np.zeros((3, 4), np.float32)
+    w[0, 1] = 2.0
+    w[2, 3] = -1.5
+    f = tmp_path / "w.txt"
+    f.write_text("3 4 2\n0 1 2.0\n2 3 -1.5\n")
+    x = rng.randn(5, 4).astype(np.float32)
+    lay = mk("fixconn", [("nhidden", "3"), ("fixconn_weight", str(f))])
+    (out,), params = run1(lay, x)
+    assert params == {}
+    np.testing.assert_allclose(out, x @ w.T, rtol=1e-5)
+
+
+# ---------------------------------------------------------------- conv
+
+
+def conv_ref(x, w, b, stride, pad, ngroup):
+    """Naive NHWC grouped conv. w: (kh, kw, cin_g, cout)."""
+    n, h, wd, c = x.shape
+    kh, kw, cin_g, cout = w.shape
+    xp = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, oh, ow, cout), np.float32)
+    cout_g = cout // ngroup
+    for g in range(ngroup):
+        xg = xp[..., g * cin_g : (g + 1) * cin_g]
+        wg = w[..., g * cout_g : (g + 1) * cout_g]
+        for i in range(oh):
+            for j in range(ow):
+                patch = xg[:, i * stride : i * stride + kh, j * stride : j * stride + kw, :]
+                out[:, i, j, g * cout_g : (g + 1) * cout_g] = np.einsum(
+                    "nhwc,hwck->nk", patch, wg
+                )
+    if b is not None:
+        out += b
+    return out
+
+
+@pytest.mark.parametrize("ngroup,pad,stride", [(1, 0, 1), (1, 1, 2), (2, 2, 1)])
+def test_conv_forward(rng, ngroup, pad, stride):
+    x = rng.randn(2, 8, 8, 4).astype(np.float32)
+    lay = mk(
+        "conv",
+        [
+            ("kernel_size", "3"),
+            ("nchannel", "6"),
+            ("ngroup", str(ngroup)),
+            ("pad", str(pad)),
+            ("stride", str(stride)),
+            ("init_sigma", "0.1"),
+        ],
+    )
+    (out,), params = run1(lay, x)
+    want = conv_ref(
+        x, np.asarray(params["wmat"]), np.asarray(params["bias"]), stride, pad, ngroup
+    )
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_shape_formula():
+    lay = mk("conv", [("kernel_size", "11"), ("stride", "4"), ("nchannel", "96")])
+    assert lay.infer_shape([(2, 227, 227, 3)]) == [(2, 55, 55, 96)]
+
+
+# ---------------------------------------------------------------- pooling
+
+
+def pool_ref(x, k, s, mode):
+    """Naive ceil-mode pooling with partial edge windows (reference rule)."""
+    n, h, w, c = x.shape
+    oh = min(h - k + s - 1, h - 1) // s + 1
+    ow = min(w - k + s - 1, w - 1) // s + 1
+    out = np.zeros((n, oh, ow, c), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            win = x[:, i * s : min(i * s + k, h), j * s : min(j * s + k, w), :]
+            if mode == "max":
+                out[:, i, j] = win.max(axis=(1, 2))
+            elif mode == "sum":
+                out[:, i, j] = win.sum(axis=(1, 2))
+            else:  # avg: always divide by k*k (reference parity)
+                out[:, i, j] = win.sum(axis=(1, 2)) / (k * k)
+    return out
+
+
+@pytest.mark.parametrize(
+    "name,mode", [("max_pooling", "max"), ("sum_pooling", "sum"), ("avg_pooling", "avg")]
+)
+@pytest.mark.parametrize("hw,k,s", [(28, 3, 2), (6, 2, 2), (7, 3, 3)])
+def test_pooling(rng, name, mode, hw, k, s):
+    x = rng.randn(2, hw, hw, 3).astype(np.float32)
+    lay = mk(name, [("kernel_size", str(k)), ("stride", str(s))])
+    (out,), _ = run1(lay, x)
+    np.testing.assert_allclose(out, pool_ref(x, k, s, mode), rtol=1e-5, atol=1e-6)
+
+
+def test_pooling_ceil_shape():
+    # 28x28, k=3, s=2 → 14 (ceil), not 13 (floor)
+    lay = mk("max_pooling", [("kernel_size", "3"), ("stride", "2")])
+    assert lay.infer_shape([(1, 28, 28, 8)]) == [(1, 14, 14, 8)]
+
+
+def test_relu_max_pooling(rng):
+    x = rng.randn(2, 6, 6, 2).astype(np.float32)
+    lay = mk("relu_max_pooling", [("kernel_size", "2"), ("stride", "2")])
+    (out,), _ = run1(lay, x)
+    np.testing.assert_allclose(out, pool_ref(np.maximum(x, 0), 2, 2, "max"), rtol=1e-5)
+
+
+def test_insanity_pooling_eval_is_maxpool(rng):
+    x = rng.randn(2, 6, 6, 2).astype(np.float32)
+    lay = mk("insanity_max_pooling", [("kernel_size", "2"), ("stride", "2"), ("keep", "0.7")])
+    (out,), _ = run1(lay, x, train=False)
+    np.testing.assert_allclose(out, pool_ref(x, 2, 2, "max"), rtol=1e-5)
+
+
+def test_insanity_pooling_train_bounded(rng):
+    # jittered max-pool output values must come from the input tensor
+    x = rng.randn(1, 8, 8, 1).astype(np.float32)
+    lay = mk("insanity_max_pooling", [("kernel_size", "2"), ("stride", "2"), ("keep", "0.5")])
+    (out,), _ = run1(lay, x, train=True, rng=jax.random.PRNGKey(1))
+    assert np.isin(np.round(out, 5), np.round(x, 5)).all()
+
+
+# ---------------------------------------------------------------- norm
+
+
+def test_lrn(rng):
+    x = rng.randn(2, 4, 4, 6).astype(np.float32)
+    n = 5
+    alpha, beta, knorm = 0.001, 0.75, 1.0
+    lay = mk("lrn", [("local_size", str(n)), ("alpha", str(alpha)), ("beta", str(beta)), ("knorm", str(knorm))])
+    (out,), _ = run1(lay, x)
+    c = x.shape[-1]
+    want = np.zeros_like(x)
+    half = n // 2
+    for ch in range(c):
+        lo, hi = max(0, ch - half), min(c, ch + (n - 1 - half) + 1)
+        norm = knorm + alpha / n * (x[..., lo:hi] ** 2).sum(-1)
+        want[..., ch] = x[..., ch] * norm ** (-beta)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape", [(8, 5, 5, 3), (16, 7)])
+def test_batch_norm(rng, shape):
+    x = (rng.randn(*shape) * 3 + 1).astype(np.float32)
+    lay = mk("batch_norm", [("init_slope", "1.5"), ("init_bias", "0.2")])
+    (out,), _ = run1(lay, x, train=True)
+    axes = tuple(range(x.ndim - 1))
+    mean, var = x.mean(axes), x.var(axes)
+    want = (x - mean) / np.sqrt(var + 1e-10) * 1.5 + 0.2
+    np.testing.assert_allclose(out, want, rtol=1e-3, atol=1e-4)
+    # reference parity: eval ALSO uses minibatch stats
+    (out_eval,), _ = run1(lay, x, train=False)
+    np.testing.assert_allclose(out_eval, want, rtol=1e-3, atol=1e-4)
+
+
+# ---------------------------------------------------------------- elemwise
+
+
+def test_activations(rng):
+    x = rng.randn(3, 5).astype(np.float32)
+    for name, fn in [
+        ("relu", lambda v: np.maximum(v, 0)),
+        ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+        ("tanh", np.tanh),
+        ("softplus", lambda v: np.log1p(np.exp(v))),
+    ]:
+        (out,), _ = run1(mk(name), x)
+        np.testing.assert_allclose(out, fn(x), rtol=1e-5, atol=1e-6)
+
+
+def test_xelu(rng):
+    x = rng.randn(3, 5).astype(np.float32)
+    (out,), _ = run1(mk("xelu", [("b", "4")]), x)
+    np.testing.assert_allclose(out, np.where(x > 0, x, x / 4), rtol=1e-5)
+
+
+def test_prelu_eval(rng):
+    x = rng.randn(2, 4, 4, 3).astype(np.float32)
+    lay = mk("prelu", [("init_slope", "0.25")])
+    (out,), params = run1(lay, x)
+    np.testing.assert_allclose(out, np.where(x > 0, x, 0.25 * x), rtol=1e-5)
+    assert params["bias"].shape == (3,)
+
+
+def test_insanity_eval(rng):
+    x = rng.randn(3, 5).astype(np.float32)
+    lay = mk("insanity", [("lb", "4"), ("ub", "8")])
+    (out,), _ = run1(lay, x)
+    np.testing.assert_allclose(out, np.where(x > 0, x, x / 6.0), rtol=1e-5)
+
+
+def test_dropout(rng):
+    x = np.ones((100, 100), np.float32)
+    lay = mk("dropout", [("threshold", "0.4")])
+    (out_eval,), _ = run1(lay, x, train=False)
+    np.testing.assert_allclose(out_eval, x)
+    (out_tr,), _ = run1(lay, x, train=True, rng=jax.random.PRNGKey(3))
+    vals = np.unique(np.round(out_tr, 4))
+    assert set(vals) <= {0.0, np.float32(np.round(1 / 0.6, 4))}
+    assert abs((out_tr == 0).mean() - 0.4) < 0.02
+
+
+def test_bias_layer(rng):
+    x = rng.randn(4, 6).astype(np.float32)
+    lay = mk("bias", [("init_bias", "0.5")])
+    (out,), params = run1(lay, x)
+    np.testing.assert_allclose(out, x + 0.5)
+    assert params["bias"].shape == (6,)
+
+
+# ---------------------------------------------------------------- structure
+
+
+def test_split():
+    lay = mk("split")
+    lay.n_split = 3
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    outs, _ = run1(lay, x)
+    assert len(outs) == 3
+    for o in outs:
+        np.testing.assert_allclose(o, x)
+
+
+def test_concat_flat(rng):
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(3, 2).astype(np.float32)
+    outs, _ = run1(mk("concat"), a, extra_inputs=[b])
+    np.testing.assert_allclose(outs[0], np.concatenate([a, b], axis=1))
+
+
+def test_ch_concat(rng):
+    a = rng.randn(2, 4, 4, 3).astype(np.float32)
+    b = rng.randn(2, 4, 4, 5).astype(np.float32)
+    outs, _ = run1(mk("ch_concat"), a, extra_inputs=[b])
+    np.testing.assert_allclose(outs[0], np.concatenate([a, b], axis=3))
+
+
+def test_concat_shape_mismatch(rng):
+    lay = mk("ch_concat")
+    with pytest.raises(ValueError):
+        lay.infer_shape([(2, 4, 4, 3), (2, 5, 4, 5)])
+
+
+# ---------------------------------------------------------------- losses
+
+
+def test_softmax_loss_grad_matches_reference(rng):
+    x = jnp.asarray(rng.randn(6, 10).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 10, size=(6,)))
+    lay = mk("softmax")
+    g = jax.grad(lambda v: lay.loss(v, y))(x)
+    p = np.asarray(jax.nn.softmax(x, axis=-1))
+    want = p.copy()
+    want[np.arange(6), np.asarray(y)] -= 1.0
+    np.testing.assert_allclose(np.asarray(g), want, rtol=1e-4, atol=1e-6)
+    # transform is softmax probs
+    (out,), _ = run1(lay, x)
+    np.testing.assert_allclose(out, p, rtol=1e-5)
+
+
+def test_l2_loss_grad(rng):
+    x = jnp.asarray(rng.randn(4, 3).astype(np.float32))
+    y = jnp.asarray(rng.randn(4, 3).astype(np.float32))
+    lay = mk("l2_loss")
+    g = jax.grad(lambda v: lay.loss(v, y))(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(x - y), rtol=1e-5)
+
+
+def test_multi_logistic_grad(rng):
+    x = jnp.asarray(rng.randn(4, 3).astype(np.float32))
+    y = jnp.asarray((rng.rand(4, 3) > 0.5).astype(np.float32))
+    lay = mk("multi_logistic")
+    g = jax.grad(lambda v: lay.loss(v, y))(x)
+    want = np.asarray(jax.nn.sigmoid(x)) - np.asarray(y)
+    np.testing.assert_allclose(np.asarray(g), want, rtol=1e-4, atol=1e-6)
+
+
+# ---------------------------------------------------------------- pairtest & registry
+
+
+def test_pairtest_identical_masters(rng):
+    lay = L.create_layer("pairtest-relu-relu")
+    x = jnp.asarray(rng.randn(3, 5).astype(np.float32))
+    lay.infer_shape([x.shape])
+    err = lay.compare({}, [x])
+    assert float(err) == 0.0
+
+
+def test_pairtest_divergent(rng):
+    lay = L.create_layer("pairtest-relu-sigmoid")
+    x = jnp.asarray(rng.randn(3, 5).astype(np.float32))
+    lay.infer_shape([x.shape])
+    assert float(lay.compare({}, [x])) > 0.01
+
+
+def test_registry_covers_reference_zoo():
+    want = {
+        "fullc", "fixconn", "bias", "softmax", "relu", "sigmoid", "tanh",
+        "softplus", "flatten", "dropout", "conv", "relu_max_pooling",
+        "max_pooling", "sum_pooling", "avg_pooling", "lrn", "concat",
+        "split", "xelu", "insanity", "insanity_max_pooling", "l2_loss",
+        "multi_logistic", "ch_concat", "prelu", "batch_norm",
+    }
+    assert want <= set(L.layer_types())
+
+
+def test_unknown_layer_type():
+    with pytest.raises(ValueError):
+        L.create_layer("wombat")
+
+
+# ---------------------------------------------------------------- init rules
+
+
+def test_init_distributions():
+    import math
+
+    p = L.LayerParam()
+    key = jax.random.PRNGKey(0)
+    p.random_type, p.init_sigma = 0, 0.05
+    w = p.rand_init_weight(key, (200, 200), 200, 200)
+    assert abs(float(jnp.std(w)) - 0.05) < 0.005
+    p.random_type = 1  # xavier uniform: a = sqrt(3/(in+out))
+    w = p.rand_init_weight(key, (200, 200), 100, 100)
+    a = math.sqrt(3.0 / 200)
+    assert float(jnp.max(jnp.abs(w))) <= a + 1e-6
+    assert float(jnp.max(jnp.abs(w))) > 0.8 * a
+    p.random_type = 2  # kaiming from nhidden
+    p.num_hidden = 50
+    w = p.rand_init_weight(key, (200, 200), 0, 0)
+    assert abs(float(jnp.std(w)) - math.sqrt(2.0 / 50)) < 0.02
