@@ -1,0 +1,288 @@
+"""Task driver: ``python -m cxxnet_tpu <config.conf> [name=val ...]``.
+
+Parity: ``CXXNetLearnTask`` (``/root/reference/src/cxxnet_main.cpp``):
+tasks ``train`` / ``pred`` / ``extract`` / ``finetune``; round loop with
+per-round evaluation lines ``[round]\\tname-metric:value`` on stderr;
+``%04d.model`` checkpoints every ``save_model`` rounds in ``model_dir``;
+``continue=1`` resumes from the newest checkpoint; ``model_in`` loads a
+model (inferring ``start_counter`` from its filename); ``test_io=1``
+pulls batches without updating (IO throughput dry-run); ``print_step``
+progress lines; ``max_round`` caps rounds this invocation.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List, Optional
+
+from . import config as cfgmod
+from .io.data import DataIter, create_iterator
+from .nnet.trainer import NetTrainer
+
+
+class LearnTask:
+    def __init__(self) -> None:
+        self.task = "train"
+        self.net_trainer: Optional[NetTrainer] = None
+        self.itr_train: Optional[DataIter] = None
+        self.itr_pred: Optional[DataIter] = None
+        self.itr_evals: List[DataIter] = []
+        self.eval_names: List[str] = []
+        self.name_model_dir = "models"
+        self.num_round = 10
+        self.max_round = 1 << 30
+        self.test_io = 0
+        self.silent = 0
+        self.start_counter = 0
+        self.continue_training = 0
+        self.save_period = 1
+        self.name_model_in = "NULL"
+        self.name_pred = "pred.txt"
+        self.print_step = 100
+        self.extract_node_name = ""
+        self.output_format = 1
+        self.cfg: List[tuple] = []
+
+    # ------------------------------------------------------------------
+    def set_param(self, name: str, val: str) -> None:
+        if val == "default":
+            return
+        if name == "print_step":
+            self.print_step = int(val)
+        elif name == "continue":
+            self.continue_training = int(val)
+        elif name == "save_model":
+            self.save_period = int(val)
+        elif name == "start_counter":
+            self.start_counter = int(val)
+        elif name == "model_in":
+            self.name_model_in = val
+        elif name == "model_dir":
+            self.name_model_dir = val
+        elif name == "num_round":
+            self.num_round = int(val)
+        elif name == "max_round":
+            self.max_round = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "task":
+            self.task = val
+        elif name == "test_io":
+            self.test_io = int(val)
+        elif name == "extract_node_name":
+            self.extract_node_name = val
+        elif name == "output_format":
+            self.output_format = 1 if val == "txt" else 0
+        self.cfg.append((name, val))
+
+    # ------------------------------------------------------------------
+    def run(self, argv: List[str]) -> int:
+        if len(argv) < 1:
+            print("Usage: <config> [name=val ...]")
+            return 0
+        for name, val in cfgmod.parse_file(argv[0]):
+            self.set_param(name, val)
+        for name, val in cfgmod.parse_cli_overrides(argv[1:]):
+            self.set_param(name, val)
+        if self.task not in ("train", "finetune", "pred", "extract"):
+            raise ValueError(f"unknown task {self.task!r}")
+        self.init()
+        if not self.silent:
+            print("initializing end, start working")
+        if self.task in ("train", "finetune"):
+            self.task_train()
+        elif self.task == "pred":
+            self.task_predict()
+        elif self.task == "extract":
+            self.task_extract()
+        else:
+            raise ValueError(f"unknown task {self.task!r}")
+        return 0
+
+    # ------------------------------------------------------------------
+    def _create_trainer(self) -> NetTrainer:
+        tr = NetTrainer()
+        tr.set_params(self.cfg)
+        return tr
+
+    def init(self) -> None:
+        if self.task == "train" and self.continue_training:
+            if self._sync_latest_model():
+                print(f"Init: Continue training from round {self.start_counter}")
+                self._create_iterators()
+                return
+            raise FileNotFoundError(
+                "Init: cannot find models for continue training; "
+                "specify model_in instead"
+            )
+        self.continue_training = 0
+        if self.name_model_in == "NULL":
+            if self.task != "train":
+                raise ValueError("must specify model_in if not training")
+            self.net_trainer = self._create_trainer()
+            self.net_trainer.init_model()
+        elif self.task == "finetune":
+            self.net_trainer = self._create_trainer()
+            self.net_trainer.copy_model_from(self.name_model_in)
+        else:
+            self._load_model()
+        self._create_iterators()
+
+    def _sync_latest_model(self) -> bool:
+        s = self.start_counter
+        last = None
+        while True:
+            path = os.path.join(self.name_model_dir, f"{s:04d}.model")
+            if not os.path.exists(path):
+                break
+            last, s = path, s + 1
+        if last is None:
+            return False
+        self.net_trainer = self._create_trainer()
+        self.net_trainer.load_model(last)
+        self.start_counter = s
+        return True
+
+    def _load_model(self) -> None:
+        base = os.path.basename(self.name_model_in)
+        stem = base.split(".")[0]
+        if stem.isdigit():
+            self.start_counter = int(stem)
+        else:
+            print(
+                "WARNING: cannot infer start_counter from model name; "
+                "set it in the config if needed"
+            )
+        self.net_trainer = self._create_trainer()
+        self.net_trainer.load_model(self.name_model_in)
+        self.start_counter += 1
+
+    def _save_model(self) -> None:
+        path = os.path.join(self.name_model_dir, f"{self.start_counter:04d}.model")
+        self.start_counter += 1
+        if self.save_period == 0 or self.start_counter % self.save_period != 0:
+            return
+        os.makedirs(self.name_model_dir, exist_ok=True)
+        self.net_trainer.save_model(path)
+
+    def _create_iterators(self) -> None:
+        split = cfgmod.split_sections(self.cfg)
+        for sec in split.sections:
+            if sec.kind == "data" and self.task != "pred":
+                if self.itr_train is not None:
+                    raise ValueError("can only have one data section")
+                self.itr_train = create_iterator(sec.entries)
+            elif sec.kind == "eval" and self.task != "pred":
+                self.itr_evals.append(create_iterator(sec.entries))
+                self.eval_names.append(sec.tag)
+            elif sec.kind == "pred":
+                self.name_pred = sec.tag
+                if self.task in ("pred", "extract"):
+                    if self.itr_pred is not None:
+                        raise ValueError("can only have one pred section")
+                    self.itr_pred = create_iterator(sec.entries)
+        for it in [self.itr_train, self.itr_pred, *self.itr_evals]:
+            if it is not None:
+                for n, v in split.global_entries:
+                    it.set_param(n, v)
+                it.init()
+
+    # ------------------------------------------------------------------
+    def task_train(self) -> None:
+        start = time.time()
+        if self.continue_training == 0 and self.name_model_in == "NULL":
+            self._save_model()
+        else:
+            for it, nm in zip(self.itr_evals, self.eval_names):
+                sys.stderr.write(self.net_trainer.evaluate(it, nm))
+            sys.stderr.write("\n")
+            sys.stderr.flush()
+        if self.itr_train is None:
+            return
+        if self.test_io:
+            print("start I/O test")
+        cc = self.max_round
+        while self.start_counter <= self.num_round and cc > 0:
+            cc -= 1
+            if not self.silent:
+                print(f"update round {self.start_counter - 1}", flush=True)
+            sample_counter = 0
+            self.net_trainer.start_round(self.start_counter)
+            self.itr_train.before_first()
+            while self.itr_train.next():
+                if self.test_io == 0:
+                    self.net_trainer.update(self.itr_train.value())
+                sample_counter += 1
+                if sample_counter % self.print_step == 0 and not self.silent:
+                    elapsed = int(time.time() - start)
+                    print(
+                        f"round {self.start_counter - 1:8d}:"
+                        f"[{sample_counter:8d}] {elapsed} sec elapsed",
+                        flush=True,
+                    )
+            if self.test_io == 0:
+                sys.stderr.write(f"[{self.start_counter}]")
+                if not self.itr_evals:
+                    sys.stderr.write(self.net_trainer.evaluate(None, "train"))
+                for it, nm in zip(self.itr_evals, self.eval_names):
+                    sys.stderr.write(self.net_trainer.evaluate(it, nm))
+                sys.stderr.write("\n")
+                sys.stderr.flush()
+            self._save_model()
+        if not self.silent:
+            print(f"\nupdating end, {int(time.time() - start)} sec in all")
+
+    def task_predict(self) -> None:
+        if self.itr_pred is None:
+            raise ValueError("must specify a pred iterator to generate predictions")
+        print("start predicting...")
+        with open(self.name_pred, "w", encoding="utf-8") as fo:
+            self.itr_pred.before_first()
+            while self.itr_pred.next():
+                batch = self.itr_pred.value()
+                preds = self.net_trainer.predict(batch)
+                n = batch.batch_size - batch.num_batch_padd
+                for v in preds[:n]:
+                    fo.write(f"{v:g}\n")
+        print(f"finished prediction, write into {self.name_pred}")
+
+    def task_extract(self) -> None:
+        if self.itr_pred is None:
+            raise ValueError("must specify a pred iterator for feature extraction")
+        if not self.extract_node_name:
+            raise ValueError("extract_node_name must be specified in task extract")
+        print("start predicting...")
+        nrow = 0
+        dshape = None
+        meta_path = self.name_pred + ".meta"
+        mode = "w" if self.output_format else "wb"
+        with open(self.name_pred, mode) as fo:
+            self.itr_pred.before_first()
+            while self.itr_pred.next():
+                batch = self.itr_pred.value()
+                feats = self.net_trainer.extract_feature(batch, self.extract_node_name)
+                n = batch.batch_size - batch.num_batch_padd
+                feats = feats[:n]
+                nrow += n
+                flat = feats.reshape(feats.shape[0], -1)
+                if self.output_format:
+                    for row in flat:
+                        fo.write(" ".join(f"{v:g}" for v in row) + " \n")
+                else:
+                    flat.astype("<f4").tofile(fo)
+                if n:
+                    dshape = feats.shape[1:]
+        with open(meta_path, "w", encoding="utf-8") as fm:
+            shp = list(dshape) if dshape else []
+            while len(shp) < 3:
+                shp.append(1)
+            fm.write(f"{nrow},{shp[0]},{shp[1]},{shp[2]}\n")
+        print(f"finished prediction, write into {self.name_pred}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    return LearnTask().run(argv)

@@ -1,0 +1,4 @@
+"""Composable data pipeline (iterator chains configured by ``iter = X``)."""
+
+from .batch import BatchAdaptIterator, DataInst, InstIterator  # noqa: F401
+from .data import DataBatch, DataIter, create_iterator  # noqa: F401

@@ -1,0 +1,73 @@
+"""Side-feature join iterator (``iter = attachtxt``).
+
+Parity: ``/root/reference/src/io/iter_attach_txt-inl.hpp`` — joins
+per-instance dense features from a text file into ``batch.extra_data``
+by instance id.  File format: each line ``inst_index v1 v2 ... vk``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .data import DataBatch, DataIter
+
+
+class AttachTxtIterator(DataIter):
+    def __init__(self, base: DataIter) -> None:
+        self.base = base
+        self.filename = ""
+        self.silent = 0
+        self._table: Dict[int, np.ndarray] = {}
+        self._width = 0
+        self._cur: Optional[DataBatch] = None
+
+    def set_param(self, name, val):
+        self.base.set_param(name, val)
+        if name in ("attach_file", "filename"):
+            self.filename = val
+        elif name == "silent":
+            self.silent = int(val)
+
+    def init(self):
+        self.base.init()
+        if not self.filename:
+            raise ValueError("AttachTxtIterator: must set attach_file")
+        with open(self.filename, "r", encoding="utf-8") as f:
+            for line in f:
+                toks = line.split()
+                if not toks:
+                    continue
+                self._table[int(float(toks[0]))] = np.asarray(
+                    [float(t) for t in toks[1:]], np.float32
+                )
+        self._width = len(next(iter(self._table.values()))) if self._table else 0
+        if not self.silent:
+            print(f"AttachTxtIterator: {len(self._table)} rows, width={self._width}")
+
+    def before_first(self):
+        self.base.before_first()
+
+    def next(self) -> bool:
+        if not self.base.next():
+            return False
+        b = self.base.value()
+        extra = np.zeros((b.batch_size, self._width), np.float32)
+        if b.inst_index is not None:
+            for i, idx in enumerate(b.inst_index):
+                row = self._table.get(int(idx))
+                if row is not None:
+                    extra[i] = row
+        self._cur = DataBatch(
+            data=b.data,
+            label=b.label,
+            inst_index=b.inst_index,
+            num_batch_padd=b.num_batch_padd,
+            extra_data=b.extra_data + [extra],
+        )
+        return True
+
+    def value(self) -> DataBatch:
+        assert self._cur is not None
+        return self._cur

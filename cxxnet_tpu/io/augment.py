@@ -1,0 +1,291 @@
+"""Per-instance augmentation: crop, mirror, mean subtraction, jitter, affine.
+
+Parity: ``/root/reference/src/io/iter_augment_proc-inl.hpp`` (crop /
+mirror / mean-image-or-value / contrast / illumination / scale, and the
+first-run mean-image computation cached to ``image_mean``) plus
+``/root/reference/src/io/image_augmenter-inl.hpp`` (rotation, shear,
+aspect-ratio and scale jitter folded into a single affine warp, random
+crop-size ranges, rotate lists).  The affine warp here uses PIL instead of
+OpenCV ``warpAffine``; the parameter names and ranges are identical.
+
+Channel-order note: the reference decodes with OpenCV (BGR) and parses
+``mean_value = b,g,r``; this framework stores RGB, and ``mean_value`` is
+applied in the file order to channels ``(2, 1, 0)`` so the same config
+subtracts the same per-channel values.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .batch import DataInst, InstIterator
+
+_RAND_MAGIC = 111
+
+
+class AugmentIterator(InstIterator):
+    def __init__(self, base: InstIterator) -> None:
+        self.base = base
+        self.shape = (0, 0, 0)           # (C,H,W) net convention
+        self.rand_crop = 0
+        self.rand_mirror = 0
+        self.mirror = 0
+        self.crop_y_start = -1
+        self.crop_x_start = -1
+        self.scale = 1.0
+        self.silent = 0
+        self.name_meanimg = ""
+        self.mean_value: Optional[np.ndarray] = None  # per-channel, RGB order
+        self.max_random_contrast = 0.0
+        self.max_random_illumination = 0.0
+        # affine params (image_augmenter)
+        self.max_rotate_angle = 0.0
+        self.max_shear_ratio = 0.0
+        self.max_aspect_ratio = 0.0
+        self.min_crop_size = -1
+        self.max_crop_size = -1
+        self.rotate = -1.0
+        self.rotate_list: List[int] = []
+        self.min_random_scale = 1.0
+        self.max_random_scale = 1.0
+        self.min_img_size = 0.0
+        self.max_img_size = 1e10
+        self.fill_value = 255
+        self._rng = np.random.RandomState(_RAND_MAGIC)
+        self._meanimg: Optional[np.ndarray] = None
+        self._out: Optional[DataInst] = None
+
+    def set_param(self, name, val):
+        self.base.set_param(name, val)
+        if name == "input_shape":
+            c, h, w = (int(t) for t in val.split(","))
+            self.shape = (c, h, w)
+        elif name == "seed_data":
+            self._rng = np.random.RandomState(_RAND_MAGIC + int(val))
+        elif name == "rand_crop":
+            self.rand_crop = int(val)
+        elif name == "rand_mirror":
+            self.rand_mirror = int(val)
+        elif name == "mirror":
+            self.mirror = int(val)
+        elif name == "crop_y_start":
+            self.crop_y_start = int(val)
+        elif name == "crop_x_start":
+            self.crop_x_start = int(val)
+        elif name == "divideby":
+            self.scale = 1.0 / float(val)
+        elif name == "scale":
+            self.scale = float(val)
+        elif name == "image_mean":
+            self.name_meanimg = val
+        elif name == "mean_value":
+            b, g, r = (float(t) for t in val.split(","))
+            self.mean_value = np.asarray([r, g, b], np.float32)  # RGB order
+        elif name == "max_random_contrast":
+            self.max_random_contrast = float(val)
+        elif name == "max_random_illumination":
+            self.max_random_illumination = float(val)
+        elif name == "max_rotate_angle":
+            self.max_rotate_angle = float(val)
+        elif name == "max_shear_ratio":
+            self.max_shear_ratio = float(val)
+        elif name == "max_aspect_ratio":
+            self.max_aspect_ratio = float(val)
+        elif name == "min_crop_size":
+            self.min_crop_size = int(val)
+        elif name == "max_crop_size":
+            self.max_crop_size = int(val)
+        elif name == "rotate":
+            self.rotate = float(val)
+        elif name == "rotate_list":
+            self.rotate_list = [int(t) for t in val.replace(",", " ").split()]
+        elif name == "min_random_scale":
+            self.min_random_scale = float(val)
+        elif name == "max_random_scale":
+            self.max_random_scale = float(val)
+        elif name == "min_img_size":
+            self.min_img_size = float(val)
+        elif name == "max_img_size":
+            self.max_img_size = float(val)
+        elif name == "fill_value":
+            self.fill_value = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+
+    # ------------------------------------------------------------------
+    def init(self):
+        self.base.init()
+        if self.name_meanimg:
+            if os.path.exists(self.name_meanimg):
+                with np.load(self.name_meanimg) as z:
+                    self._meanimg = z["mean"]
+                if not self.silent:
+                    print(f"loading mean image from {self.name_meanimg}")
+            else:
+                self._create_mean_img()
+
+    def _create_mean_img(self):
+        if not self.silent:
+            print(f"cannot find {self.name_meanimg}: creating mean image...")
+        total, cnt = None, 0
+        self.base.before_first()
+        while self.base.next():
+            d = self._augmented(self.base.value(), apply_mean=False)
+            total = d.data.astype(np.float64) if total is None else total + d.data
+            cnt += 1
+        if total is None:
+            raise ValueError("AugmentIterator: empty input, cannot build mean image")
+        self._meanimg = (total / cnt).astype(np.float32)
+        np.savez(self.name_meanimg, mean=self._meanimg)
+        if not self.silent:
+            print(f"saved mean image to {self.name_meanimg} ({cnt} images)")
+        self.base.before_first()
+
+    def before_first(self):
+        self.base.before_first()
+
+    def next(self) -> bool:
+        if not self.base.next():
+            return False
+        self._out = self._augmented(self.base.value(), apply_mean=True)
+        return True
+
+    def value(self) -> DataInst:
+        assert self._out is not None
+        return self._out
+
+    # ------------------------------------------------------------------
+    def _affine(self, img: np.ndarray) -> np.ndarray:
+        """Rotation/shear/scale/aspect as one warp (image_augmenter:75-123)."""
+        if (
+            self.max_rotate_angle <= 0
+            and self.max_shear_ratio <= 0
+            and self.max_aspect_ratio <= 0
+            and self.rotate < 0
+            and not self.rotate_list
+            and self.min_random_scale == 1.0
+            and self.max_random_scale == 1.0
+            and self.min_crop_size <= 0
+        ):
+            return img
+        from PIL import Image
+
+        rng = self._rng
+        angle = 0.0
+        if self.max_rotate_angle > 0:
+            angle = rng.uniform(-self.max_rotate_angle, self.max_rotate_angle)
+        if self.rotate > 0:
+            angle = self.rotate
+        if self.rotate_list:
+            angle = float(self.rotate_list[rng.randint(len(self.rotate_list))])
+        s = rng.uniform(-self.max_shear_ratio, self.max_shear_ratio) if self.max_shear_ratio > 0 else 0.0
+        scale = rng.uniform(self.min_random_scale, self.max_random_scale)
+        ratio = rng.uniform(-self.max_aspect_ratio, self.max_aspect_ratio) + 1.0 if self.max_aspect_ratio > 0 else 1.0
+        hs = 2.0 * scale / (1.0 + ratio)
+        ws = ratio * hs
+        a = math.cos(math.radians(angle))
+        b = math.sin(math.radians(angle))
+        h, w = img.shape[:2]
+        # forward warp matrix, exact parity with the reference
+        # (image_augmenter-inl.hpp:96-104): dst = M @ (src_x, src_y) + t,
+        # centered in a (new_w, new_h) = scale-clamped output canvas
+        m00 = hs * a - s * b * ws
+        m01 = hs * b + s * a * ws
+        m10 = -b * ws
+        m11 = a * ws
+        new_w = int(round(max(self.min_img_size, min(self.max_img_size, scale * w))))
+        new_h = int(round(max(self.min_img_size, min(self.max_img_size, scale * h))))
+        tx = (new_w - (m00 * w + m01 * h)) / 2.0
+        ty = (new_h - (m10 * w + m11 * h)) / 2.0
+        det = m00 * m11 - m01 * m10
+        if abs(det) < 1e-8:
+            return img
+        # PIL wants the inverse map (output coords → input coords)
+        i00, i01 = m11 / det, -m01 / det
+        i10, i11 = -m10 / det, m00 / det
+        coeffs = (
+            i00, i01, -(i00 * tx + i01 * ty),
+            i10, i11, -(i10 * tx + i11 * ty),
+        )
+        mode = "F" if img.ndim == 2 or img.shape[2] == 1 else "RGB"
+        if mode == "RGB":
+            pim = Image.fromarray(np.clip(img, 0, 255).astype(np.uint8), "RGB")
+        else:
+            pim = Image.fromarray(img.reshape(h, w).astype(np.float32), "F")
+        pim = pim.transform(
+            (new_w, new_h), Image.AFFINE, coeffs,
+            resample=Image.BILINEAR, fillcolor=self.fill_value,
+        )
+        out = np.asarray(pim, np.float32)
+        if out.ndim == 2:
+            out = out[..., None]
+        # random crop-size: crop a random square then resize back (bowl.conf)
+        if self.min_crop_size > 0 and self.max_crop_size >= self.min_crop_size:
+            cs = rng.randint(self.min_crop_size, self.max_crop_size + 1)
+            cs = min(cs, out.shape[0], out.shape[1])
+            yy = rng.randint(out.shape[0] - cs + 1)
+            xx = rng.randint(out.shape[1] - cs + 1)
+            patch = out[yy : yy + cs, xx : xx + cs]
+            if mode == "RGB":
+                pim2 = Image.fromarray(np.clip(patch, 0, 255).astype(np.uint8), "RGB")
+                pim2 = pim2.resize((w, h), Image.BILINEAR)
+                out = np.asarray(pim2, np.float32)
+            else:
+                pim2 = Image.fromarray(patch.reshape(cs, cs), "F").resize((w, h), Image.BILINEAR)
+                out = np.asarray(pim2, np.float32)[..., None]
+        return out
+
+    def _augmented(self, d: DataInst, *, apply_mean: bool) -> DataInst:
+        """SetData parity (iter_augment_proc-inl.hpp:98-162), HWC layout."""
+        c, th, tw = self.shape
+        data = d.data.astype(np.float32)
+        if c == 1 and th == 1:
+            return DataInst(d.index, data.reshape(-1) * self.scale, d.label)
+        if data.ndim == 2:
+            data = data[..., None]
+        data = self._affine(data)
+        rng = self._rng
+        h, w = data.shape[:2]
+        if h < th or w < tw:
+            raise ValueError("data size must be at least the net input size")
+        yy_max, xx_max = h - th, w - tw
+        if self.rand_crop and (yy_max or xx_max):
+            yy = rng.randint(yy_max + 1)
+            xx = rng.randint(xx_max + 1)
+        else:
+            yy, xx = yy_max // 2, xx_max // 2
+        if h != th and self.crop_y_start != -1:
+            yy = self.crop_y_start
+        if w != tw and self.crop_x_start != -1:
+            xx = self.crop_x_start
+        contrast = 1.0
+        illumination = 0.0
+        if self.max_random_contrast > 0:
+            contrast = rng.uniform(1 - self.max_random_contrast, 1 + self.max_random_contrast)
+        if self.max_random_illumination > 0:
+            illumination = rng.uniform(
+                -self.max_random_illumination, self.max_random_illumination
+            )
+        do_mirror = self.mirror == 1 or (self.rand_mirror and rng.rand() < 0.5)
+
+        if apply_mean and self.mean_value is not None:
+            data = data - self.mean_value[: data.shape[2]]
+            img = data[yy : yy + th, xx : xx + tw] * contrast + illumination
+        elif apply_mean and self._meanimg is not None:
+            if self._meanimg.shape == data.shape:
+                data = data - self._meanimg
+                img = data[yy : yy + th, xx : xx + tw] * contrast + illumination
+            else:
+                img = data[yy : yy + th, xx : xx + tw]
+                if self._meanimg.shape == img.shape:
+                    img = img - self._meanimg
+                img = img * contrast + illumination
+        else:
+            img = data[yy : yy + th, xx : xx + tw]
+        if do_mirror:
+            img = img[:, ::-1]
+        return DataInst(d.index, np.ascontiguousarray(img) * self.scale, d.label)

@@ -1,0 +1,158 @@
+"""Instance→batch collation with round-batch semantics.
+
+Parity: ``BatchAdaptIterator`` (``/root/reference/src/io/
+iter_batch_proc-inl.hpp:16-128``):
+
+* collates ``DataInst`` from the wrapped instance iterator into fixed
+  ``batch_size`` batches (static shapes — XLA requirement on TPU);
+* ``round_batch=1``: the short final batch wraps around to the dataset
+  head; ``num_batch_padd`` = number of wrapped instances; the *next*
+  epoch then continues from the wrap point instead of rewinding (the
+  reference's ``num_overflow_`` dance), so over epochs every instance is
+  seen equally often;
+* ``round_batch=0``: the short batch is emitted padded with whatever was
+  in the buffer, ``num_batch_padd`` = missing count;
+* ``test_skipread=1``: after the first batch, ``next()`` keeps returning
+  the same batch without touching the base iterator (decode-free IO
+  throughput measurement, SURVEY §4.2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from .data import DataBatch, DataIter
+
+
+@dataclasses.dataclass
+class DataInst:
+    """One instance (parity: ``DataInst``, data.h:42-56)."""
+
+    index: int
+    data: np.ndarray     # HWC image or flat vector
+    label: np.ndarray    # (label_width,)
+
+
+class InstIterator:
+    """Instance-level iterator protocol (``IIterator<DataInst>``)."""
+
+    def set_param(self, name: str, val: str) -> None:
+        pass
+
+    def init(self) -> None:
+        pass
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def next(self) -> bool:
+        raise NotImplementedError
+
+    def value(self) -> DataInst:
+        raise NotImplementedError
+
+
+class BatchAdaptIterator(DataIter):
+    def __init__(self, base: InstIterator) -> None:
+        self.base = base
+        self.batch_size = 0
+        self.label_width = 1
+        self.round_batch = 0
+        self.test_skipread = 0
+        self.silent = 0
+        self._shape: Optional[tuple] = None  # (C,H,W) net convention
+        self._num_overflow = 0
+        self._head = 1
+        self._out: Optional[DataBatch] = None
+
+    def set_param(self, name, val):
+        self.base.set_param(name, val)
+        if name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "label_width":
+            self.label_width = int(val)
+        elif name == "round_batch":
+            self.round_batch = int(val)
+        elif name == "test_skipread":
+            self.test_skipread = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "input_shape":
+            c, h, w = (int(t) for t in val.split(","))
+            self._shape = (c, h, w)
+
+    def init(self):
+        if self.batch_size <= 0:
+            raise ValueError("BatchAdaptIterator: batch_size must be set")
+        if self._shape is None:
+            raise ValueError("BatchAdaptIterator: input_shape must be set")
+        self.base.init()
+        c, h, w = self._shape
+        dshape = (
+            (self.batch_size, w) if (c == 1 and h == 1)
+            else (self.batch_size, h, w, c)
+        )
+        self._data = np.zeros(dshape, np.float32)
+        self._label = np.zeros((self.batch_size, self.label_width), np.float32)
+        self._inst = np.zeros(self.batch_size, np.uint32)
+
+    def before_first(self):
+        if self.round_batch == 0 or self._num_overflow == 0:
+            self.base.before_first()
+        else:
+            self._num_overflow = 0
+        self._head = 1
+
+    def _store(self, top: int, d: DataInst) -> None:
+        x = d.data
+        if self._data.ndim == 2:
+            x = x.reshape(-1)
+        self._data[top] = x
+        self._label[top] = np.asarray(d.label, np.float32).reshape(-1)[: self.label_width]
+        self._inst[top] = d.index
+
+    def next(self) -> bool:
+        if self.test_skipread and self._head == 0:
+            return True
+        self._head = 0
+        if self._num_overflow:
+            return False
+        padd = 0
+        top = 0
+        while self.base.next():
+            self._store(top, self.base.value())
+            top += 1
+            if top >= self.batch_size:
+                self._emit(0)
+                return True
+        if top != 0:
+            if self.round_batch:
+                self._num_overflow = 0
+                self.base.before_first()
+                while top < self.batch_size:
+                    if not self.base.next():
+                        raise ValueError("number of instances must exceed batch size")
+                    self._store(top, self.base.value())
+                    top += 1
+                    self._num_overflow += 1
+                padd = self._num_overflow
+            else:
+                padd = self.batch_size - top
+            self._emit(padd)
+            return True
+        return False
+
+    def _emit(self, padd: int) -> None:
+        self._out = DataBatch(
+            data=self._data.copy(),
+            label=self._label.copy(),
+            inst_index=self._inst.copy(),
+            num_batch_padd=padd,
+        )
+
+    def value(self) -> DataBatch:
+        assert self._head == 0 and self._out is not None, "call next() first"
+        return self._out

@@ -1,0 +1,76 @@
+"""CSV instance iterator.
+
+Parity: ``/root/reference/src/io/iter_csv-inl.hpp`` — each row is
+``label_width`` labels followed by ``prod(input_shape)`` dense features,
+comma-separated; ``has_header`` skips the first line.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .batch import DataInst, InstIterator
+
+
+class CSVIterator(InstIterator):
+    def __init__(self) -> None:
+        self.filename = ""
+        self.label_width = 1
+        self.has_header = 0
+        self.silent = 0
+        self.input_shape = (1, 1, 0)
+        self._rows: np.ndarray | None = None
+        self._pos = 0
+
+    def set_param(self, name, val):
+        if name == "filename":
+            self.filename = val
+        elif name == "label_width":
+            self.label_width = int(val)
+        elif name == "has_header":
+            self.has_header = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "input_shape":
+            c, h, w = (int(t) for t in val.split(","))
+            self.input_shape = (c, h, w)
+
+    def init(self):
+        nfeat = self.input_shape[0] * self.input_shape[1] * self.input_shape[2]
+        if nfeat <= 0:
+            raise ValueError("CSVIterator: input_shape must be set")
+        rows = np.loadtxt(
+            self.filename,
+            delimiter=",",
+            skiprows=1 if self.has_header else 0,
+            dtype=np.float32,
+            ndmin=2,
+        )
+        want = self.label_width + nfeat
+        if rows.shape[1] != want:
+            raise ValueError(
+                f"CSVIterator: row has {rows.shape[1]} columns, expected "
+                f"{want} (label_width + input size)"
+            )
+        self._rows = rows
+        if not self.silent:
+            print(f"CSVIterator: filename={self.filename}, {len(rows)} rows")
+
+    def before_first(self):
+        self._pos = 0
+
+    def next(self) -> bool:
+        assert self._rows is not None, "init() not called"
+        if self._pos < len(self._rows):
+            self._pos += 1
+            return True
+        return False
+
+    def value(self) -> DataInst:
+        row = self._rows[self._pos - 1]
+        c, h, w = self.input_shape
+        feats = row[self.label_width:]
+        data = feats.reshape(-1) if (c == 1 and h == 1) else feats.reshape(c, h, w).transpose(1, 2, 0)
+        return DataInst(
+            index=self._pos - 1, data=data, label=row[: self.label_width]
+        )

@@ -1,0 +1,124 @@
+"""Data pipeline core: DataBatch, iterator protocol, chain factory.
+
+Parity: ``/root/reference/src/io/data.h`` (``DataInst``/``DataBatch`` with
+``num_batch_padd`` for short final batches, ``extra_data`` side inputs) and
+``/root/reference/src/io/data.cpp:24-82`` (the ordered ``iter = X`` chain
+factory: base iterators at the bottom, ``threadbuffer``/``membuffer``/
+``attachtxt`` wrap the iterator below them; params following an ``iter=``
+line configure the current top of the chain, which forwards them down).
+
+Layout note: batches are NHWC (or flat ``(N, D)``) numpy float32 — the
+TPU-native transposition of the reference's NCHW batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+ConfigEntry = Tuple[str, str]
+
+
+@dataclasses.dataclass
+class DataBatch:
+    """One mini-batch. ``num_batch_padd`` trailing instances are padding
+    (replicated data to keep shapes static) and must be excluded from
+    evaluation/prediction output (data.h:86-88)."""
+
+    data: np.ndarray                  # (N, H, W, C) or (N, D)
+    label: np.ndarray                 # (N, label_width) float32
+    inst_index: Optional[np.ndarray] = None
+    num_batch_padd: int = 0
+    extra_data: List[np.ndarray] = dataclasses.field(default_factory=list)
+
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+
+class DataIter:
+    """Iterator protocol (parity: ``IIterator``, data.h:19-39)."""
+
+    def set_param(self, name: str, val: str) -> None:  # noqa: D401
+        pass
+
+    def init(self) -> None:
+        pass
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def next(self) -> bool:
+        raise NotImplementedError
+
+    def value(self) -> DataBatch:
+        raise NotImplementedError
+
+    # python sugar
+    def __iter__(self):
+        self.before_first()
+        while self.next():
+            yield self.value()
+
+
+def create_iterator(cfg: Sequence[ConfigEntry]) -> DataIter:
+    """Build an iterator chain from an ordered config section."""
+    # imports here to avoid cycles
+    from .augment import AugmentIterator
+    from .batch import BatchAdaptIterator
+    from .csv import CSVIterator
+    from .img import ImageIterator
+    from .imgbin import ImageBinIterator
+    from .membuffer import MemBufferIterator
+    from .mnist import MNISTIterator
+    from .prefetch import ThreadBufferIterator
+    from .synth import SyntheticIterator
+    from .attach_txt import AttachTxtIterator
+
+    it: Optional[DataIter] = None
+    for name, val in cfg:
+        if name == "iter":
+            if val == "mnist":
+                if it is not None:
+                    raise ValueError("mnist cannot chain over another iterator")
+                it = MNISTIterator()
+            elif val in ("imgbin", "imgbinx"):
+                if it is not None:
+                    raise ValueError("imgbin cannot chain over another iterator")
+                it = BatchAdaptIterator(AugmentIterator(ImageBinIterator()))
+            elif val == "img":
+                if it is not None:
+                    raise ValueError("img cannot chain over another iterator")
+                it = BatchAdaptIterator(AugmentIterator(ImageIterator()))
+            elif val == "csv":
+                if it is not None:
+                    raise ValueError("csv cannot chain over another iterator")
+                it = BatchAdaptIterator(CSVIterator())
+            elif val == "synthetic":
+                if it is not None:
+                    raise ValueError("synthetic cannot chain over another iterator")
+                it = SyntheticIterator()
+            elif val == "threadbuffer":
+                if it is None:
+                    raise ValueError("must specify input of threadbuffer")
+                it = ThreadBufferIterator(it)
+            elif val == "membuffer":
+                if it is None:
+                    raise ValueError("must specify input of membuffer")
+                it = MemBufferIterator(it)
+            elif val == "attachtxt":
+                if it is None:
+                    raise ValueError("must specify input of attachtxt")
+                it = AttachTxtIterator(it)
+            elif val == "end":
+                continue
+            else:
+                raise ValueError(f"unknown iterator type {val!r}")
+            continue
+        if it is not None:
+            it.set_param(name, val)
+    if it is None:
+        raise ValueError("must specify iterator by iter=itername")
+    return it

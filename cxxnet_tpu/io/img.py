@@ -1,0 +1,61 @@
+"""Individual-image-file iterator (``iter = img``).
+
+Parity: ``/root/reference/src/io/iter_img-inl.hpp`` — reads a ``.lst``
+file (``index \\t labels \\t filename``) and loads each image from
+``image_root + filename`` (PIL instead of OpenCV; RGB HWC float 0..255).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .batch import DataInst, InstIterator
+from .imgbin import parse_lst_line
+
+
+class ImageIterator(InstIterator):
+    def __init__(self) -> None:
+        self.image_list = ""
+        self.image_root = ""
+        self.silent = 0
+        self._recs: List[Tuple[int, np.ndarray, str]] = []
+        self._pos = 0
+        self._out: Optional[DataInst] = None
+
+    def set_param(self, name, val):
+        if name == "image_list":
+            self.image_list = val
+        elif name == "image_root":
+            self.image_root = val
+        elif name == "silent":
+            self.silent = int(val)
+
+    def init(self):
+        if not self.image_list:
+            raise ValueError("ImageIterator: must set image_list")
+        with open(self.image_list, "r", encoding="utf-8") as f:
+            self._recs = [parse_lst_line(l) for l in f if l.strip()]
+        if not self.silent:
+            print(f"ImageIterator: {len(self._recs)} images from {self.image_list}")
+
+    def before_first(self):
+        self._pos = 0
+
+    def next(self) -> bool:
+        if self._pos >= len(self._recs):
+            return False
+        from PIL import Image
+
+        idx, labels, fname = self._recs[self._pos]
+        self._pos += 1
+        img = Image.open(self.image_root + fname)
+        if img.mode != "RGB":
+            img = img.convert("RGB")
+        self._out = DataInst(idx, np.asarray(img, np.float32), labels)
+        return True
+
+    def value(self) -> DataInst:
+        assert self._out is not None
+        return self._out

@@ -1,0 +1,52 @@
+"""In-RAM batch cache (``iter = membuffer``).
+
+Parity: ``/root/reference/src/io/iter_mem_buffer-inl.hpp`` — caches the
+first ``max_nbatch`` batches of the wrapped iterator and replays them;
+used for small-sample overfit smoke tests (SURVEY §4.5).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .data import DataBatch, DataIter
+
+
+class MemBufferIterator(DataIter):
+    def __init__(self, base: DataIter) -> None:
+        self.base = base
+        self.max_nbatch = 0  # 0 = cache everything
+        self.silent = 0
+        self._cache: List[DataBatch] = []
+        self._filled = False
+        self._pos = 0
+
+    def set_param(self, name, val):
+        self.base.set_param(name, val)
+        if name == "max_nbatch":
+            self.max_nbatch = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+
+    def init(self):
+        self.base.init()
+        self.base.before_first()
+        while self.base.next():
+            self._cache.append(self.base.value())
+            if self.max_nbatch and len(self._cache) >= self.max_nbatch:
+                break
+        self._filled = True
+        if not self.silent:
+            print(f"MemBufferIterator: cached {len(self._cache)} batches")
+
+    def before_first(self):
+        self._pos = 0
+
+    def next(self) -> bool:
+        if self._pos < len(self._cache):
+            self._pos += 1
+            return True
+        return False
+
+    def value(self) -> DataBatch:
+        return self._cache[self._pos - 1]

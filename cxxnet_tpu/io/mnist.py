@@ -1,0 +1,117 @@
+"""MNIST idx-format iterator.
+
+Parity: ``/root/reference/src/io/iter_mnist-inl.hpp`` — loads the idx
+images/labels into RAM, scales pixels by 1/256, optional one-shot shuffle
+(``shuffle``, ``seed_data``), ``input_flat`` chooses flat vs image nodes,
+``index_offset``; the final partial batch is dropped.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from .data import DataBatch, DataIter
+
+
+def read_idx_images(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        magic, count, rows, cols = struct.unpack(">iiii", f.read(16))
+        buf = f.read(count * rows * cols)
+    return np.frombuffer(buf, np.uint8).reshape(count, rows, cols)
+
+
+def read_idx_labels(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        magic, count = struct.unpack(">ii", f.read(8))
+        buf = f.read(count)
+    return np.frombuffer(buf, np.uint8)
+
+
+def write_idx_images(path: str, imgs: np.ndarray) -> None:
+    """idx3 writer (for tools/tests; the reference ships data externally)."""
+    n, r, c = imgs.shape
+    with open(path, "wb") as f:
+        f.write(struct.pack(">iiii", 0x803, n, r, c))
+        f.write(imgs.astype(np.uint8).tobytes())
+
+
+def write_idx_labels(path: str, labels: np.ndarray) -> None:
+    with open(path, "wb") as f:
+        f.write(struct.pack(">ii", 0x801, len(labels)))
+        f.write(labels.astype(np.uint8).tobytes())
+
+
+class MNISTIterator(DataIter):
+    def __init__(self) -> None:
+        self.batch_size = 0
+        self.input_flat = 1
+        self.shuffle = 0
+        self.index_offset = 0
+        self.silent = 0
+        self.path_img = ""
+        self.path_label = ""
+        self.seed = 0
+        self._loc = 0
+        self._img: np.ndarray | None = None
+        self._label: np.ndarray | None = None
+        self._inst: np.ndarray | None = None
+
+    def set_param(self, name, val):
+        if name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "input_flat":
+            self.input_flat = int(val)
+        elif name == "shuffle":
+            self.shuffle = int(val)
+        elif name == "index_offset":
+            self.index_offset = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "path_img":
+            self.path_img = val
+        elif name == "path_label":
+            self.path_label = val
+        elif name == "seed_data":
+            self.seed = int(val)
+
+    def init(self):
+        imgs = read_idx_images(self.path_img).astype(np.float32) / 256.0
+        labels = read_idx_labels(self.path_label).astype(np.float32)
+        if self.batch_size <= 0:
+            raise ValueError("MNISTIterator: batch_size must be set")
+        inst = np.arange(len(labels), dtype=np.uint32) + self.index_offset
+        if self.shuffle:
+            rng = np.random.RandomState(42 + self.seed)
+            perm = rng.permutation(len(labels))
+            imgs, labels, inst = imgs[perm], labels[perm], inst[perm]
+        if self.input_flat:
+            self._img = imgs.reshape(len(labels), -1)
+        else:
+            self._img = imgs[..., None]  # NHWC with C=1
+        self._label = labels[:, None]
+        self._inst = inst
+        if not self.silent:
+            print(
+                f"MNISTIterator: load {len(labels)} images, "
+                f"shuffle={self.shuffle}, shape={self._img.shape}"
+            )
+
+    def before_first(self):
+        self._loc = 0
+
+    def next(self) -> bool:
+        assert self._img is not None, "init() not called"
+        if self._loc + self.batch_size <= self._img.shape[0]:
+            self._loc += self.batch_size
+            return True
+        return False
+
+    def value(self) -> DataBatch:
+        lo, hi = self._loc - self.batch_size, self._loc
+        return DataBatch(
+            data=self._img[lo:hi],
+            label=self._label[lo:hi],
+            inst_index=self._inst[lo:hi],
+        )

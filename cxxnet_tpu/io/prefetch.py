@@ -1,0 +1,116 @@
+"""Threaded batch prefetch (``iter = threadbuffer``).
+
+Parity: ``ThreadBufferIterator`` (``/root/reference/src/io/
+iter_batch_proc-inl.hpp:131-219``) over the generic double-buffer
+(``/root/reference/src/utils/thread_buffer.h``): a producer thread pulls
+batches from the wrapped iterator into a bounded queue so host-side
+decode/augment overlaps with device compute — the classic input-pipeline
+overlap that feeds the TPU.
+
+Epoch restarts are handled with a generation counter: ``before_first``
+bumps the generation; the producer re-reads it between items and restarts
+the wrapped iterator; the consumer discards queue entries from stale
+generations.  This replaces the reference's semaphore handshake with an
+equivalent that cannot deadlock on mid-epoch rewinds.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+from .data import DataBatch, DataIter
+
+_END = object()
+
+
+class ThreadBufferIterator(DataIter):
+    def __init__(self, base: DataIter) -> None:
+        self.base = base
+        self.buffer_size = 2
+        self.silent = 0
+        self._q: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._cur: Optional[DataBatch] = None
+        self._gen = 0                      # consumer's current epoch
+        self._gen_lock = threading.Condition()
+        self._stop = False
+
+    def set_param(self, name, val):
+        self.base.set_param(name, val)
+        if name == "buffer_size":
+            self.buffer_size = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+
+    def init(self):
+        self.base.init()
+        self._q = queue.Queue(maxsize=self.buffer_size)
+        self._gen = 0
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+        if not self.silent:
+            print(f"ThreadBufferIterator: buffer_size={self.buffer_size}")
+
+    # ------------------------------------------------------------------
+    def _producer(self):
+        served = -1  # last generation fully produced
+        while True:
+            with self._gen_lock:
+                while not self._stop and self._gen <= served:
+                    self._gen_lock.wait(timeout=0.5)
+                if self._stop:
+                    return
+                gen = self._gen
+            self.base.before_first()
+            while True:
+                with self._gen_lock:
+                    if self._stop:
+                        return
+                    if self._gen != gen:
+                        break  # consumer rewound; restart epoch
+                if not self.base.next():
+                    self._put((gen, _END))
+                    break
+                self._put((gen, self.base.value()))
+            served = gen
+
+    def _put(self, item) -> None:
+        # bounded put that aborts if the consumer rewound or stopped
+        gen = item[0]
+        while True:
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                with self._gen_lock:
+                    if self._stop or self._gen != gen:
+                        return
+
+    # ------------------------------------------------------------------
+    def before_first(self):
+        assert self._q is not None, "init() not called"
+        with self._gen_lock:
+            self._gen += 1
+            self._gen_lock.notify_all()
+
+    def next(self) -> bool:
+        assert self._q is not None, "init() not called"
+        while True:
+            gen, item = self._q.get()
+            if gen != self._gen:
+                continue  # stale epoch
+            if item is _END:
+                return False
+            self._cur = item
+            return True
+
+    def value(self) -> DataBatch:
+        assert self._cur is not None
+        return self._cur
+
+    def close(self):
+        with self._gen_lock:
+            self._stop = True
+            self._gen_lock.notify_all()

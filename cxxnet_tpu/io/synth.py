@@ -1,0 +1,86 @@
+"""Synthetic data iterator (framework extension, not in the reference).
+
+Generates a deterministic random dataset in RAM — the benchmark/test
+stand-in for datasets that are not shipped (the reference assumes you
+downloaded MNIST/ImageNet).  The labels are drawn from a fixed linear
+teacher over the inputs so that models can actually *learn* from it in
+overfit tests.
+
+Config keys::
+
+    nsample      number of instances (default 512)
+    input_shape  C,H,W (same convention as the net config)
+    nclass       number of classes (default 10)
+    label_width  label columns (default 1; class id in column 0)
+    batch_size   required
+    seed_data    RNG seed
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .data import DataBatch, DataIter
+
+
+class SyntheticIterator(DataIter):
+    def __init__(self) -> None:
+        self.nsample = 512
+        self.input_shape = (1, 1, 16)
+        self.nclass = 10
+        self.label_width = 1
+        self.batch_size = 0
+        self.seed = 0
+        self._loc = 0
+        self._data: np.ndarray | None = None
+        self._label: np.ndarray | None = None
+
+    def set_param(self, name, val):
+        if name == "nsample":
+            self.nsample = int(val)
+        elif name == "input_shape":
+            z, y, x = (int(t) for t in val.split(","))
+            self.input_shape = (z, y, x)
+        elif name == "nclass":
+            self.nclass = int(val)
+        elif name == "label_width":
+            self.label_width = int(val)
+        elif name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "seed_data":
+            self.seed = int(val)
+
+    def init(self):
+        if self.batch_size <= 0:
+            raise ValueError("SyntheticIterator: batch_size must be set")
+        rng = np.random.RandomState(1234 + self.seed)
+        c, h, w = self.input_shape
+        if c == 1 and h == 1:
+            shape = (self.nsample, w)
+        else:
+            shape = (self.nsample, h, w, c)
+        self._data = rng.randn(*shape).astype(np.float32)
+        flat = self._data.reshape(self.nsample, -1)
+        teacher = rng.randn(flat.shape[1], self.nclass).astype(np.float32)
+        cls = (flat @ teacher).argmax(-1).astype(np.float32)
+        lab = np.zeros((self.nsample, self.label_width), np.float32)
+        lab[:, 0] = cls
+        self._label = lab
+
+    def before_first(self):
+        self._loc = 0
+
+    def next(self) -> bool:
+        assert self._data is not None, "init() not called"
+        if self._loc + self.batch_size <= self.nsample:
+            self._loc += self.batch_size
+            return True
+        return False
+
+    def value(self) -> DataBatch:
+        lo, hi = self._loc - self.batch_size, self._loc
+        return DataBatch(
+            data=self._data[lo:hi],
+            label=self._label[lo:hi],
+            inst_index=np.arange(lo, hi, dtype=np.uint32),
+        )

@@ -1,0 +1,4 @@
+"""Trainer orchestration: graph parsing, functional net, trainer."""
+
+from .graph import LayerSpec, NetGraph  # noqa: F401
+from .net import FunctionalNet  # noqa: F401

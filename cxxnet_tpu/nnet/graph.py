@@ -1,0 +1,283 @@
+"""Net structure configuration: the ``netconfig=start..end`` graph parser.
+
+Parity: ``/root/reference/src/nnet/nnet_config.h`` —
+
+* ``layer[src->dst] = type:name`` with comma-separated node lists
+* ``layer[+1]`` (new anonymous node after the top), ``layer[+1:tag]``
+  (new named node), ``layer[+0]`` (self-loop: out node == in node)
+* node ``0`` is the input, named ``in`` (also addressable as ``0``);
+  ``extra_data_num`` adds ``in_1..in_k`` side-input nodes
+* ``shared[tag]`` layers reuse the params of the earlier layer named
+  ``tag`` (``primary_layer_index``)
+* keys following a ``layer[...]`` line bind to that layer; keys outside
+  netconfig are global defaults applied to every layer first
+  (``neural_net-inl.hpp:252-264`` applies defcfg, then layercfg)
+* ``label_vec[a,b) = name`` declares named label fields over column
+  ranges of the batch label matrix (``nnet_config.h:192-203``); field
+  ``label`` = column 0 by default.
+
+The parsed structure is serialized as JSON inside the model checkpoint
+(the reference writes a binary blob, ``SaveNet``/``LoadNet``
+``nnet_config.h:126-191``); JSON keeps the same information.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+ConfigEntry = Tuple[str, str]
+
+_LABEL_VEC_RE = re.compile(r"label_vec\[(\d+),(\d+)\)")
+
+
+@dataclasses.dataclass
+class LayerSpec:
+    type_name: str                 # config layer type ("conv", "shared", ...)
+    name: str                      # optional tag ("" if anonymous)
+    primary: int                   # primary layer index if shared, else -1
+    nindex_in: List[int]
+    nindex_out: List[int]
+
+    @property
+    def is_self_loop(self) -> bool:
+        return self.nindex_in == self.nindex_out
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "LayerSpec":
+        return LayerSpec(**d)
+
+
+class NetGraph:
+    """Parsed network structure + per-layer / global config streams."""
+
+    def __init__(self) -> None:
+        self.node_names: List[str] = []
+        self.node_name_map: Dict[str, int] = {}
+        self.layers: List[LayerSpec] = []
+        self.layer_name_map: Dict[str, int] = {}
+        self.layercfg: List[List[ConfigEntry]] = []
+        self.defcfg: List[ConfigEntry] = []
+        self.input_shape: Tuple[int, int, int] = (0, 0, 0)  # (C, H, W)
+        self.extra_data_num = 0
+        self.extra_shape: List[Tuple[int, int, int]] = []
+        self.updater_type = "sgd"
+        # label fields: name -> index into label_range
+        self.label_name_map: Dict[str, int] = {"label": 0}
+        self.label_range: List[Tuple[int, int]] = [(0, 1)]
+        self._initialized = False
+
+    # ------------------------------------------------------------------
+    def configure(self, cfg: Sequence[ConfigEntry]) -> "NetGraph":
+        """Parse an ordered global config stream (nnet_config.h:207-289).
+
+        May be called again on a loaded structure: the layer lines are then
+        validated against the stored graph instead of re-creating it.
+        """
+        self.defcfg = []
+        self.layercfg = [[] for _ in self.layers]
+        if not self.node_names:
+            self._add_node("in")
+        self.node_name_map.setdefault("0", 0)
+
+        netcfg_mode = 0      # 0 outside, 1 inside netconfig, 2 after a layer line
+        cfg_top_node = 0
+        cfg_layer_index = 0
+
+        for name, val in cfg:
+            if name == "extra_data_num":
+                num = int(val)
+                for i in range(num):
+                    nm = f"in_{i + 1}"
+                    if nm not in self.node_name_map:
+                        self._add_node(nm)
+                self.extra_data_num = num
+            if name.startswith("extra_data_shape["):
+                x, y, z = (int(t) for t in val.split(","))
+                self.extra_shape.append((x, y, z))
+            if not self._initialized and name == "input_shape":
+                parts = val.split(",")
+                if len(parts) != 3:
+                    raise ValueError(
+                        "input_shape must be three comma-separated ints, e.g. 1,1,200"
+                    )
+                z, y, x = (int(p) for p in parts)
+                self.input_shape = (z, y, x)
+            if netcfg_mode != 2:
+                self._set_global_param(name, val)
+            if name == "netconfig" and val == "start":
+                netcfg_mode = 1
+            if name == "netconfig" and val == "end":
+                netcfg_mode = 0
+            if name.startswith("layer["):
+                info = self._parse_layer_line(name, val, cfg_top_node, cfg_layer_index)
+                netcfg_mode = 2
+                if not self._initialized:
+                    assert len(self.layers) == cfg_layer_index, "NetGraph inconsistent"
+                    self.layers.append(info)
+                    self.layercfg.append([])
+                else:
+                    if cfg_layer_index >= len(self.layers):
+                        raise ValueError("config layer index exceeds stored structure")
+                    if self.layers[cfg_layer_index] != info:
+                        raise ValueError(
+                            "config does not match existing network structure: "
+                            f"layer {cfg_layer_index} is {self.layers[cfg_layer_index]}, "
+                            f"config says {info}"
+                        )
+                cfg_top_node = (
+                    info.nindex_out[0] if len(info.nindex_out) == 1 else -1
+                )
+                cfg_layer_index += 1
+                continue
+            if netcfg_mode == 2:
+                if self.layers[cfg_layer_index - 1].type_name == "shared":
+                    raise ValueError(
+                        "do not set parameters on a shared layer; set them on the primary"
+                    )
+                self.layercfg[cfg_layer_index - 1].append((name, val))
+            else:
+                self.defcfg.append((name, val))
+        self._initialized = True
+        return self
+
+    # ------------------------------------------------------------------
+    def _add_node(self, name: str) -> int:
+        idx = len(self.node_names)
+        self.node_names.append(name)
+        self.node_name_map[name] = idx
+        return idx
+
+    def _get_node(self, name: str, alloc_unknown: bool) -> int:
+        if name in self.node_name_map:
+            return self.node_name_map[name]
+        if not alloc_unknown:
+            raise ValueError(
+                f"undefined node name {name!r}: a layer's input must be the "
+                f"output of an earlier layer"
+            )
+        return self._add_node(name)
+
+    def _set_global_param(self, name: str, val: str) -> None:
+        if name == "updater":
+            self.updater_type = val
+        m = _LABEL_VEC_RE.fullmatch(name)
+        if m:
+            a, b = int(m.group(1)), int(m.group(2))
+            self.label_range.append((a, b))
+            self.label_name_map[val] = len(self.label_range) - 1
+
+    def _parse_layer_line(
+        self, name: str, val: str, top_node: int, cfg_layer_index: int
+    ) -> LayerSpec:
+        """Parse ``layer[...] = type[:tag]`` (nnet_config.h:303-360)."""
+        body = name[len("layer["):]
+        if not body.endswith("]"):
+            raise ValueError(f"invalid layer format {name!r}")
+        body = body[:-1]
+        nindex_in: List[int] = []
+        nindex_out: List[int] = []
+        if body.startswith("+"):
+            # layer[+k] / layer[+1:tag]
+            if top_node < 0:
+                raise ValueError(
+                    "layer[+k] used after a layer with multiple outputs; "
+                    "use layer[in->out] instead"
+                )
+            if ":" in body:
+                inc_s, tag = body.split(":", 1)
+                inc = int(inc_s[1:])
+                nindex_in.append(top_node)
+                nindex_out.append(self._get_node(tag, True))
+            else:
+                inc = int(body[1:])
+                nindex_in.append(top_node)
+                if inc == 0:
+                    nindex_out.append(top_node)  # self-loop
+                else:
+                    nindex_out.append(self._get_node(f"!node-after-{top_node}", True))
+        elif "->" in body:
+            src, dst = body.split("->", 1)
+            for t in src.split(","):
+                nindex_in.append(self._get_node(t, False))
+            for t in dst.split(","):
+                nindex_out.append(self._get_node(t, True))
+        else:
+            raise ValueError(f"invalid layer format {name!r}")
+
+        # value: "type" or "type:tag"
+        if ":" in val:
+            ltype, tag = val.split(":", 1)
+        else:
+            ltype, tag = val, ""
+        spec = LayerSpec(ltype, "", -1, nindex_in, nindex_out)
+        if ltype.startswith("share"):
+            m = re.match(r"share[a-z]*\[([^\]]+)\]", ltype)
+            if not m:
+                raise ValueError(
+                    "shared layer must specify the tag of the layer to share: shared[tag]"
+                )
+            s_tag = m.group(1)
+            if s_tag not in self.layer_name_map:
+                raise ValueError(f"shared layer tag {s_tag!r} not defined before")
+            spec.type_name = "shared"
+            spec.primary = self.layer_name_map[s_tag]
+        elif tag:
+            if tag in self.layer_name_map:
+                if self.layer_name_map[tag] != cfg_layer_index:
+                    raise ValueError(
+                        f"layer name {tag!r} does not match the stored structure"
+                    )
+            else:
+                self.layer_name_map[tag] = cfg_layer_index
+            spec.name = tag
+        return spec
+
+    # ------------------------------------------------------------------
+    @property
+    def num_nodes(self) -> int:
+        return len(self.node_names)
+
+    def layer_index_of(self, name: str) -> int:
+        if name not in self.layer_name_map:
+            raise ValueError(f"unknown layer name {name!r}")
+        return self.layer_name_map[name]
+
+    def node_index_of(self, name: str) -> int:
+        if name not in self.node_name_map:
+            raise ValueError(f"unknown node name {name!r}")
+        return self.node_name_map[name]
+
+    # --- structure (de)serialization ----------------------------------
+    def structure_to_json(self) -> str:
+        return json.dumps(
+            {
+                "input_shape": list(self.input_shape),
+                "extra_data_num": self.extra_data_num,
+                "extra_shape": [list(s) for s in self.extra_shape],
+                "node_names": self.node_names,
+                "layers": [l.to_json() for l in self.layers],
+            }
+        )
+
+    @classmethod
+    def structure_from_json(cls, s: str) -> "NetGraph":
+        d = json.loads(s)
+        g = cls()
+        g.input_shape = tuple(d["input_shape"])
+        g.extra_data_num = d["extra_data_num"]
+        g.extra_shape = [tuple(x) for x in d["extra_shape"]]
+        for nm in d["node_names"]:
+            g._add_node(nm)
+        g.layers = [LayerSpec.from_json(x) for x in d["layers"]]
+        g.layercfg = [[] for _ in g.layers]
+        for i, l in enumerate(g.layers):
+            if l.name:
+                g.layer_name_map[l.name] = i
+        g._initialized = True
+        return g

@@ -1,0 +1,228 @@
+"""FunctionalNet: a parsed NetGraph compiled into pure JAX functions.
+
+This replaces the reference's mutable ``NeuralNet`` engine
+(``/root/reference/src/nnet/neural_net-inl.hpp``): instead of nodes that
+double as activation/gradient storage and per-layer hand-written backprop,
+the graph is executed as one pure function and ``jax.grad`` differentiates
+the summed loss.  XLA sees the whole step and fuses across layer
+boundaries — the TPU analog of mshadow's expression fusing, but global.
+
+Semantics preserved:
+
+* node 0 is the input; ``input_shape = C,H,W`` maps to a flat ``(N, W)``
+  node when ``C == H == 1`` else an NHWC image node (the reference is
+  NCHW; layout is the TPU-native transposition of the same data).
+* layers are configured with the global defaults first, then their own
+  section (``neural_net-inl.hpp:252-264``).
+* self-loop loss layers transform their node in place (downstream sees
+  probabilities) and contribute ``grad_scale / (batch_size *
+  update_period) * L`` to the total loss
+  (``loss_layer_base-inl.hpp:60-63``).
+* shared layers reuse the primary layer's parameters.
+* label fields: the batch label matrix is sliced by the ``label_vec[a,b)``
+  ranges; each loss layer reads its ``target`` field.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..layers import Layer, LossLayer, create_layer
+from ..layers.structure import SplitLayer
+from .graph import NetGraph
+
+ConfigEntry = Tuple[str, str]
+
+
+class FunctionalNet:
+    """Executable form of a NetGraph."""
+
+    def __init__(self, graph: NetGraph) -> None:
+        self.graph = graph
+        self.batch_size = 0
+        self.update_period = 1
+        # instantiate layers (shared layers alias the primary instance)
+        self.layer_objs: List[Layer] = []
+        self.param_key: List[Optional[str]] = []  # params pytree key per layer
+        for i, spec in enumerate(graph.layers):
+            if spec.type_name == "shared":
+                primary = self.layer_objs[spec.primary]
+                self.layer_objs.append(primary)
+                self.param_key.append(self.param_key[spec.primary])
+                continue
+            lay = create_layer(spec.type_name)
+            if isinstance(lay, SplitLayer):
+                lay.n_split = len(spec.nindex_out)
+            self.layer_objs.append(lay)
+            tag = spec.name if spec.name else spec.type_name
+            self.param_key.append(f"l{i}_{tag}")
+        self._configure_layers()
+        self.node_shapes: List[Optional[Tuple[int, ...]]] = []
+
+    # ------------------------------------------------------------------
+    def _configure_layers(self) -> None:
+        g = self.graph
+        for name, val in g.defcfg:
+            if name == "batch_size":
+                self.batch_size = int(val)
+            elif name == "update_period":
+                self.update_period = int(val)
+        for i, spec in enumerate(g.layers):
+            if spec.type_name == "shared":
+                continue
+            lay = self.layer_objs[i]
+            for name, val in g.defcfg:
+                self._safe_set(lay, name, val)
+            for name, val in g.layercfg[i]:
+                self._safe_set(lay, name, val)
+
+    @staticmethod
+    def _safe_set(lay: Layer, name: str, val: str) -> None:
+        """Global defaults may contain keys a given layer can't parse
+        (e.g. ``dev``); layer set_param ignores unknown keys by design,
+        but value errors for *known* keys must propagate."""
+        try:
+            lay.set_param(name, val)
+        except ValueError:
+            raise
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    def input_node_shape(self, batch_size: int) -> Tuple[int, ...]:
+        c, h, w = self.graph.input_shape
+        if c == 1 and h == 1:
+            return (batch_size, w)
+        return (batch_size, h, w, c)
+
+    def extra_node_shape(self, k: int, batch_size: int) -> Tuple[int, ...]:
+        c, h, w = self.graph.extra_shape[k]
+        if c == 1 and h == 1:
+            return (batch_size, w)
+        return (batch_size, h, w, c)
+
+    def infer_shapes(self, batch_size: int) -> List[Tuple[int, ...]]:
+        """Run shape inference over the DAG; returns per-node shapes."""
+        g = self.graph
+        shapes: List[Optional[Tuple[int, ...]]] = [None] * g.num_nodes
+        shapes[0] = self.input_node_shape(batch_size)
+        for k in range(g.extra_data_num):
+            shapes[k + 1] = self.extra_node_shape(k, batch_size)
+        for i, spec in enumerate(g.layers):
+            lay = self.layer_objs[i]
+            in_shapes = []
+            for n in spec.nindex_in:
+                if shapes[n] is None:
+                    raise ValueError(
+                        f"layer {i} ({spec.type_name}) input node "
+                        f"{g.node_names[n]!r} has no shape yet"
+                    )
+                in_shapes.append(shapes[n])
+            out_shapes = lay.infer_shape(in_shapes)
+            if len(out_shapes) != len(spec.nindex_out):
+                raise ValueError(
+                    f"layer {i} ({spec.type_name}): produced {len(out_shapes)} "
+                    f"outputs for {len(spec.nindex_out)} output nodes"
+                )
+            for n, s in zip(spec.nindex_out, out_shapes):
+                shapes[n] = tuple(s)
+        self.node_shapes = shapes
+        return shapes  # type: ignore[return-value]
+
+    # ------------------------------------------------------------------
+    def init_params(self, key: jax.Array, batch_size: int) -> Dict[str, dict]:
+        shapes = self.infer_shapes(batch_size)
+        params: Dict[str, dict] = {}
+        for i, spec in enumerate(self.graph.layers):
+            if spec.type_name == "shared":
+                continue
+            lay = self.layer_objs[i]
+            key, sub = jax.random.split(key)
+            in_shapes = [shapes[n] for n in spec.nindex_in]
+            p = lay.init_params(sub, in_shapes)
+            if p:
+                params[self.param_key[i]] = p
+        return params
+
+    # ------------------------------------------------------------------
+    def forward(
+        self,
+        params: Dict[str, dict],
+        data: jnp.ndarray,
+        *,
+        labels: Optional[jnp.ndarray] = None,
+        extras: Sequence[jnp.ndarray] = (),
+        train: bool = False,
+        rng: Optional[jax.Array] = None,
+        step: Optional[jnp.ndarray] = None,
+    ) -> Tuple[List[Optional[jnp.ndarray]], jnp.ndarray]:
+        """Execute the graph.
+
+        Returns ``(node_values, total_scaled_loss)``.  ``labels`` is the
+        batch label matrix ``(N, label_width)`` (may be None at predict
+        time — loss is then 0 and loss layers only transform).
+        """
+        g = self.graph
+        nodes: List[Optional[jnp.ndarray]] = [None] * g.num_nodes
+        nodes[0] = data
+        for k, e in enumerate(extras):
+            nodes[k + 1] = e
+        total_loss = jnp.zeros((), jnp.float32)
+        batch = self.batch_size if self.batch_size > 0 else data.shape[0]
+        for i, spec in enumerate(g.layers):
+            lay = self.layer_objs[i]
+            inputs = [nodes[n] for n in spec.nindex_in]
+            if any(v is None for v in inputs):
+                raise ValueError(f"layer {i}: unset input node")
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            if isinstance(lay, LossLayer):
+                logits = inputs[0]
+                if labels is not None:
+                    field = self._label_field(labels, lay.target)
+                    scale = lay.grad_scale / (batch * self.update_period)
+                    total_loss = total_loss + scale * lay.loss(logits, field)
+                nodes[spec.nindex_out[0]] = lay.transform(logits)
+            else:
+                outs = lay.apply(
+                    params.get(self.param_key[i], {}),
+                    inputs,
+                    train=train,
+                    rng=lrng,
+                    step=step,
+                )
+                for n, v in zip(spec.nindex_out, outs):
+                    nodes[n] = v
+        return nodes, total_loss
+
+    def _label_field(self, labels: jnp.ndarray, target: str) -> jnp.ndarray:
+        g = self.graph
+        if target not in g.label_name_map:
+            raise ValueError(f"LossLayer: unknown target={target!r}")
+        a, b = g.label_range[g.label_name_map[target]]
+        if labels.ndim == 1:
+            labels = labels[:, None]
+        return labels[:, a:b]
+
+    # convenience -------------------------------------------------------
+    def out_node_index(self) -> int:
+        """The final node (prediction output), reference trainer semantics."""
+        return self.graph.layers[-1].nindex_out[-1] if self.graph.layers else 0
+
+    def loss_fn(
+        self,
+        params,
+        data,
+        labels,
+        *,
+        train: bool = True,
+        rng=None,
+        step=None,
+        extras=(),
+    ) -> jnp.ndarray:
+        _, loss = self.forward(
+            params, data, labels=labels, extras=extras, train=train, rng=rng, step=step
+        )
+        return loss

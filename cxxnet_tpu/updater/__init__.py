@@ -1,0 +1,130 @@
+"""Updaters: sgd / nag / adam as pure per-tensor update rules.
+
+Each updater is ``init_state(w) -> state`` plus ``apply(w, grad, state,
+epoch) -> (new_w, new_state)``, both jit-traceable; the trainer maps them
+over the parameter pytree.  This replaces the reference's per-tensor
+``IUpdater`` objects (``/root/reference/src/updater/updater.h:22-66``) and
+the AsyncUpdater push/pull engine — on TPU the gradients arrive already
+all-reduced by the compiler, so the update is just math.
+
+Update rules (exact parity, including quirks):
+* sgd (``sgd_updater-inl.hpp:72-84``): ``m = mom*m - lr*(clip(g) + wd*w);
+  w += m`` where ``clip`` also zeroes NaNs, applied only when
+  ``clip_gradient != 0`` (the built-in NaN guard, SURVEY §4.5).
+* nag (``nag_updater-inl.hpp:62-70``): ``m' = mom*m - lr*(g + wd*w);
+  w += (1 + mom)*m' - mom*m``.
+* adam (``adam_updater-inl.hpp:13-84``): decay1=0.1, decay2=0.001;
+  **wd is subtracted** (``grad -= wd*w``) — reference quirk kept;
+  ``lr_t = lr * sqrt(fix2)/fix1`` with ``fix_i = 1-(1-decay_i)^(t+1)``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+from .param import UpdaterParam
+
+State = Dict[str, jnp.ndarray]
+
+
+def _nan_clip(g: jnp.ndarray, bound: float) -> jnp.ndarray:
+    g = jnp.where(jnp.isnan(g), 0.0, g)
+    return jnp.clip(g, -bound, bound)
+
+
+class Updater:
+    """Base: one instance per weight tensor, carrying its UpdaterParam."""
+
+    type_name = ""
+
+    def __init__(self, tag: str) -> None:
+        self.param = UpdaterParam(tag)
+
+    def set_param(self, name: str, val: str) -> None:
+        self.param.set_param(name, val)
+
+    def init_state(self, w: jnp.ndarray) -> State:
+        raise NotImplementedError
+
+    def apply(
+        self, w: jnp.ndarray, g: jnp.ndarray, state: State, epoch: jnp.ndarray
+    ) -> Tuple[jnp.ndarray, State]:
+        raise NotImplementedError
+
+
+class SGDUpdater(Updater):
+    type_name = "sgd"
+
+    def init_state(self, w):
+        return {"m": jnp.zeros_like(w)}
+
+    def apply(self, w, g, state, epoch):
+        p = self.param
+        lr = p.learning_rate(epoch).astype(w.dtype)
+        mom = p.momentum_at(epoch).astype(w.dtype)
+        if p.clip_gradient != 0.0:
+            g = _nan_clip(g, p.clip_gradient)
+        m = mom * state["m"] - lr * (g + p.wd * w)
+        return w + m, {"m": m}
+
+
+class NAGUpdater(Updater):
+    type_name = "nag"
+
+    def init_state(self, w):
+        return {"m": jnp.zeros_like(w)}
+
+    def apply(self, w, g, state, epoch):
+        p = self.param
+        lr = p.learning_rate(epoch).astype(w.dtype)
+        mom = p.momentum_at(epoch).astype(w.dtype)
+        old_m = state["m"]
+        m = mom * old_m - lr * (g + p.wd * w)
+        return w + (1.0 + mom) * m - mom * old_m, {"m": m}
+
+
+class AdamUpdater(Updater):
+    type_name = "adam"
+
+    def __init__(self, tag: str) -> None:
+        super().__init__(tag)
+        self.decay1 = 0.1
+        self.decay2 = 0.001
+
+    def set_param(self, name: str, val: str) -> None:
+        # parity (adam_updater-inl.hpp:56-57): the reference's beta1/beta2
+        # ARE the decay rates (beta1=0.1 ≙ conventional beta1=0.9)
+        if name == "beta1":
+            self.decay1 = float(val)
+        elif name == "beta2":
+            self.decay2 = float(val)
+        else:
+            super().set_param(name, val)
+
+    def init_state(self, w):
+        return {"m1": jnp.zeros_like(w), "m2": jnp.zeros_like(w)}
+
+    def apply(self, w, g, state, epoch):
+        p = self.param
+        if p.wd > 0.0:
+            g = g - p.wd * w  # reference quirk: wd *subtracted* (adam:77)
+        t = jnp.asarray(epoch, jnp.float32)
+        fix1 = 1.0 - jnp.power(1.0 - self.decay1, t + 1.0)
+        fix2 = 1.0 - jnp.power(1.0 - self.decay2, t + 1.0)
+        lr_t = (p.base_lr * jnp.sqrt(fix2) / fix1).astype(w.dtype)
+        m1 = state["m1"] + self.decay1 * (g - state["m1"])
+        m2 = state["m2"] + self.decay2 * (g * g - state["m2"])
+        w = w - lr_t * (m1 / (jnp.sqrt(m2) + 1e-8))
+        return w, {"m1": m1, "m2": m2}
+
+
+_UPDATERS = {"sgd": SGDUpdater, "nag": NAGUpdater, "adam": AdamUpdater}
+
+
+def create_updater(type_name: str, tag: str) -> Updater:
+    """Factory (parity: ``updater_impl-inl.hpp:18-31``)."""
+    if type_name not in _UPDATERS:
+        raise ValueError(f"unknown updater type: {type_name!r}")
+    return _UPDATERS[type_name](tag)

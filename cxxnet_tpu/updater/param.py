@@ -1,0 +1,122 @@
+"""Updater hyper-parameters: learning-rate & momentum schedules, per-tag scoping.
+
+Parity: ``/root/reference/src/updater/param.h``.
+
+* ``epoch`` is the number of mini-batch updates so far
+  (``/root/reference/src/updater/updater.h:48-50``), NOT the round.
+* lr schedules (``ScheduleEpoch``, param.h:117-137)::
+
+    constant :  lr = base_lr
+    expdecay :  lr = base_lr * gamma ** (epoch / step)          (continuous)
+    polydecay:  lr = base_lr * (1 + (epoch // step) * gamma) ** -alpha
+    factor   :  lr = base_lr * factor ** (epoch // step)
+
+  clamped below by ``minimum_lr``; before ``start_epoch`` lr = base_lr.
+* momentum saturation: the reference's in-place ``momentum += (final -
+  base)/saturation * epoch + base`` accumulates across calls and is clamped
+  at ``final_momentum`` (param.h:130-133); the *intent* — and what is
+  implemented here, as a pure function — is a linear ramp from
+  ``base_momentum`` to ``final_momentum`` over ``saturation_epoch`` updates.
+* per-tag scoping (param.h:146-150): a key ``wmat:lr`` applies only to
+  updaters whose tag is ``wmat``; the tag prefix is stripped and the rest
+  re-parsed.  ``lr:...``/``eta:...`` prefixes configure the schedule.
+
+All schedule evaluation is a pure function of a traced ``epoch`` scalar so
+the whole update rule lives inside one ``jit``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class UpdaterParam:
+    def __init__(self, tag: str = "") -> None:
+        self.tag = tag
+        self.base_lr = 0.01
+        self.wd = 0.0
+        self.momentum = 0.9
+        self.lr_schedule = 0  # 0 const, 1 expdecay, 2 polydecay, 3 factor
+        self.momentum_schedule = 0
+        self.lr_step = 1
+        self.lr_gamma = 0.5
+        self.lr_alpha = 0.5
+        self.lr_factor = 0.1
+        self.lr_minimum = 0.00001
+        self.start_epoch = 0
+        self.base_momentum = 0.5
+        self.final_momentum = 0.90
+        self.saturation_epoch = 0
+        self.clip_gradient = 0.0
+        self.silent = 0
+
+    def set_param(self, name: str, val: str) -> None:
+        # tag-scoped override: "wmat:lr" applies only when tag == "wmat"
+        if self.tag and name.startswith(self.tag) and len(name) > len(self.tag) \
+                and name[len(self.tag)] == ":":
+            name = name[len(self.tag) + 1:]
+        if name in ("lr", "eta"):
+            self.base_lr = float(val)
+        elif name == "wd":
+            self.wd = float(val)
+        elif name == "momentum":
+            self.momentum = float(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "momentum_schedule":
+            self.momentum_schedule = int(val)
+        elif name == "clip_gradient":
+            self.clip_gradient = float(val)
+        elif name == "final_momentum":
+            self.final_momentum = float(val)
+        elif name == "base_momentum":
+            self.base_momentum = float(val)
+        elif name == "saturation_epoch":
+            self.saturation_epoch = int(val)
+        elif name.startswith("lr:") or name.startswith("eta:"):
+            sub = name.split(":", 1)[1]
+            if sub == "schedule":
+                table = {"constant": 0, "expdecay": 1, "polydecay": 2, "factor": 3}
+                if val in table:
+                    self.lr_schedule = table[val]
+            elif sub == "gamma":
+                self.lr_gamma = float(val)
+            elif sub == "alpha":
+                self.lr_alpha = float(val)
+            elif sub == "step":
+                self.lr_step = int(val)
+            elif sub == "factor":
+                self.lr_factor = float(val)
+            elif sub == "minimum_lr":
+                self.lr_minimum = float(val)
+            elif sub == "start_epoch":
+                self.start_epoch = int(val)
+
+    # --- pure schedule evaluation (jit-safe) ---------------------------
+    def learning_rate(self, epoch: jnp.ndarray) -> jnp.ndarray:
+        e = jnp.asarray(epoch, jnp.float32)
+        if self.lr_schedule == 0:
+            lr = jnp.full_like(e, self.base_lr)
+        elif self.lr_schedule == 1:
+            lr = self.base_lr * jnp.power(self.lr_gamma, e / self.lr_step)
+        elif self.lr_schedule == 2:
+            lr = self.base_lr * jnp.power(
+                1.0 + jnp.floor(e / self.lr_step) * self.lr_gamma, -self.lr_alpha
+            )
+        elif self.lr_schedule == 3:
+            lr = self.base_lr * jnp.power(self.lr_factor, jnp.floor(e / self.lr_step))
+        else:
+            raise ValueError("unknown lr schedule")
+        lr = jnp.maximum(lr, self.lr_minimum)
+        if self.start_epoch > 0:
+            lr = jnp.where(e < self.start_epoch, self.base_lr, lr)
+        return lr
+
+    def momentum_at(self, epoch: jnp.ndarray) -> jnp.ndarray:
+        e = jnp.asarray(epoch, jnp.float32)
+        if self.momentum_schedule and self.saturation_epoch > 0:
+            ramp = self.base_momentum + (
+                self.final_momentum - self.base_momentum
+            ) * e / self.saturation_epoch
+            return jnp.minimum(ramp, self.final_momentum)
+        return jnp.full_like(e, self.momentum)

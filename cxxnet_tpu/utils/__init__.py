@@ -1,0 +1,3 @@
+"""Utility modules: metrics, timing."""
+
+from .metric import MetricSet, create_metric  # noqa: F401

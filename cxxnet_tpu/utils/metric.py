@@ -1,0 +1,178 @@
+"""Evaluation metrics: error, rmse, logloss, rec@n + MetricSet.
+
+Parity: ``/root/reference/src/utils/metric.h`` —
+
+* ``error``: argmax mismatch (first max wins on ties); 1-column
+  predictions threshold at 0 (metric.h:73-90)
+* ``rmse``: *sum* of squared errors per instance, averaged over instances
+  (the reference never takes the square root despite the name — kept)
+* ``logloss``: -log p[target], clamped to [1e-15, 1-1e-15]; binary form
+  for 1-column predictions with the built-in NaN check
+* ``rec@n``: fraction of the label list present in the top-n predictions
+  (deterministic sort here; the reference shuffles before sorting to break
+  ties randomly)
+* ``MetricSet``: multiple metrics over named label fields; report format
+  ``\\tname-metric[field]:value`` (metric.h:193-203)
+
+Config parsing (``nnet_impl-inl.hpp:57-67``): ``metric = error`` binds to
+field "label"; ``metric[field,node] = error`` selects a label field (the
+node part selects an output node; all example configs evaluate the final
+output, which is what the trainer provides).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+class Metric:
+    name = ""
+
+    def __init__(self) -> None:
+        self.sum_metric = 0.0
+        self.cnt_inst = 0
+
+    def clear(self) -> None:
+        self.sum_metric, self.cnt_inst = 0.0, 0
+
+    def add_eval(self, pred: np.ndarray, label: np.ndarray) -> None:
+        """pred: (N, K) scores; label: (N, L) field columns."""
+        self.sum_metric += float(self._batch_sum(pred, label))
+        self.cnt_inst += pred.shape[0]
+
+    def get(self) -> float:
+        return self.sum_metric / max(self.cnt_inst, 1)
+
+    def _batch_sum(self, pred: np.ndarray, label: np.ndarray) -> float:
+        raise NotImplementedError
+
+
+class MetricError(Metric):
+    name = "error"
+
+    def _batch_sum(self, pred, label):
+        if pred.shape[1] != 1:
+            guess = pred.argmax(axis=1)
+        else:
+            guess = (pred[:, 0] > 0).astype(np.int64)
+        return np.sum(guess != label[:, 0].astype(np.int64))
+
+
+class MetricRMSE(Metric):
+    name = "rmse"
+
+    def _batch_sum(self, pred, label):
+        if pred.shape != label.shape:
+            raise ValueError("rmse: prediction and label sizes must match")
+        return np.sum((pred - label) ** 2)
+
+
+class MetricLogloss(Metric):
+    name = "logloss"
+
+    def _batch_sum(self, pred, label):
+        eps = 1e-15
+        if pred.shape[1] != 1:
+            tgt = label[:, 0].astype(np.int64)
+            p = np.clip(pred[np.arange(len(tgt)), tgt], eps, 1 - eps)
+            return -np.sum(np.log(p))
+        p = np.clip(pred[:, 0], eps, 1 - eps)
+        y = label[:, 0]
+        res = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+        if np.isnan(res).any():
+            raise FloatingPointError("logloss: NaN detected!")
+        return np.sum(res)
+
+
+class MetricRecall(Metric):
+    def __init__(self, name: str) -> None:
+        super().__init__()
+        m = re.fullmatch(r"rec@(\d+)", name)
+        if not m:
+            raise ValueError("must specify n for rec@n")
+        self.topn = int(m.group(1))
+        self.name = name
+
+    def _batch_sum(self, pred, label):
+        if pred.shape[1] < self.topn:
+            raise ValueError(
+                f"rec@{self.topn} meaningless for prediction list of "
+                f"size {pred.shape[1]}"
+            )
+        top = np.argsort(-pred, axis=1)[:, : self.topn]
+        total = 0.0
+        for i in range(pred.shape[0]):
+            hits = np.isin(label[i].astype(np.int64), top[i]).sum()
+            total += hits / label.shape[1]
+        return total
+
+
+def create_metric(name: str) -> Metric:
+    if name == "error":
+        return MetricError()
+    if name == "rmse":
+        return MetricRMSE()
+    if name == "logloss":
+        return MetricLogloss()
+    if name.startswith("rec@"):
+        return MetricRecall(name)
+    raise ValueError(f"Metric: unknown metric name: {name}")
+
+
+_METRIC_KEY_RE = re.compile(r"metric(\[(?P<field>[^,\]]+)(,(?P<node>[^\]]+))?\])?")
+
+
+class MetricSet:
+    def __init__(self) -> None:
+        self.metrics: List[Metric] = []
+        self.fields: List[str] = []
+
+    def add_metric(self, name: str, field: str = "label") -> None:
+        self.metrics.append(create_metric(name))
+        self.fields.append(field)
+
+    def try_add_from_config(self, key: str, val: str) -> bool:
+        """Parse a ``metric`` / ``metric[field]`` / ``metric[field,node]``
+        config entry; returns False if the key is not a metric key."""
+        if not key.startswith("metric"):
+            return False
+        m = _METRIC_KEY_RE.fullmatch(key)
+        if not m:
+            return False
+        field = m.group("field") or "label"
+        self.add_metric(val, field)
+        return True
+
+    def clear(self) -> None:
+        for mt in self.metrics:
+            mt.clear()
+
+    def add_eval(
+        self,
+        pred: np.ndarray,
+        labels: np.ndarray,
+        label_ranges: Dict[str, Tuple[int, int]],
+    ) -> None:
+        """labels: (N, label_width); label_ranges: field → column span."""
+        if labels.ndim == 1:
+            labels = labels[:, None]
+        for mt, field in zip(self.metrics, self.fields):
+            if field not in label_ranges:
+                raise ValueError(f"Metric: unknown target = {field}")
+            a, b = label_ranges[field]
+            mt.add_eval(pred, labels[:, a:b])
+
+    def print(self, evname: str) -> str:
+        out = []
+        for mt, field in zip(self.metrics, self.fields):
+            tag = f"{evname}-{mt.name}"
+            if field != "label":
+                tag += f"[{field}]"
+            out.append(f"\t{tag}:{mt.get():g}")
+        return "".join(out)
+
+    def __len__(self) -> int:
+        return len(self.metrics)

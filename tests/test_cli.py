@@ -1,0 +1,188 @@
+"""End-to-end CLI tests: full .conf runs through the task driver."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.mnist import write_idx_images, write_idx_labels
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_conf(tmp_path, num_round=3, extra=""):
+    """A small MNIST-style conf over synthetic idx files."""
+    rng = np.random.RandomState(0)
+    n, hw = 256, 8
+    imgs = rng.randint(0, 256, (n, hw, hw)).astype(np.uint8)
+    # learnable labels: derived from mean pixel intensity quartiles
+    flat = imgs.reshape(n, -1).astype(np.float32)
+    labels = (np.argsort(np.argsort(flat.mean(1))) * 4 // n).astype(np.uint8)
+    write_idx_images(str(tmp_path / "tr-img.idx"), imgs)
+    write_idx_labels(str(tmp_path / "tr-lab.idx"), labels)
+    write_idx_images(str(tmp_path / "te-img.idx"), imgs[:64])
+    write_idx_labels(str(tmp_path / "te-lab.idx"), labels[:64])
+    conf = f"""
+data = train
+iter = mnist
+  path_img = "{tmp_path}/tr-img.idx"
+  path_label = "{tmp_path}/tr-lab.idx"
+  shuffle = 1
+iter = end
+eval = test
+iter = mnist
+  path_img = "{tmp_path}/te-img.idx"
+  path_label = "{tmp_path}/te-lab.idx"
+iter = end
+
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.1
+layer[+1:sg1] = relu
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,64
+batch_size = 64
+dev = cpu
+save_model = 1
+num_round = {num_round}
+train_eval = 1
+eval_train = 1
+eta = 0.3
+momentum = 0.9
+metric = error
+model_dir = {tmp_path}/models
+print_step = 100
+{extra}
+"""
+    path = tmp_path / "mnist.conf"
+    path.write_text(conf)
+    return str(path)
+
+
+def run_cli(args, cwd):
+    """Run the CLI in-process-like via subprocess with the test env."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO  # drops /root/.axon_site → pure CPU jax
+    return subprocess.run(
+        [sys.executable, "-m", "cxxnet_tpu", *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        env=env,
+        timeout=300,
+    )
+
+
+def test_train_task_end_to_end(tmp_path):
+    conf = make_conf(tmp_path)
+    r = run_cli([conf], str(tmp_path))
+    assert r.returncode == 0, r.stderr + r.stdout
+    # eval lines on stderr: [round]\ttrain-error:..\ttest-error:..
+    lines = [l for l in r.stderr.splitlines() if l.startswith("[")]
+    assert len(lines) == 3
+    assert "train-error:" in lines[0] and "test-error:" in lines[0]
+    # error decreases over rounds
+    def err_of(line):
+        return float(line.split("test-error:")[1].split()[0])
+
+    assert err_of(lines[-1]) < err_of(lines[0]) + 1e-9
+    # checkpoints written each round
+    models = sorted(os.listdir(tmp_path / "models"))
+    assert models == ["0000.model", "0001.model", "0002.model", "0003.model"]
+
+
+def test_continue_training(tmp_path):
+    conf = make_conf(tmp_path, num_round=2)
+    r1 = run_cli([conf], str(tmp_path))
+    assert r1.returncode == 0, r1.stderr
+    # continue for 2 more rounds
+    r2 = run_cli([conf, "continue=1", "num_round=4"], str(tmp_path))
+    assert r2.returncode == 0, r2.stderr
+    assert "Continue training from round" in r2.stdout
+    models = sorted(os.listdir(tmp_path / "models"))
+    assert "0004.model" in models
+
+
+def test_pred_task(tmp_path):
+    conf = make_conf(tmp_path, num_round=1)
+    r1 = run_cli([conf], str(tmp_path))
+    assert r1.returncode == 0, r1.stderr
+    pred_conf = tmp_path / "pred.conf"
+    pred_conf.write_text(
+        open(conf).read()
+        + f"""
+pred = {tmp_path}/pred.txt
+iter = mnist
+  path_img = "{tmp_path}/te-img.idx"
+  path_label = "{tmp_path}/te-lab.idx"
+iter = end
+"""
+    )
+    r2 = run_cli(
+        [str(pred_conf), "task=pred", f"model_in={tmp_path}/models/0001.model"],
+        str(tmp_path),
+    )
+    assert r2.returncode == 0, r2.stderr + r2.stdout
+    preds = np.loadtxt(tmp_path / "pred.txt")
+    assert len(preds) == 64
+    assert set(np.unique(preds)) <= {0.0, 1.0, 2.0, 3.0}
+
+
+def test_extract_task(tmp_path):
+    conf = make_conf(tmp_path, num_round=1)
+    r1 = run_cli([conf], str(tmp_path))
+    assert r1.returncode == 0, r1.stderr
+    pred_conf = tmp_path / "ext.conf"
+    pred_conf.write_text(
+        open(conf).read()
+        + f"""
+pred = {tmp_path}/feat.txt
+iter = mnist
+  path_img = "{tmp_path}/te-img.idx"
+  path_label = "{tmp_path}/te-lab.idx"
+iter = end
+"""
+    )
+    r2 = run_cli(
+        [
+            str(pred_conf),
+            "task=extract",
+            f"model_in={tmp_path}/models/0001.model",
+            "extract_node_name=fc1",
+        ],
+        str(tmp_path),
+    )
+    assert r2.returncode == 0, r2.stderr + r2.stdout
+    feats = np.loadtxt(tmp_path / "feat.txt")
+    assert feats.shape == (64, 32)
+    meta = open(tmp_path / "feat.txt.meta").read().strip()
+    assert meta.startswith("64,")
+
+
+def test_finetune_task(tmp_path):
+    conf = make_conf(tmp_path, num_round=1)
+    r1 = run_cli([conf], str(tmp_path))
+    assert r1.returncode == 0, r1.stderr
+    r2 = run_cli(
+        [conf, "task=finetune", f"model_in={tmp_path}/models/0001.model",
+         "num_round=2", f"model_dir={tmp_path}/models2"],
+        str(tmp_path),
+    )
+    assert r2.returncode == 0, r2.stderr + r2.stdout
+    assert "Copying layer fc1" in r2.stdout
+
+
+def test_test_io_mode(tmp_path):
+    conf = make_conf(tmp_path, num_round=1)
+    r = run_cli([conf, "test_io=1"], str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    assert "start I/O test" in r.stdout

@@ -1,0 +1,261 @@
+"""NetGraph parser + FunctionalNet tests against the reference configs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu import config as C
+from cxxnet_tpu.nnet import FunctionalNet, NetGraph
+
+MNIST_NET = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 100
+  init_sigma = 0.01
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.01
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,784
+batch_size = 32
+"""
+
+
+def build(text):
+    cfg = C.parse_pairs(text)
+    g = NetGraph().configure(cfg)
+    return g, FunctionalNet(g)
+
+
+def test_mnist_mlp_graph():
+    g, net = build(MNIST_NET)
+    assert g.node_names[0] == "in"
+    assert [l.type_name for l in g.layers] == ["fullc", "sigmoid", "fullc", "softmax"]
+    assert g.layers[0].name == "fc1"
+    # layer[+0] self-loop: softmax in node == out node
+    assert g.layers[3].is_self_loop
+    # node naming: layer[+1:fc1] creates node named fc1
+    assert g.node_index_of("fc1") == 1
+    assert g.node_index_of("sg1") == 2
+    shapes = net.infer_shapes(32)
+    assert shapes[0] == (32, 784)
+    assert shapes[g.node_index_of("fc1")] == (32, 100)
+    assert shapes[g.node_index_of("fc2")] == (32, 10)
+
+
+def test_mnist_mlp_forward_and_loss():
+    g, net = build(MNIST_NET)
+    params = net.init_params(jax.random.PRNGKey(0), 32)
+    assert set(params) == {"l0_fc1", "l2_fc2"}
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(32, 784).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 10, (32, 1)).astype(np.float32))
+    nodes, loss = net.forward(params, x, labels=y, train=True)
+    out = nodes[net.out_node_index()]
+    assert out.shape == (32, 10)
+    np.testing.assert_allclose(np.asarray(out.sum(-1)), 1.0, rtol=1e-5)  # softmax probs
+    # scaled loss ≈ mean CE / update_period; CE ~ log(10) at init
+    assert 0.9 * np.log(10) / 1 < float(loss) * 1 < 1.1 * np.log(10)
+    # gradient flows to all params
+    grads = jax.grad(net.loss_fn)(params, x, y)
+    assert float(jnp.abs(grads["l0_fc1"]["wmat"]).max()) > 0
+
+
+def test_numeric_node_graph():
+    text = """
+netconfig=start
+layer[0->1] = conv:cv1
+  kernel_size = 3
+  pad = 1
+  stride = 2
+  nchannel = 8
+layer[1->2] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[2->3] = flatten
+layer[3->3] = dropout
+  threshold = 0.5
+layer[3->4] = fullc
+  nhidden = 10
+layer[4->4] = softmax
+netconfig=end
+input_shape = 1,28,28
+batch_size = 16
+"""
+    g, net = build(text)
+    shapes = net.infer_shapes(16)
+    assert shapes[1] == (16, 14, 14, 8)
+    assert shapes[2] == (16, 7, 7, 8)
+    assert shapes[3] == (16, 7 * 7 * 8)
+    assert shapes[4] == (16, 10)
+    params = net.init_params(jax.random.PRNGKey(1), 16)
+    x = jnp.zeros((16, 28, 28, 1))
+    y = jnp.zeros((16, 1))
+    nodes, loss = net.forward(
+        params, x, labels=y, train=True, rng=jax.random.PRNGKey(2)
+    )
+    assert nodes[4].shape == (16, 10)
+
+
+def test_split_concat_graph():
+    text = """
+netconfig=start
+layer[0->1,2] = split
+layer[1->3] = fullc:a
+  nhidden = 4
+layer[2->4] = fullc:b
+  nhidden = 6
+layer[3,4->5] = concat
+layer[5->6] = fullc:c
+  nhidden = 3
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,8
+batch_size = 4
+"""
+    g, net = build(text)
+    shapes = net.infer_shapes(4)
+    assert shapes[5] == (4, 10)
+    assert shapes[6] == (4, 3)
+    params = net.init_params(jax.random.PRNGKey(0), 4)
+    x = jnp.ones((4, 8))
+    nodes, _ = net.forward(params, x)
+    assert nodes[6].shape == (4, 3)
+
+
+def test_shared_layer_params():
+    text = """
+netconfig=start
+layer[0->1] = fullc:fc
+  nhidden = 8
+layer[1->2] = sigmoid
+layer[2->3] = shared[fc]
+netconfig=end
+input_shape = 1,1,8
+batch_size = 4
+"""
+    g, net = build(text)
+    assert g.layers[2].type_name == "shared"
+    assert g.layers[2].primary == 0
+    shapes = net.infer_shapes(4)
+    assert shapes[3] == (4, 8)
+    params = net.init_params(jax.random.PRNGKey(0), 4)
+    assert list(params) == ["l0_fc"]  # one param set, shared
+    x = jnp.ones((4, 8))
+    nodes, _ = net.forward(params, x)
+    # shared layer applies the same weights: node3 = W@sigmoid(W@x+b)+b
+    w, b = np.asarray(params["l0_fc"]["wmat"]), np.asarray(params["l0_fc"]["bias"])
+    h = 1 / (1 + np.exp(-(np.ones((4, 8)) @ w.T + b)))
+    np.testing.assert_allclose(np.asarray(nodes[3]), h @ w.T + b, rtol=1e-4)
+
+
+def test_shared_layer_rejects_own_config():
+    text = """
+netconfig=start
+layer[0->1] = fullc:fc
+  nhidden = 8
+layer[1->2] = shared[fc]
+  nhidden = 4
+netconfig=end
+"""
+    with pytest.raises(ValueError):
+        NetGraph().configure(C.parse_pairs(text))
+
+
+def test_undefined_input_node_rejected():
+    text = """
+netconfig=start
+layer[nope->1] = fullc
+  nhidden = 8
+netconfig=end
+"""
+    with pytest.raises(ValueError):
+        NetGraph().configure(C.parse_pairs(text))
+
+
+def test_label_vec_fields():
+    text = """
+label_vec[0,1) = label
+label_vec[1,3) = aux
+netconfig=start
+layer[0->1] = fullc
+  nhidden = 2
+layer[+0] = l2_loss
+  target = aux
+netconfig=end
+input_shape = 1,1,4
+batch_size = 2
+"""
+    g, net = build(text)
+    assert g.label_name_map["aux"] == 2
+    params = net.init_params(jax.random.PRNGKey(0), 2)
+    x = jnp.ones((2, 4))
+    labels = jnp.asarray([[9.0, 1.0, 2.0], [9.0, 3.0, 4.0]])
+    _, loss = net.forward(params, x, labels=labels)
+    # loss uses columns 1:3, not column 0
+    pred = np.asarray(net.forward(params, x)[0][1])
+    want = 0.5 * ((pred - np.asarray(labels[:, 1:3])) ** 2).sum() / 2
+    np.testing.assert_allclose(float(loss), want, rtol=1e-4)
+
+
+def test_structure_roundtrip():
+    g, net = build(MNIST_NET)
+    s = g.structure_to_json()
+    g2 = NetGraph.structure_from_json(s)
+    assert g2.node_names == g.node_names
+    assert g2.layers == g.layers
+    assert g2.input_shape == g.input_shape
+    # re-configuring the loaded graph with the same config validates OK
+    g2.configure(C.parse_pairs(MNIST_NET))
+    # ...and a mismatched config fails
+    with pytest.raises(ValueError):
+        NetGraph.structure_from_json(s).configure(
+            C.parse_pairs(MNIST_NET.replace("sigmoid", "tanh"))
+        )
+
+
+def test_reference_netconfigs_parse():
+    import os
+
+    for rel, nlayers in (
+        ("example/MNIST/MNIST.conf", 4),
+        ("example/MNIST/MNIST_CONV.conf", 8),
+        ("example/ImageNet/ImageNet.conf", 24),
+        ("example/kaggle_bowl/bowl.conf", 17),
+    ):
+        path = os.path.join("/root/reference", rel)
+        if not os.path.exists(path):
+            pytest.skip("reference not mounted")
+        split = C.split_sections(C.parse_file(path))
+        g = NetGraph().configure(split.global_entries)
+        assert len(g.layers) == nlayers, rel
+        net = FunctionalNet(g)
+        batch = int(C.cfg_get(split.global_entries, "batch_size", "16"))
+        shapes = net.infer_shapes(min(batch, 16))
+        assert all(s is not None for s in shapes)
+
+
+def test_alexnet_forward_compiles():
+    """The full AlexNet graph from the reference conf runs under jit."""
+    import os
+
+    path = "/root/reference/example/ImageNet/ImageNet.conf"
+    if not os.path.exists(path):
+        pytest.skip("reference not mounted")
+    split = C.split_sections(C.parse_file(path))
+    g = NetGraph().configure(split.global_entries)
+    net = FunctionalNet(g)
+    net.batch_size = 2
+    params = net.init_params(jax.random.PRNGKey(0), 2)
+    x = jnp.zeros((2, 227, 227, 3))
+    y = jnp.zeros((2, 1))
+
+    @jax.jit
+    def step(p, x, y):
+        return net.loss_fn(p, x, y, train=True, rng=jax.random.PRNGKey(0))
+
+    loss = step(params, x, y)
+    assert np.isfinite(float(loss))

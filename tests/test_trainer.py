@@ -1,0 +1,246 @@
+"""NetTrainer tests: overfit, accumulation, checkpointing, finetune, weights."""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import config as C
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import NetTrainer
+
+MLP_CFG = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.1
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,8
+batch_size = 16
+eta = 0.5
+momentum = 0.9
+metric = error
+metric = logloss
+"""
+
+
+def make_trainer(extra=""):
+    tr = NetTrainer()
+    tr.set_params(C.parse_pairs(MLP_CFG + extra))
+    tr.init_model()
+    return tr
+
+
+def toy_data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8).astype(np.float32)
+    w = rng.randn(8, 4).astype(np.float32)
+    y = (x @ w).argmax(-1).astype(np.float32)[:, None]
+    return x, y
+
+
+def batches(x, y, bs=16):
+    for i in range(0, len(x), bs):
+        yield DataBatch(data=x[i : i + bs], label=y[i : i + bs])
+
+
+def test_overfit_small_dataset():
+    tr = make_trainer()
+    x, y = toy_data()
+    first_err = None
+    for epoch in range(60):
+        for b in batches(x, y):
+            tr.update(b)
+    # final train error on the data itself
+    errs = []
+    for b in batches(x, y):
+        pred = tr.predict(b)
+        errs.append((pred != b.label[:, 0]).mean())
+    err = float(np.mean(errs))
+    assert err <= 0.05, f"did not overfit: err={err}"
+    assert tr.epoch_counter == 60 * 4
+
+
+def test_update_period_accumulation():
+    tr = make_trainer("update_period = 2\n")
+    x, y = toy_data(32)
+    for b in batches(x, y):
+        tr.update(b)
+    # 2 micro-batches per update → epoch_counter advanced half as often
+    assert tr.epoch_counter == 1
+    assert tr.sample_counter == 0
+
+
+def test_eval_train_metrics_and_format():
+    tr = make_trainer()
+    x, y = toy_data(32)
+    for b in batches(x, y):
+        tr.update(b)
+    line = tr.evaluate(None, "train")
+    assert "\ttrain-error:" in line and "\ttrain-logloss:" in line
+
+
+def test_evaluate_iterator_trims_padding():
+    from cxxnet_tpu.utils.metric import MetricSet
+
+    tr = make_trainer()
+    x, y = toy_data(32)
+
+    class FakeIter:
+        def __init__(self):
+            self.pos = 0
+
+        def before_first(self):
+            self.pos = 0
+
+        def next(self):
+            self.pos += 1
+            return self.pos <= 2
+
+        def value(self):
+            b = DataBatch(data=x[:16], label=y[:16])
+            if self.pos == 2:
+                b = DataBatch(data=x[16:32], label=y[16:32], num_batch_padd=6)
+            return b
+
+    line = tr.evaluate(FakeIter(), "val")
+    assert "\tval-error:" in line
+    # 16 + 10 = 26 instances counted
+    assert tr.metric.metrics[0].cnt_inst == 26
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    tr = make_trainer()
+    x, y = toy_data(32)
+    for b in batches(x, y):
+        tr.update(b)
+    path = str(tmp_path / "0001.model")
+    tr.save_model(path)
+
+    tr2 = NetTrainer()
+    tr2.set_params(C.parse_pairs(MLP_CFG))
+    tr2.load_model(path)
+    assert tr2.epoch_counter == tr.epoch_counter
+    b = DataBatch(data=x[:16], label=y[:16])
+    np.testing.assert_allclose(tr.predict(b), tr2.predict(b))
+    # loaded model can continue training
+    tr2.update(b)
+
+
+def test_finetune_copies_matched_layers(tmp_path):
+    tr = make_trainer()
+    path = str(tmp_path / "m.model")
+    tr.save_model(path)
+
+    # new net: same fc1 name, different fc2 size → only fc1 copied
+    cfg2 = MLP_CFG.replace("nhidden = 4", "nhidden = 3")
+    tr2 = NetTrainer()
+    tr2.set_params(C.parse_pairs(cfg2))
+    tr2.copy_model_from(path)
+    np.testing.assert_allclose(
+        tr2.get_weight("fc1", "wmat"), tr.get_weight("fc1", "wmat")
+    )
+    assert tr2.get_weight("fc2", "wmat").shape == (3, 32)
+    assert tr2.epoch_counter == 0
+
+
+def test_get_set_weight_2d():
+    tr = make_trainer()
+    w = tr.get_weight("fc1", "wmat")
+    assert w.shape == (32, 8)
+    neww = np.zeros_like(w)
+    tr.set_weight(neww, "fc1", "wmat")
+    np.testing.assert_allclose(tr.get_weight("fc1", "wmat"), 0.0)
+    b = tr.get_weight("fc1", "bias")
+    assert b.shape == (1, 32)
+
+
+def test_conv_weight_2d_roundtrip():
+    cfg = """
+netconfig=start
+layer[0->1] = conv:cv
+  kernel_size = 3
+  nchannel = 6
+netconfig=end
+input_shape = 3,8,8
+batch_size = 4
+"""
+    tr = NetTrainer()
+    tr.set_params(C.parse_pairs(cfg))
+    tr.init_model()
+    w2 = tr.get_weight("cv", "wmat")
+    assert w2.shape == (6, 3 * 3 * 3)
+    tr.set_weight(w2 * 2, "cv", "wmat")
+    np.testing.assert_allclose(tr.get_weight("cv", "wmat"), w2 * 2, rtol=1e-6)
+
+
+def test_predict_raw_single_column():
+    cfg = """
+netconfig=start
+layer[0->1] = fullc:f
+  nhidden = 1
+layer[+0] = l2_loss
+netconfig=end
+input_shape = 1,1,4
+batch_size = 8
+"""
+    tr = NetTrainer()
+    tr.set_params(C.parse_pairs(cfg))
+    tr.init_model()
+    x = np.ones((8, 4), np.float32)
+    pred = tr.predict(DataBatch(data=x, label=np.zeros((8, 1), np.float32)))
+    # 1-column output: raw values, not argmax
+    w = tr.get_weight("f", "wmat")
+    bias = tr.get_weight("f", "bias")
+    np.testing.assert_allclose(pred, (x @ w.T + bias)[:, 0], rtol=1e-4)
+
+
+def test_extract_feature_by_name_and_top():
+    tr = make_trainer()
+    x, y = toy_data(16)
+    b = DataBatch(data=x[:16], label=y[:16])
+    f1 = tr.extract_feature(b, "fc1")
+    assert f1.shape == (16, 32)
+    # top[-1] = last node (softmax output)
+    fo = tr.extract_feature(b, "top[-1]")
+    assert fo.shape == (16, 4)
+    np.testing.assert_allclose(fo.sum(-1), 1.0, rtol=1e-4)
+
+
+def test_training_with_extra_data():
+    """Side inputs (extra_data_num) must flow through the TRAIN path too."""
+    cfg = """
+extra_data_num = 1
+extra_data_shape[0] = 1,1,3
+netconfig=start
+layer[0->2] = fullc:f1
+  nhidden = 5
+layer[in_1->3] = fullc:f2
+  nhidden = 5
+layer[2,3->4] = concat
+layer[4->5] = fullc:f3
+  nhidden = 2
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,4
+batch_size = 8
+eta = 0.1
+"""
+    from cxxnet_tpu.io.data import DataBatch
+
+    tr = NetTrainer()
+    tr.set_params(C.parse_pairs(cfg))
+    tr.init_model()
+    rng = np.random.RandomState(0)
+    b = DataBatch(
+        data=rng.randn(8, 4).astype(np.float32),
+        label=np.zeros((8, 1), np.float32),
+        extra_data=[rng.randn(8, 3).astype(np.float32)],
+    )
+    tr.update(b)  # must not raise
+    assert tr.epoch_counter == 1
+    out = tr.predict(b)
+    assert out.shape == (8,)

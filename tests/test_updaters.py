@@ -1,0 +1,143 @@
+"""Updater math + schedule tests vs closed-form references."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu.updater import create_updater
+from cxxnet_tpu.updater.param import UpdaterParam
+
+
+def test_sgd_matches_reference_recurrence():
+    up = create_updater("sgd", "wmat")
+    up.set_param("lr", "0.1")
+    up.set_param("momentum", "0.9")
+    up.set_param("wd", "0.01")
+    w = jnp.asarray([1.0, -2.0])
+    st = up.init_state(w)
+    g = jnp.asarray([0.5, 0.5])
+    m = np.zeros(2)
+    wr = np.array([1.0, -2.0])
+    for t in range(3):
+        w, st = up.apply(w, g, st, jnp.asarray(t))
+        m = 0.9 * m - 0.1 * (np.asarray(g) + 0.01 * wr)
+        wr = wr + m
+        np.testing.assert_allclose(np.asarray(w), wr, rtol=1e-6)
+
+
+def test_sgd_nan_zeroed_with_clip():
+    up = create_updater("sgd", "wmat")
+    up.set_param("lr", "1.0")
+    up.set_param("momentum", "0.0")
+    up.set_param("clip_gradient", "0.2")
+    w = jnp.asarray([0.0, 0.0, 0.0])
+    st = up.init_state(w)
+    g = jnp.asarray([jnp.nan, 5.0, -5.0])
+    w2, _ = up.apply(w, g, st, jnp.asarray(0))
+    np.testing.assert_allclose(np.asarray(w2), [0.0, -0.2, 0.2], atol=1e-7)
+
+
+def test_sgd_nan_propagates_without_clip():
+    up = create_updater("sgd", "wmat")
+    w = jnp.asarray([0.0])
+    st = up.init_state(w)
+    w2, _ = up.apply(w, jnp.asarray([jnp.nan]), st, jnp.asarray(0))
+    assert np.isnan(np.asarray(w2)).all()
+
+
+def test_nag_matches_reference_recurrence():
+    up = create_updater("nag", "wmat")
+    up.set_param("lr", "0.1")
+    up.set_param("momentum", "0.9")
+    w = jnp.asarray([1.0])
+    st = up.init_state(w)
+    g = jnp.asarray([1.0])
+    m = np.zeros(1)
+    wr = np.array([1.0])
+    for t in range(3):
+        w, st = up.apply(w, g, st, jnp.asarray(t))
+        old = m.copy()
+        m = 0.9 * m - 0.1 * np.asarray(g)
+        wr = wr + (1 + 0.9) * m - 0.9 * old
+        np.testing.assert_allclose(np.asarray(w), wr, rtol=1e-6)
+
+
+def test_adam_matches_reference_recurrence():
+    up = create_updater("adam", "wmat")
+    up.set_param("lr", "0.01")
+    up.set_param("wd", "0.1")
+    w = jnp.asarray([2.0])
+    st = up.init_state(w)
+    g0 = jnp.asarray([1.0])
+    m1 = np.zeros(1)
+    m2 = np.zeros(1)
+    wr = np.array([2.0])
+    d1, d2 = 0.1, 0.001
+    for t in range(3):
+        w, st = up.apply(w, g0, st, jnp.asarray(t))
+        g = np.asarray(g0) - 0.1 * wr  # reference: wd subtracted
+        fix1 = 1 - (1 - d1) ** (t + 1)
+        fix2 = 1 - (1 - d2) ** (t + 1)
+        lr_t = 0.01 * np.sqrt(fix2) / fix1
+        m1 = m1 + d1 * (g - m1)
+        m2 = m2 + d2 * (g * g - m2)
+        wr = wr - lr_t * (m1 / (np.sqrt(m2) + 1e-8))
+        np.testing.assert_allclose(np.asarray(w), wr, rtol=1e-5)
+
+
+def test_lr_schedules():
+    p = UpdaterParam("wmat")
+    p.set_param("lr", "0.1")
+    p.set_param("lr:schedule", "expdecay")
+    p.set_param("lr:gamma", "0.1")
+    p.set_param("lr:step", "100")
+    np.testing.assert_allclose(float(p.learning_rate(0)), 0.1, rtol=1e-5)
+    np.testing.assert_allclose(float(p.learning_rate(100)), 0.01, rtol=1e-5)
+    np.testing.assert_allclose(float(p.learning_rate(50)), 0.1 * 0.1 ** 0.5, rtol=1e-5)
+
+    p2 = UpdaterParam("")
+    p2.set_param("eta", "1.0")
+    p2.set_param("lr:schedule", "factor")
+    p2.set_param("lr:factor", "0.5")
+    p2.set_param("lr:step", "10")
+    np.testing.assert_allclose(float(p2.learning_rate(25)), 0.25, rtol=1e-5)
+    # lr_minimum floor
+    p2.set_param("lr:minimum_lr", "0.3")
+    np.testing.assert_allclose(float(p2.learning_rate(25)), 0.3, rtol=1e-5)
+
+    p3 = UpdaterParam("")
+    p3.set_param("lr", "1.0")
+    p3.set_param("lr:schedule", "polydecay")
+    p3.set_param("lr:gamma", "1.0")
+    p3.set_param("lr:alpha", "1.0")
+    p3.set_param("lr:step", "1")
+    np.testing.assert_allclose(float(p3.learning_rate(3)), 0.25, rtol=1e-5)
+
+
+def test_tag_scoped_overrides():
+    pw = UpdaterParam("wmat")
+    pb = UpdaterParam("bias")
+    for p in (pw, pb):
+        p.set_param("lr", "0.01")
+        p.set_param("wmat:lr", "0.5")
+        p.set_param("bias:wd", "0.25")
+    assert pw.base_lr == 0.5
+    assert pb.base_lr == 0.01
+    assert pb.wd == 0.25
+    assert pw.wd == 0.0
+
+
+def test_momentum_saturation_ramp():
+    p = UpdaterParam("")
+    p.set_param("momentum_schedule", "1")
+    p.set_param("base_momentum", "0.5")
+    p.set_param("final_momentum", "0.9")
+    p.set_param("saturation_epoch", "100")
+    np.testing.assert_allclose(float(p.momentum_at(0)), 0.5, rtol=1e-5)
+    np.testing.assert_allclose(float(p.momentum_at(50)), 0.7, rtol=1e-5)
+    np.testing.assert_allclose(float(p.momentum_at(1000)), 0.9, rtol=1e-5)
+
+
+def test_unknown_updater():
+    with pytest.raises(ValueError):
+        create_updater("lbfgs", "wmat")
