@@ -39,6 +39,7 @@ import numpy as np
 from .. import config as cfgmod
 from ..io.data import DataBatch
 from ..layers import LossLayer
+from ..parallel import MeshPlan, make_mesh
 from ..updater import Updater, create_updater
 from ..utils.metric import MetricSet
 from .graph import NetGraph
@@ -64,6 +65,8 @@ class NetTrainer:
         self.silent = 0
         self.seed = 0
         self.dev = "tpu"
+        self.model_parallel = 1
+        self.mesh_plan: Optional[MeshPlan] = None
         self.metric = MetricSet()
         self.train_metric = MetricSet()
         self._grad_accum = None
@@ -84,6 +87,8 @@ class NetTrainer:
             self.seed = int(val)
         elif name == "dev":
             self.dev = val
+        elif name == "model_parallel":
+            self.model_parallel = int(val)
         if self.metric.try_add_from_config(name, val):
             self.train_metric.try_add_from_config(name, val)
         self.cfg.append((name, val))
@@ -100,6 +105,7 @@ class NetTrainer:
             graph = NetGraph()
         graph.configure(self.cfg)
         self.graph = graph
+        self._jit_cache.clear()  # drop closures over any previous net/mesh
         self.net = FunctionalNet(graph)
         if self.net.batch_size:
             self.batch_size = self.net.batch_size
@@ -129,6 +135,7 @@ class NetTrainer:
 
     def init_model(self) -> None:
         self._build_net()
+        self._build_mesh()
         self._rng_key = jax.random.PRNGKey(self.seed)
         self._rng_key, sub = jax.random.split(self._rng_key)
         self.params = self.net.init_params(sub, self.batch_size)
@@ -137,8 +144,87 @@ class NetTrainer:
         self.sample_counter = 0
         self._grad_accum = None
 
+    def _build_mesh(self) -> None:
+        """dev=tpu:0-3 → ('data','model') mesh; the mshadow-ps replacement."""
+        if self.model_parallel != 1:
+            raise ValueError(
+                "model_parallel>1: tensor-parallel shardings are not wired "
+                "into the layer zoo yet; use data parallelism (dev=tpu:0-N)"
+            )
+        self.mesh_plan = make_mesh(self.dev, self.model_parallel)
+        if self.batch_size:
+            self.mesh_plan.check_batch(self.batch_size)
+
+    def _sh(self):
+        """(replicated, data-sharded) NamedShardings for the current mesh."""
+        plan = self.mesh_plan
+        if plan is None:
+            self._build_mesh()
+            plan = self.mesh_plan
+        return plan.replicated(), plan.data_sharding()
+
     # ------------------------------------------------------------------
     # jitted step functions (built lazily, cached per (train, accum) kind)
+    def _n_extras(self) -> int:
+        return self.graph.extra_data_num if self.graph else 0
+
+    @staticmethod
+    def _apply_updates(updaters, params, ustates, grads, epoch):
+        """Per-tensor updater math over the param pytree (trace-time loop)."""
+        new_p = {}
+        new_s = {}
+        for key, tags in params.items():
+            new_p[key] = {}
+            new_s[key] = {}
+            for tag, w in tags.items():
+                up = updaters[(key, tag)]
+                w2, s2 = up.apply(w, grads[key][tag], ustates[key][tag], epoch)
+                new_p[key][tag] = w2
+                new_s[key][tag] = s2
+        return new_p, new_s
+
+    def _loss_and_out(self, params, data, labels, rng, epoch, extras):
+        """(loss, out_node) with train=True — shared by fused/fwd_train."""
+        net = self.net
+        nodes, loss = net.forward(
+            params, data, labels=labels, extras=extras,
+            train=True, rng=rng, step=epoch,
+        )
+        return loss, nodes[net.out_node_index()]
+
+    def _fused_step_fn(self):
+        """fwd + bwd + updater math as ONE donated SPMD program.
+
+        Used when ``update_period == 1`` (the common case): XLA sees the
+        whole step, fuses update math into backprop epilogues, and
+        overlaps the data-parallel gradient all-reduce with backprop —
+        the reference needed AsyncUpdater priorities for this
+        (``async_updater-inl.hpp:94-127``); here it is the latency-hiding
+        scheduler's job.
+        """
+        if "fused" not in self._jit_cache:
+            updaters = dict(self.updaters)
+            rep, dsh = self._sh()
+            ex = (dsh,) * self._n_extras()
+            loss_and_out = self._loss_and_out
+            apply_updates = self._apply_updates
+
+            def step(params, ustates, data, labels, rng, epoch, extras):
+                (loss, out), grads = jax.value_and_grad(
+                    lambda p: loss_and_out(p, data, labels, rng, epoch, extras),
+                    has_aux=True,
+                )(params)
+                new_p, new_s = apply_updates(updaters, params, ustates, grads, epoch)
+                return new_p, new_s, loss, out
+
+            self._jit_cache["fused"] = jax.jit(
+                step,
+                in_shardings=(rep, rep, dsh, dsh, rep, rep, ex),
+                out_shardings=(rep, rep, rep, dsh),
+                donate_argnums=(0, 1),
+            )
+        return self._jit_cache["fused"]
+
     def _grad_fn(self):
         if "grad" not in self._jit_cache:
             net = self.net
@@ -148,27 +234,34 @@ class NetTrainer:
                     params, data, labels, train=True, rng=rng, step=step, extras=extras
                 )
 
-            self._jit_cache["grad"] = jax.jit(jax.value_and_grad(loss_fn))
+            rep, dsh = self._sh()
+            ex = (dsh,) * self._n_extras()
+            self._jit_cache["grad"] = jax.jit(
+                jax.value_and_grad(loss_fn),
+                in_shardings=(rep, dsh, dsh, rep, rep, ex),
+                out_shardings=(rep, rep),
+            )
         return self._jit_cache["grad"]
 
     def _fwd_train_fn(self):
         """value_and_grad + output node (for eval_train metrics)."""
         if "fwd_train" not in self._jit_cache:
-            net = self.net
-            out_idx = net.out_node_index()
+            loss_and_out = self._loss_and_out
 
             def f(params, data, labels, rng, step, extras):
-                def loss_only(p):
-                    nodes, loss = net.forward(
-                        p, data, labels=labels, extras=extras,
-                        train=True, rng=rng, step=step,
-                    )
-                    return loss, nodes[out_idx]
-
-                (loss, out), grads = jax.value_and_grad(loss_only, has_aux=True)(params)
+                (loss, out), grads = jax.value_and_grad(
+                    lambda p: loss_and_out(p, data, labels, rng, step, extras),
+                    has_aux=True,
+                )(params)
                 return loss, out, grads
 
-            self._jit_cache["fwd_train"] = jax.jit(f)
+            rep, dsh = self._sh()
+            ex = (dsh,) * self._n_extras()
+            self._jit_cache["fwd_train"] = jax.jit(
+                f,
+                in_shardings=(rep, dsh, dsh, rep, rep, ex),
+                out_shardings=(rep, dsh, rep),
+            )
         return self._jit_cache["fwd_train"]
 
     def _eval_fn(self):
@@ -180,7 +273,11 @@ class NetTrainer:
                 nodes, _ = net.forward(params, data, extras=extras, train=False)
                 return nodes[out_idx]
 
-            self._jit_cache["eval"] = jax.jit(f)
+            rep, dsh = self._sh()
+            ex = (dsh,) * self._n_extras()
+            self._jit_cache["eval"] = jax.jit(
+                f, in_shardings=(rep, dsh, ex), out_shardings=dsh
+            )
         return self._jit_cache["eval"]
 
     def _node_fn(self, node_id: int):
@@ -192,25 +289,20 @@ class NetTrainer:
                 nodes, _ = net.forward(params, data, extras=extras, train=False)
                 return nodes[node_id]
 
-            self._jit_cache[key] = jax.jit(f)
+            rep, dsh = self._sh()
+            ex = (dsh,) * self._n_extras()
+            self._jit_cache[key] = jax.jit(
+                f, in_shardings=(rep, dsh, ex), out_shardings=dsh
+            )
         return self._jit_cache[key]
 
     def _apply_fn(self):
         if "apply" not in self._jit_cache:
             updaters = dict(self.updaters)
+            apply_updates = self._apply_updates
 
             def f(params, ustates, grads, epoch):
-                new_p = {}
-                new_s = {}
-                for key, tags in params.items():
-                    new_p[key] = {}
-                    new_s[key] = {}
-                    for tag, w in tags.items():
-                        up = updaters[(key, tag)]
-                        w2, s2 = up.apply(w, grads[key][tag], ustates[key][tag], epoch)
-                        new_p[key][tag] = w2
-                        new_s[key][tag] = s2
-                return new_p, new_s
+                return apply_updates(updaters, params, ustates, grads, epoch)
 
             self._jit_cache["apply"] = jax.jit(f)
         return self._jit_cache["apply"]
@@ -230,6 +322,18 @@ class NetTrainer:
         labels = jnp.asarray(batch.label)
         extras = tuple(jnp.asarray(e) for e in batch.extra_data)
         step = jnp.asarray(self.epoch_counter, jnp.int32)
+        if self.update_period == 1:
+            # fused SPMD fast path: fwd+bwd+update in one donated program
+            self.params, self.ustates, loss, out = self._fused_step_fn()(
+                self.params, self.ustates, data, labels,
+                self._next_rng(), step, extras,
+            )
+            if self.eval_train:
+                self.train_metric.add_eval(
+                    np.asarray(out), np.asarray(batch.label), self._label_ranges()
+                )
+            self.epoch_counter += 1
+            return
         if self.eval_train:
             loss, out, grads = self._fwd_train_fn()(
                 self.params, data, labels, self._next_rng(), step, extras
@@ -401,6 +505,7 @@ class NetTrainer:
         header, raw = self._read_model_file(path)
         graph = NetGraph.structure_from_json(json.dumps(header["structure"]))
         self._build_net(graph)
+        self._build_mesh()
         self.epoch_counter = int(header["epoch_counter"])
         self.sample_counter = 0
         self._rng_key = jax.random.PRNGKey(self.seed + 1)

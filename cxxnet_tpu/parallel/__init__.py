@@ -1,0 +1,16 @@
+"""Device mesh + sharding: the TPU-native replacement for mshadow-ps.
+
+The reference scales by spawning one pthread + CUDA stream per GPU and
+combining gradients through a parameter server
+(``/root/reference/src/nnet/nnet_impl-inl.hpp:376-390``,
+``/root/reference/src/updater/async_updater-inl.hpp``).  Here the same
+``dev=tpu:0-3`` config line builds a ``jax.sharding.Mesh`` and the whole
+train step is ONE jitted SPMD program: the batch is sharded over the
+``data`` axis, parameters are replicated (or sharded over ``model`` for
+tensor parallelism), and XLA inserts the ICI all-reduce that replaces
+Push/PullReq — overlapped with backprop by the latency-hiding scheduler,
+which subsumes the reference's per-layer WFBP priorities
+(``updater_impl-inl.hpp:82``).
+"""
+
+from .mesh import MeshPlan, make_mesh, parse_device  # noqa: F401
