@@ -1,0 +1,60 @@
+"""Benchmark: GoogLeNet training throughput, images/sec/chip.
+
+Run on the real TPU chip (no JAX_PLATFORMS override).  Prints ONE JSON
+line: ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+Baseline: BASELINE.json north star = 2000 images/sec/chip (v5e).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 2000.0
+
+
+def main() -> None:
+    import jax
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+
+    from __graft_entry__ import _build_googlenet
+
+    tr = _build_googlenet(batch_size=batch, input_size=224, dev="tpu")
+    tr.eval_train = 0  # pure step time; no per-step metric fetch
+
+    rng = np.random.RandomState(0)
+    data = rng.randn(batch, 224, 224, 3).astype(np.float32)
+    labels = rng.randint(0, 1000, size=(batch, 1)).astype(np.float32)
+
+    # warmup / compile
+    for _ in range(3):
+        tr.update_all(data, labels)
+    jax.block_until_ready(tr.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        tr.update_all(data, labels)
+    jax.block_until_ready(tr.params)
+    dt = time.perf_counter() - t0
+
+    n_chips = max(1, tr.mesh_plan.n_devices if tr.mesh_plan else 1)
+    img_s = batch * steps / dt / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "images/sec/chip (GoogLeNet b{} train)".format(batch),
+                "value": round(img_s, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -118,6 +118,11 @@ class ImageBinIterator(InstIterator):
         self._rec_pos = 0
         self._out: Optional[DataInst] = None
         self._raw = 0  # raw float blobs instead of encoded images
+        self.native_decoder = 1  # C++ reader+decode pool when buildable
+        self.decode_thread = 0  # 0 = auto (ncpu - 2)
+        self._native = None  # NativePageReader
+        self._native_labels: List[Tuple[int, np.ndarray]] = []
+        self._native_pos = 0
 
     def set_param(self, name, val):
         if name in ("image_bin", "image_bin_x"):
@@ -134,6 +139,10 @@ class ImageBinIterator(InstIterator):
             self.dist_num_worker = int(val)
         elif name == "dist_worker_rank":
             self.dist_worker_rank = int(val)
+        elif name == "native_decoder":
+            self.native_decoder = int(val)
+        elif name == "decode_thread":
+            self.decode_thread = int(val)
 
     def init(self):
         # PS_RANK env parity (iter_thread_imbin_x-inl.hpp:110-113)
@@ -152,6 +161,19 @@ class ImageBinIterator(InstIterator):
                 if i % self.dist_num_worker == self.dist_worker_rank
             ] or shards  # fewer shards than workers: everyone reads all
         self._shards = shards
+        if self.native_decoder and not self._raw:
+            try:
+                from .native import NativePageReader, available
+
+                if available():
+                    self._native = NativePageReader(
+                        [b for b, _ in shards], self.decode_thread
+                    )
+                    self._native_labels = []
+                    for _, lst in shards:
+                        self._native_labels.extend(self._load_labels(lst))
+            except Exception:
+                self._native = None  # pure-Python fallback
         self.before_first()
 
     def _load_labels(self, lst_path: str) -> List[Tuple[int, np.ndarray]]:
@@ -164,6 +186,10 @@ class ImageBinIterator(InstIterator):
         return out
 
     def before_first(self):
+        if self._native is not None:
+            self._native.reset()
+            self._native_pos = 0
+            return
         self._shard_pos = 0
         self._open_shard(0)
 
@@ -177,6 +203,22 @@ class ImageBinIterator(InstIterator):
             self._page_iter = None
 
     def next(self) -> bool:
+        if self._native is not None:
+            rec = self._native.next()
+            if rec is None:
+                return False
+            kind, payload = rec
+            if kind == 1:
+                data = np.asarray(payload, np.float32)
+            else:
+                data = decode_image(payload)  # non-JPEG: PIL fallback
+            idx, labels = self._native_labels[self._native_pos]
+            self._native_pos += 1
+            self._out = DataInst(idx, data, labels)
+            return True
+        return self._next_python()
+
+    def _next_python(self) -> bool:
         while True:
             if self._page_iter is None:
                 return False

@@ -1,0 +1,132 @@
+"""ctypes binding for the native IO pipeline (``native/cxxnet_io.cc``).
+
+The native library plays the role of the reference's ThreadBuffer page +
+decode threads (``iter_thread_imbin_x-inl.hpp:203-354``): a C++ reader
+thread streams CXBP pages while a libjpeg decode pool converts blobs to
+HWC uint8, re-ordered to .lst order.  Python sees a simple pull
+iterator.  Falls back gracefully: ``available()`` is False when the
+shared library can't be built (no g++/libjpeg), and records the C++ side
+couldn't decode (non-JPEG) come back as raw blobs for PIL.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libcxxnet_io.so"))
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(
+                ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                check=True, capture_output=True, timeout=120,
+            )
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.cxio_open.restype = ctypes.c_void_p
+    lib.cxio_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.cxio_reset.argtypes = [ctypes.c_void_p]
+    lib.cxio_next.restype = ctypes.c_int
+    lib.cxio_next.argtypes = [ctypes.c_void_p]
+    lib.cxio_kind.restype = ctypes.c_int
+    lib.cxio_kind.argtypes = [ctypes.c_void_p]
+    lib.cxio_shape.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.cxio_size.restype = ctypes.c_long
+    lib.cxio_size.argtypes = [ctypes.c_void_p]
+    lib.cxio_copy.restype = ctypes.c_long
+    lib.cxio_copy.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long
+    ]
+    lib.cxio_close.argtypes = [ctypes.c_void_p]
+    lib.cxio_error.restype = ctypes.c_char_p
+    lib.cxio_error.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativePageReader:
+    """Ordered record stream over CXBP shards, decoded off-thread.
+
+    ``next()`` returns ``(kind, payload)``: kind 1 → HWC uint8 ndarray;
+    kind 0 → raw ``bytes`` for the caller to decode.
+    """
+
+    def __init__(self, bin_paths: List[str], n_decode: int = 0) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native IO library unavailable")
+        if n_decode <= 0:
+            n_decode = max(2, (os.cpu_count() or 4) - 2)
+        joined = "\n".join(bin_paths).encode("utf-8")
+        self._lib = lib
+        self._h = lib.cxio_open(joined, n_decode)
+        if not self._h:
+            raise ValueError(f"cxio_open failed for {bin_paths}")
+
+    def reset(self) -> None:
+        self._lib.cxio_reset(self._h)
+
+    def next(self) -> Optional[Tuple[int, object]]:
+        lib = self._lib
+        if not lib.cxio_next(self._h):
+            # distinguish clean EOF from a reader failure: a missing or
+            # corrupt shard must raise (silent truncation would misalign
+            # records with .lst labels), matching the Python path's errors
+            err = lib.cxio_error(self._h)
+            if err:
+                raise RuntimeError(err.decode("utf-8", "replace"))
+            return None
+        kind = lib.cxio_kind(self._h)
+        size = lib.cxio_size(self._h)
+        buf = np.empty(size, np.uint8)
+        got = lib.cxio_copy(
+            self._h, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)), size
+        )
+        if got != size:
+            raise RuntimeError("cxio_copy size mismatch")
+        if kind == 1:
+            h = ctypes.c_int()
+            w = ctypes.c_int()
+            c = ctypes.c_int()
+            lib.cxio_shape(self._h, h, w, c)
+            return 1, buf.reshape(h.value, w.value, c.value)
+        return 0, buf.tobytes()
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.cxio_close(self._h)
+            self._h = None
+
+    def __del__(self) -> None:  # pragma: no cover - finalizer
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -34,15 +34,27 @@ from jax import lax
 from .base import Layer, Params, Shape, register
 
 
-def _ceil_pool_shape(in_size: int, k: int, s: int) -> int:
-    """Reference pooling output size (pooling_layer-inl.hpp:100-104)."""
-    return min(in_size - k + s - 1, in_size - 1) // s + 1
+def _ceil_pool_shape(in_size: int, k: int, s: int, p: int = 0) -> int:
+    """Reference pooling output size (pooling_layer-inl.hpp:100-104).
+
+    ``p=0`` is the exact reference formula (it has no pooling pad).  With
+    ``p>0`` (a framework extension needed for inception-style same-size
+    pool branches) the shape follows the caffe convention the reference's
+    formula derives from: ceil((in+2p-k)/s)+1, clipped so the last window
+    starts inside the (left-padded) input.
+    """
+    if p == 0:
+        return min(in_size - k + s - 1, in_size - 1) // s + 1
+    out = (in_size + 2 * p - k + s - 1) // s + 1
+    if (out - 1) * s >= in_size + p:
+        out -= 1
+    return out
 
 
-def _pool_pad(in_size: int, k: int, s: int) -> int:
-    """Right/bottom padding so VALID windows realize the ceil shape."""
-    out = _ceil_pool_shape(in_size, k, s)
-    return max(0, (out - 1) * s + k - in_size)
+def _pool_pad(in_size: int, k: int, s: int, p: int = 0) -> Tuple[int, int]:
+    """(left, right) padding so VALID windows realize the ceil shape."""
+    out = _ceil_pool_shape(in_size, k, s, p)
+    return p, max(0, (out - 1) * s + k - in_size - p)
 
 
 @register
@@ -62,7 +74,7 @@ class ConvolutionLayer(Layer):
             raise ValueError("must set nchannel correctly (divisible by ngroup)")
         if p.kernel_height <= 0 or p.kernel_width <= 0:
             raise ValueError("must set kernel_size correctly")
-        if p.kernel_width > w or p.kernel_height > h:
+        if p.kernel_width > w + 2 * p.pad_x or p.kernel_height > h + 2 * p.pad_y:
             raise ValueError("kernel size exceeds input")
         if p.num_input_channel == 0:
             p.num_input_channel = c
@@ -112,13 +124,13 @@ class _PoolBase(Layer):
         if p.kernel_height <= 0 or p.kernel_width <= 0:
             raise ValueError("must set kernel_size correctly")
         n, h, w, c = shape
-        if p.kernel_width > w or p.kernel_height > h:
+        if p.kernel_width > w + 2 * p.pad_x or p.kernel_height > h + 2 * p.pad_y:
             raise ValueError("kernel size exceeds input")
         return [
             (
                 n,
-                _ceil_pool_shape(h, p.kernel_height, p.stride),
-                _ceil_pool_shape(w, p.kernel_width, p.stride),
+                _ceil_pool_shape(h, p.kernel_height, p.stride, p.pad_y),
+                _ceil_pool_shape(w, p.kernel_width, p.stride, p.pad_x),
                 c,
             )
         ]
@@ -126,15 +138,17 @@ class _PoolBase(Layer):
     def _pool(self, x: jnp.ndarray, reducer, init_val) -> jnp.ndarray:
         p = self.param
         h, w = x.shape[1], x.shape[2]
-        pad_h = _pool_pad(h, p.kernel_height, p.stride)
-        pad_w = _pool_pad(w, p.kernel_width, p.stride)
+        pad_h = _pool_pad(h, p.kernel_height, p.stride, p.pad_y)
+        pad_w = _pool_pad(w, p.kernel_width, p.stride, p.pad_x)
+        # init must stay a Python-scalar literal: a traced array init
+        # defeats reduce_window's monoid-recognition and kills autodiff
         return lax.reduce_window(
             x,
-            jnp.asarray(init_val, x.dtype),
+            x.dtype.type(init_val),
             reducer,
             window_dimensions=(1, p.kernel_height, p.kernel_width, 1),
             window_strides=(1, p.stride, p.stride, 1),
-            padding=((0, 0), (0, pad_h), (0, pad_w), (0, 0)),
+            padding=((0, 0), pad_h, pad_w, (0, 0)),
         )
 
 
@@ -246,9 +260,10 @@ class LRNLayer(Layer):
         half = self.nsize // 2
         # cross-channel sum of squares over a window of nsize channels
         sq = x * x
+        # literal init (see _pool): traced init breaks reduce_window autodiff
         norm_win = lax.reduce_window(
             sq,
-            jnp.asarray(0.0, x.dtype),
+            sq.dtype.type(0.0),
             lax.add,
             window_dimensions=(1, 1, 1, self.nsize),
             window_strides=(1, 1, 1, 1),

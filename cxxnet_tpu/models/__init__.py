@@ -1,0 +1,35 @@
+"""Model zoo: reference-format ``.conf`` builders.
+
+The reference ships models *as config files* (``example/MNIST``,
+``example/ImageNet``, ``example/kaggle_bowl``); this package generates the
+same networks programmatically in the identical config grammar, so they
+run through the normal config → graph → jit pipeline.  Builders return
+conf *text*; feed it to ``cxxnet_tpu.config.parse_string`` / the CLI.
+
+Parity sources (structure, hyper-parameters, schedules):
+* MNIST MLP — ``/root/reference/example/MNIST/MNIST.conf``
+* MNIST conv (LeNet-style) — ``/root/reference/example/MNIST/MNIST_CONV.conf``
+* AlexNet — ``/root/reference/example/ImageNet/ImageNet.conf``
+* kaggle plankton — ``/root/reference/example/kaggle_bowl/bowl.conf``
+* GoogLeNet / VGG-16 — not shipped by the reference (its README names
+  them as goals); built here from the papers as the benchmark models
+  (BASELINE.json: images/sec/chip on GoogLeNet).
+"""
+
+from .builders import (  # noqa: F401
+    alexnet_conf,
+    googlenet_conf,
+    kaggle_bowl_conf,
+    mnist_conv_conf,
+    mnist_mlp_conf,
+    vgg16_conf,
+)
+
+MODEL_BUILDERS = {
+    "mnist_mlp": mnist_mlp_conf,
+    "mnist_conv": mnist_conv_conf,
+    "alexnet": alexnet_conf,
+    "googlenet": googlenet_conf,
+    "vgg16": vgg16_conf,
+    "kaggle_bowl": kaggle_bowl_conf,
+}

@@ -1,0 +1,386 @@
+"""Conf-text builders for the model zoo.  See package docstring."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def _iter_block(
+    kind: str, nsample: int, input_shape: str, nclass: int, threadbuffer: bool = False
+) -> str:
+    """A synthetic data/eval section (benchmarks; real runs swap in
+    mnist/imgbin sections with the same keys)."""
+    tb = "iter = threadbuffer\n" if threadbuffer else ""
+    return (
+        f"{kind} = {'train' if kind == 'data' else 'test'}\n"
+        "iter = synthetic\n"
+        f"  nsample = {nsample}\n"
+        f"  input_shape = {input_shape}\n"
+        f"  nclass = {nclass}\n"
+        "  label_width = 1\n"
+        f"{tb}iter = end\n"
+    )
+
+
+def _tail(
+    batch_size: int,
+    input_shape: str,
+    num_round: int,
+    eta: float = 0.01,
+    extra: str = "",
+    dev: str = "tpu",
+) -> str:
+    return (
+        f"input_shape = {input_shape}\n"
+        f"batch_size = {batch_size}\n"
+        f"dev = {dev}\n"
+        f"num_round = {num_round}\n"
+        f"max_round = {num_round}\n"
+        "updater = sgd\n"
+        f"eta = {eta}\n"
+        "momentum = 0.9\n"
+        "wd = 0.0005\n"
+        "metric = error\n"
+        "eval_train = 1\n"
+        "print_step = 100\n"
+        f"{extra}"
+    )
+
+
+# ---------------------------------------------------------------------------
+def mnist_mlp_conf(
+    batch_size: int = 100, synthetic: bool = True, dev: str = "tpu"
+) -> str:
+    """3-layer MLP (MNIST.conf parity: fullc 160 → sigmoid → fullc 10)."""
+    data = (
+        _iter_block("data", 6400, "1,1,784", 10)
+        + _iter_block("eval", 1600, "1,1,784", 10)
+        if synthetic
+        else ""
+    )
+    return data + (
+        "netconfig = start\n"
+        "layer[0->1] = fullc:fc1\n"
+        "  nhidden = 160\n"
+        "  init_sigma = 0.01\n"
+        "layer[1->2] = sigmoid:se1\n"
+        "layer[2->3] = fullc:fc2\n"
+        "  nhidden = 10\n"
+        "  init_sigma = 0.01\n"
+        "layer[3->3] = softmax\n"
+        "netconfig = end\n"
+    ) + _tail(batch_size, "1,1,784", 15, eta=0.1, dev=dev, extra="wd = 0.0\n")
+
+
+def mnist_conv_conf(
+    batch_size: int = 100, synthetic: bool = True, dev: str = "tpu"
+) -> str:
+    """LeNet-style conv net (MNIST_CONV.conf parity)."""
+    data = (
+        _iter_block("data", 6400, "1,28,28", 10)
+        + _iter_block("eval", 1600, "1,28,28", 10)
+        if synthetic
+        else ""
+    )
+    return data + (
+        "netconfig = start\n"
+        "layer[0->1] = conv:cv1\n"
+        "  kernel_size = 3\n"
+        "  pad = 1\n"
+        "  stride = 2\n"
+        "  nchannel = 32\n"
+        "  random_type = xavier\n"
+        "  no_bias = 0\n"
+        "layer[1->2] = max_pooling\n"
+        "  kernel_size = 3\n"
+        "  stride = 2\n"
+        "layer[2->3] = flatten\n"
+        "layer[3->3] = dropout\n"
+        "  threshold = 0.5\n"
+        "layer[3->4] = fullc:fc1\n"
+        "  nhidden = 100\n"
+        "  init_sigma = 0.01\n"
+        "layer[4->5] = sigmoid:se1\n"
+        "layer[5->6] = fullc:fc2\n"
+        "  nhidden = 10\n"
+        "  init_sigma = 0.01\n"
+        "layer[6->6] = softmax\n"
+        "netconfig = end\n"
+    ) + _tail(batch_size, "1,28,28", 15, eta=0.1, dev=dev, extra="wd = 0.0\n")
+
+
+# ---------------------------------------------------------------------------
+def alexnet_conf(
+    batch_size: int = 256,
+    num_class: int = 1000,
+    synthetic: bool = True,
+    nsample: int = 0,
+    dev: str = "tpu",
+) -> str:
+    """AlexNet (ImageNet.conf parity: grouped convs, LRN, dropout FCs)."""
+    shape = "3,227,227"
+    nsample = nsample or batch_size * 4
+    data = (
+        _iter_block("data", nsample, shape, num_class, threadbuffer=True)
+        + _iter_block("eval", batch_size * 2, shape, num_class)
+        if synthetic
+        else ""
+    )
+    lrn = (
+        "  local_size = 5\n"
+        "  alpha = 0.001\n"
+        "  beta = 0.75\n"
+        "  knorm = 1\n"
+    )
+    net = (
+        "netconfig = start\n"
+        "layer[0->1] = conv:conv1\n"
+        "  kernel_size = 11\n  stride = 4\n  nchannel = 96\n"
+        "layer[1->2] = relu\n"
+        "layer[2->3] = max_pooling\n  kernel_size = 3\n  stride = 2\n"
+        "layer[3->4] = lrn\n" + lrn +
+        "layer[4->5] = conv:conv2\n"
+        "  ngroup = 2\n  nchannel = 256\n  kernel_size = 5\n  pad = 2\n"
+        "layer[5->6] = relu\n"
+        "layer[6->7] = max_pooling\n  kernel_size = 3\n  stride = 2\n"
+        "layer[7->8] = lrn\n" + lrn +
+        "layer[8->9] = conv:conv3\n"
+        "  nchannel = 384\n  kernel_size = 3\n  pad = 1\n"
+        "layer[9->10] = relu\n"
+        "layer[10->11] = conv:conv4\n"
+        "  nchannel = 384\n  ngroup = 2\n  kernel_size = 3\n  pad = 1\n"
+        "layer[11->12] = relu\n"
+        "layer[12->13] = conv:conv5\n"
+        "  nchannel = 256\n  ngroup = 2\n  kernel_size = 3\n  pad = 1\n"
+        "  init_bias = 1.0\n"
+        "layer[13->14] = relu\n"
+        "layer[14->15] = max_pooling\n  kernel_size = 3\n  stride = 2\n"
+        "layer[15->16] = flatten\n"
+        "layer[16->17] = fullc:fc6\n"
+        "  nhidden = 4096\n  init_sigma = 0.005\n  init_bias = 1.0\n"
+        "layer[17->18] = relu\n"
+        "layer[18->18] = dropout\n  threshold = 0.5\n"
+        "layer[18->19] = fullc:fc7\n"
+        "  nhidden = 4096\n  init_sigma = 0.005\n  init_bias = 1.0\n"
+        "layer[19->20] = relu\n"
+        "layer[20->20] = dropout\n  threshold = 0.5\n"
+        f"layer[20->21] = fullc:fc8\n  nhidden = {num_class}\n"
+        "layer[21->21] = softmax\n"
+        "netconfig = end\n"
+    )
+    extra = (
+        "metric = rec@1\nmetric = rec@5\n"
+        "wmat:lr = 0.01\nwmat:wd = 0.0005\n"
+        "bias:wd = 0.000\nbias:lr = 0.02\n"
+        "lr:schedule = expdecay\nlr:gamma = 0.1\nlr:step = 100000\n"
+    )
+    return data + net + _tail(batch_size, shape, 45, eta=0.01, dev=dev, extra=extra)
+
+
+# ---------------------------------------------------------------------------
+def _inception(x: str, m: str, c1: int, c3r: int, c3: int, c5r: int, c5: int,
+               cp: int) -> str:
+    """One GoogLeNet inception module: 4 branches ch_concat'd to node m."""
+
+    def conv(src: str, dst: str, tag: str, k: int, ch: int, pad: int) -> str:
+        return (
+            f"layer[{src}->{dst}] = conv:{tag}\n"
+            f"  kernel_size = {k}\n  nchannel = {ch}\n  pad = {pad}\n"
+            "  random_type = xavier\n"
+        )
+
+    s = conv(x, f"{m}_c1", f"{m}_1x1", 1, c1, 0)
+    s += f"layer[+1:{m}_b1] = relu\n"
+    s += conv(x, f"{m}_c3r", f"{m}_3x3r", 1, c3r, 0)
+    s += f"layer[+1:{m}_b2r] = relu\n"
+    s += conv(f"{m}_b2r", f"{m}_c3", f"{m}_3x3", 3, c3, 1)
+    s += f"layer[+1:{m}_b2] = relu\n"
+    s += conv(x, f"{m}_c5r", f"{m}_5x5r", 1, c5r, 0)
+    s += f"layer[+1:{m}_b3r] = relu\n"
+    s += conv(f"{m}_b3r", f"{m}_c5", f"{m}_5x5", 5, c5, 2)
+    s += f"layer[+1:{m}_b3] = relu\n"
+    s += (
+        f"layer[{x}->{m}_p] = max_pooling\n"
+        "  kernel_size = 3\n  stride = 1\n  pad = 1\n"
+    )
+    s += conv(f"{m}_p", f"{m}_pp", f"{m}_pool_proj", 1, cp, 0)
+    s += f"layer[+1:{m}_b4] = relu\n"
+    s += f"layer[{m}_b1,{m}_b2,{m}_b3,{m}_b4->{m}] = ch_concat\n"
+    return s
+
+
+def googlenet_conf(
+    batch_size: int = 128,
+    num_class: int = 1000,
+    input_size: int = 224,
+    synthetic: bool = True,
+    nsample: int = 0,
+    dev: str = "tpu",
+) -> str:
+    """GoogLeNet (inception v1) — the BASELINE.json benchmark model.
+
+    Szegedy et al. 2014, table 1; main classifier only (the two auxiliary
+    heads exist for vanishing-gradient relief the TPU build doesn't need
+    at this depth; they are train-time-only and dropped at inference).
+    """
+    shape = f"3,{input_size},{input_size}"
+    nsample = nsample or batch_size * 4
+    data = (
+        _iter_block("data", nsample, shape, num_class, threadbuffer=True)
+        + _iter_block("eval", batch_size * 2, shape, num_class)
+        if synthetic
+        else ""
+    )
+    lrn = (
+        "  local_size = 5\n  alpha = 0.0001\n  beta = 0.75\n  knorm = 1\n"
+    )
+    net = (
+        "netconfig = start\n"
+        "layer[0->c1] = conv:conv1\n"
+        "  kernel_size = 7\n  stride = 2\n  pad = 3\n  nchannel = 64\n"
+        "  random_type = xavier\n"
+        "layer[+1:c1r] = relu\n"
+        "layer[c1r->p1] = max_pooling\n  kernel_size = 3\n  stride = 2\n"
+        "layer[p1->n1] = lrn\n" + lrn +
+        "layer[n1->c2r] = conv:conv2_reduce\n"
+        "  kernel_size = 1\n  nchannel = 64\n  random_type = xavier\n"
+        "layer[+1:c2rr] = relu\n"
+        "layer[c2rr->c2] = conv:conv2\n"
+        "  kernel_size = 3\n  pad = 1\n  nchannel = 192\n"
+        "  random_type = xavier\n"
+        "layer[+1:c2a] = relu\n"
+        "layer[c2a->n2] = lrn\n" + lrn +
+        "layer[n2->p2] = max_pooling\n  kernel_size = 3\n  stride = 2\n"
+        + _inception("p2", "i3a", 64, 96, 128, 16, 32, 32)
+        + _inception("i3a", "i3b", 128, 128, 192, 32, 96, 64)
+        + "layer[i3b->p3] = max_pooling\n  kernel_size = 3\n  stride = 2\n"
+        + _inception("p3", "i4a", 192, 96, 208, 16, 48, 64)
+        + _inception("i4a", "i4b", 160, 112, 224, 24, 64, 64)
+        + _inception("i4b", "i4c", 128, 128, 256, 24, 64, 64)
+        + _inception("i4c", "i4d", 112, 144, 288, 32, 64, 64)
+        + _inception("i4d", "i4e", 256, 160, 320, 32, 128, 128)
+        + "layer[i4e->p4] = max_pooling\n  kernel_size = 3\n  stride = 2\n"
+        + _inception("p4", "i5a", 256, 160, 320, 32, 128, 128)
+        + _inception("i5a", "i5b", 384, 192, 384, 48, 128, 128)
+        + f"layer[i5b->pool5] = avg_pooling\n"
+        f"  kernel_size = {max(1, input_size // 32)}\n  stride = 1\n"
+        "layer[pool5->pool5] = dropout\n  threshold = 0.4\n"
+        "layer[pool5->flat] = flatten\n"
+        f"layer[flat->fc] = fullc:loss3_classifier\n"
+        f"  nhidden = {num_class}\n  random_type = xavier\n"
+        "layer[fc->fc] = softmax\n"
+        "netconfig = end\n"
+    )
+    extra = (
+        "metric = rec@1\nmetric = rec@5\n"
+        "wmat:lr = 0.01\nwmat:wd = 0.0002\n"
+        "bias:lr = 0.02\nbias:wd = 0.0\n"
+        "lr:schedule = polydecay\nlr:alpha = 0.5\nlr:max_round = 2400000\n"
+    )
+    return data + net + _tail(batch_size, shape, 100, eta=0.01, dev=dev, extra=extra)
+
+
+# ---------------------------------------------------------------------------
+def vgg16_conf(
+    batch_size: int = 64,
+    num_class: int = 1000,
+    input_size: int = 224,
+    synthetic: bool = True,
+    nsample: int = 0,
+    dev: str = "tpu",
+) -> str:
+    """VGG-16 (configuration D, Simonyan & Zisserman 2014)."""
+    shape = f"3,{input_size},{input_size}"
+    nsample = nsample or batch_size * 4
+    data = (
+        _iter_block("data", nsample, shape, num_class, threadbuffer=True)
+        + _iter_block("eval", batch_size * 2, shape, num_class)
+        if synthetic
+        else ""
+    )
+    blocks: List[str] = []
+    node = "0"
+    idx = 0
+    plan = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+    for b, (reps, ch) in enumerate(plan, start=1):
+        for r in range(1, reps + 1):
+            dst = f"c{b}_{r}"
+            blocks.append(
+                f"layer[{node}->{dst}] = conv:conv{b}_{r}\n"
+                f"  kernel_size = 3\n  pad = 1\n  nchannel = {ch}\n"
+                "  random_type = xavier\n"
+                f"layer[+1:{dst}r] = relu\n"
+            )
+            node = f"{dst}r"
+            idx += 1
+        blocks.append(
+            f"layer[{node}->pool{b}] = max_pooling\n"
+            "  kernel_size = 2\n  stride = 2\n"
+        )
+        node = f"pool{b}"
+    net = (
+        "netconfig = start\n"
+        + "".join(blocks)
+        + f"layer[{node}->flat] = flatten\n"
+        "layer[flat->f6] = fullc:fc6\n"
+        "  nhidden = 4096\n  init_sigma = 0.01\n"
+        "layer[+1:f6r] = relu\n"
+        "layer[f6r->f6r] = dropout\n  threshold = 0.5\n"
+        "layer[f6r->f7] = fullc:fc7\n"
+        "  nhidden = 4096\n  init_sigma = 0.01\n"
+        "layer[+1:f7r] = relu\n"
+        "layer[f7r->f7r] = dropout\n  threshold = 0.5\n"
+        f"layer[f7r->f8] = fullc:fc8\n  nhidden = {num_class}\n"
+        "  init_sigma = 0.01\n"
+        "layer[f8->f8] = softmax\n"
+        "netconfig = end\n"
+    )
+    extra = "metric = rec@1\nmetric = rec@5\n"
+    return data + net + _tail(batch_size, shape, 74, eta=0.01, dev=dev, extra=extra)
+
+
+# ---------------------------------------------------------------------------
+def kaggle_bowl_conf(
+    batch_size: int = 64, synthetic: bool = True, dev: str = "tpu"
+) -> str:
+    """NDSB plankton convnet (bowl.conf parity: 40×40×3, 121 classes)."""
+    shape = "3,40,40"
+    data = (
+        _iter_block("data", 3200, shape, 121)
+        + _iter_block("eval", 640, shape, 121)
+        if synthetic
+        else ""
+    )
+    net = (
+        "netconfig = start\n"
+        "layer[0->1] = conv:conv1\n"
+        "  kernel_size = 5\n  pad = 2\n  nchannel = 32\n"
+        "  random_type = xavier\n"
+        "layer[1->2] = relu\n"
+        "layer[2->3] = max_pooling\n  kernel_size = 3\n  stride = 2\n"
+        "layer[3->4] = conv:conv2\n"
+        "  kernel_size = 3\n  pad = 1\n  nchannel = 64\n"
+        "  random_type = xavier\n"
+        "layer[4->5] = relu\n"
+        "layer[5->6] = max_pooling\n  kernel_size = 3\n  stride = 2\n"
+        "layer[6->7] = conv:conv3\n"
+        "  kernel_size = 3\n  pad = 1\n  nchannel = 128\n"
+        "  random_type = xavier\n"
+        "layer[7->8] = relu\n"
+        "layer[8->9] = conv:conv4\n"
+        "  kernel_size = 3\n  pad = 1\n  nchannel = 128\n"
+        "  random_type = xavier\n"
+        "layer[9->10] = relu\n"
+        "layer[10->11] = max_pooling\n  kernel_size = 3\n  stride = 2\n"
+        "layer[11->12] = flatten\n"
+        "layer[12->13] = fullc:fc1\n"
+        "  nhidden = 512\n  init_sigma = 0.01\n"
+        "layer[13->14] = relu\n"
+        "layer[14->14] = dropout\n  threshold = 0.5\n"
+        "layer[14->15] = fullc:fc2\n"
+        "  nhidden = 121\n  init_sigma = 0.01\n"
+        "layer[15->15] = softmax\n"
+        "netconfig = end\n"
+    )
+    extra = "metric = logloss\n"
+    return data + net + _tail(batch_size, shape, 100, eta=0.01, dev=dev, extra=extra)

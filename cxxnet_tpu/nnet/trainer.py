@@ -156,12 +156,13 @@ class NetTrainer:
             self.mesh_plan.check_batch(self.batch_size)
 
     def _sh(self):
-        """(replicated, data-sharded) NamedShardings for the current mesh."""
+        """(replicated, data-sharded, per-extra) shardings for the mesh."""
         plan = self.mesh_plan
         if plan is None:
             self._build_mesh()
             plan = self.mesh_plan
-        return plan.replicated(), plan.data_sharding()
+        rep, dsh = plan.replicated(), plan.data_sharding()
+        return rep, dsh, (dsh,) * self._n_extras()
 
     # ------------------------------------------------------------------
     # jitted step functions (built lazily, cached per (train, accum) kind)
@@ -204,8 +205,7 @@ class NetTrainer:
         """
         if "fused" not in self._jit_cache:
             updaters = dict(self.updaters)
-            rep, dsh = self._sh()
-            ex = (dsh,) * self._n_extras()
+            rep, dsh, ex = self._sh()
             loss_and_out = self._loss_and_out
             apply_updates = self._apply_updates
 
@@ -234,8 +234,7 @@ class NetTrainer:
                     params, data, labels, train=True, rng=rng, step=step, extras=extras
                 )
 
-            rep, dsh = self._sh()
-            ex = (dsh,) * self._n_extras()
+            rep, dsh, ex = self._sh()
             self._jit_cache["grad"] = jax.jit(
                 jax.value_and_grad(loss_fn),
                 in_shardings=(rep, dsh, dsh, rep, rep, ex),
@@ -255,8 +254,7 @@ class NetTrainer:
                 )(params)
                 return loss, out, grads
 
-            rep, dsh = self._sh()
-            ex = (dsh,) * self._n_extras()
+            rep, dsh, ex = self._sh()
             self._jit_cache["fwd_train"] = jax.jit(
                 f,
                 in_shardings=(rep, dsh, dsh, rep, rep, ex),
@@ -273,8 +271,7 @@ class NetTrainer:
                 nodes, _ = net.forward(params, data, extras=extras, train=False)
                 return nodes[out_idx]
 
-            rep, dsh = self._sh()
-            ex = (dsh,) * self._n_extras()
+            rep, dsh, ex = self._sh()
             self._jit_cache["eval"] = jax.jit(
                 f, in_shardings=(rep, dsh, ex), out_shardings=dsh
             )
@@ -289,8 +286,7 @@ class NetTrainer:
                 nodes, _ = net.forward(params, data, extras=extras, train=False)
                 return nodes[node_id]
 
-            rep, dsh = self._sh()
-            ex = (dsh,) * self._n_extras()
+            rep, dsh, ex = self._sh()
             self._jit_cache[key] = jax.jit(
                 f, in_shardings=(rep, dsh, ex), out_shardings=dsh
             )
@@ -372,6 +368,25 @@ class NetTrainer:
         g = self.graph
         return {name: g.label_range[i] for name, i in g.label_name_map.items()}
 
+    def _run_sharded(self, fn, data: np.ndarray, extras=()) -> np.ndarray:
+        """Call a data-sharded jit, zero-padding a partial final batch to a
+        multiple of the data-axis size (the XLA-static-shapes analog of the
+        reference's AdjustBatchSize, SURVEY §7 hard part (f)) and trimming
+        the result."""
+        n = data.shape[0]
+        nd = self.mesh_plan.n_data if self.mesh_plan else 1
+        pad = (-n) % nd
+        if pad:
+            data = np.concatenate([data, np.zeros((pad,) + data.shape[1:],
+                                                  data.dtype)], axis=0)
+            extras = tuple(
+                np.concatenate([e, np.zeros((pad,) + e.shape[1:], e.dtype)], 0)
+                for e in extras
+            )
+        out = np.asarray(fn(self.params, jnp.asarray(data),
+                            tuple(jnp.asarray(e) for e in extras)))
+        return out[:n] if pad else out
+
     def evaluate(self, iter_eval, data_name: str) -> str:
         """Round-end evaluation; format parity ``\\tname-metric:value``."""
         ret = ""
@@ -387,9 +402,8 @@ class NetTrainer:
         iter_eval.before_first()
         while iter_eval.next():
             batch = iter_eval.value()
-            out = np.asarray(
-                fn(self.params, jnp.asarray(batch.data),
-                   tuple(jnp.asarray(e) for e in batch.extra_data))
+            out = self._run_sharded(
+                fn, np.asarray(batch.data), tuple(batch.extra_data)
             )
             n = batch.batch_size - batch.num_batch_padd
             self.metric.add_eval(out[:n], batch.label[:n], self._label_ranges())
@@ -398,11 +412,8 @@ class NetTrainer:
 
     def predict(self, batch: DataBatch) -> np.ndarray:
         """Per-instance prediction: argmax, or raw value for 1-col output."""
-        out = np.asarray(
-            self._eval_fn()(
-                self.params, jnp.asarray(batch.data),
-                tuple(jnp.asarray(e) for e in batch.extra_data),
-            )
+        out = self._run_sharded(
+            self._eval_fn(), np.asarray(batch.data), tuple(batch.extra_data)
         )
         out2d = out.reshape(out.shape[0], -1)
         if out2d.shape[1] == 1:
@@ -419,11 +430,10 @@ class NetTrainer:
             node_id = nnode - offset
         else:
             node_id = g.node_index_of(node_name)
-        out = self._node_fn(node_id)(
-            self.params, jnp.asarray(batch.data),
-            tuple(jnp.asarray(e) for e in batch.extra_data),
+        return self._run_sharded(
+            self._node_fn(node_id), np.asarray(batch.data),
+            tuple(batch.extra_data),
         )
-        return np.asarray(out)
 
     # ------------------------------------------------------------------
     # weight access (wrapper API parity: 2-D views, visitor tag scheme)

@@ -39,11 +39,13 @@ def parse_device(dev: str) -> Tuple[str, List[int]]:
         part = part.strip()
         if "-" in part:
             lo, hi = part.split("-", 1)
+            if int(hi) < int(lo):
+                raise ValueError(f"dev={dev!r}: reversed range {part!r}")
             ids.extend(range(int(lo), int(hi) + 1))
         elif part:
             ids.append(int(part))
     if not ids:
-        ids = [0]
+        raise ValueError(f"dev={dev!r}: empty device list")
     return plat, ids
 
 

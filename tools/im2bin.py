@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""im2bin: pack images listed in a .lst file into CXBP binary pages.
+
+Parity with the reference packer (``/root/reference/tools/im2bin.cpp``):
+
+    python tools/im2bin.py image.lst image_root output.bin
+
+``image.lst`` lines are ``index \t label(s) \t filename`` (tab-separated);
+``image_root`` is prefixed to each filename.  Blobs are stored as-is
+(JPEG bytes) in ~64MB pages; the reader decodes them off-thread
+(native/cxxnet_io.cc).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from cxxnet_tpu.io.imgbin import BinPageWriter, parse_lst_line  # noqa: E402
+
+
+def main(argv) -> int:
+    if len(argv) < 4:
+        print(__doc__)
+        return 1
+    lst_path, root, out_path = argv[1], argv[2], argv[3]
+    writer = BinPageWriter(out_path)
+    n = 0
+    with open(lst_path, "r", encoding="utf-8") as f:
+        for line in f:
+            if not line.strip():
+                continue
+            _, _, fname = parse_lst_line(line)
+            with open(os.path.join(root, fname), "rb") as img:
+                writer.push(img.read())
+            n += 1
+            if n % 1000 == 0:
+                print(f"packed {n} images", file=sys.stderr)
+    writer.close()
+    print(f"wrote {n} images to {out_path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
