@@ -11,3 +11,20 @@ reference's multi-GPU parameter server.
 __version__ = "0.1.0"
 
 from . import config  # noqa: F401
+
+# DataIter / Net / train pull in the trainer (and therefore JAX); keep the
+# package import light for IO-only consumers (tools/im2bin.py) by resolving
+# them lazily (PEP 562).
+_WRAPPER_EXPORTS = ("DataIter", "Net", "train")
+
+
+def __getattr__(name):
+    if name in _WRAPPER_EXPORTS:
+        from . import wrapper
+
+        return getattr(wrapper, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_WRAPPER_EXPORTS))

@@ -24,6 +24,7 @@ from __future__ import annotations
 import io as _io
 import os
 import struct
+import warnings
 from typing import IO, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -172,8 +173,13 @@ class ImageBinIterator(InstIterator):
                     self._native_labels = []
                     for _, lst in shards:
                         self._native_labels.extend(self._load_labels(lst))
-            except Exception:
-                self._native = None  # pure-Python fallback
+            except Exception as e:
+                if self._native is not None:
+                    self._native.close()  # stop reader/decode threads
+                    self._native = None
+                warnings.warn(
+                    f"imgbin: native decoder disabled, pure-Python fallback: {e}"
+                )
         self.before_first()
 
     def _load_labels(self, lst_path: str) -> List[Tuple[int, np.ndarray]]:

@@ -4,7 +4,7 @@ The reference ships models *as config files* (``example/MNIST``,
 ``example/ImageNet``, ``example/kaggle_bowl``); this package generates the
 same networks programmatically in the identical config grammar, so they
 run through the normal config → graph → jit pipeline.  Builders return
-conf *text*; feed it to ``cxxnet_tpu.config.parse_string`` / the CLI.
+conf *text*; feed it to ``cxxnet_tpu.config.parse_pairs`` / the CLI.
 
 Parity sources (structure, hyper-parameters, schedules):
 * MNIST MLP — ``/root/reference/example/MNIST/MNIST.conf``

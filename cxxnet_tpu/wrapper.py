@@ -1,0 +1,215 @@
+"""User-facing Python API: ``DataIter`` / ``Net`` / ``train``.
+
+Parity: the reference's ctypes wrapper (``/root/reference/wrapper/cxxnet.py``
+classes ``DataIter`` (:64), ``Net`` (:105), ``train`` (:281) over the C ABI in
+``/root/reference/wrapper/cxxnet_wrapper.h:36-230``).  The reference routed
+every call through a ``libcxxnetwrapper.so`` C shim because its trainer was
+C++; here the trainer is the in-process :class:`~cxxnet_tpu.nnet.trainer.
+NetTrainer`, so the same surface is plain Python — numpy in, numpy out, with
+JAX/XLA doing device placement under the hood.
+
+Layout note: batch arrays are **NHWC** (the TPU-native layout used across the
+framework), not the reference's NCHW.  Flat ``(N, D)`` input is accepted
+anywhere a 4-D tensor is (it is reshaped to ``(N, 1, 1, D)``).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from . import config as cfgmod
+from .io.data import DataBatch, create_iterator
+from .nnet.trainer import NetTrainer
+
+__all__ = ["DataIter", "Net", "train"]
+
+
+class DataIter:
+    """Config-driven data iterator (reference ``DataIter``, cxxnet.py:64-103).
+
+    ``cfg`` is the text of one iterator section — the lines that would sit
+    between ``data = train`` and ``iter = end`` in a ``.conf`` file (the
+    section markers themselves are tolerated and ignored, so a pasted
+    section works verbatim).
+    """
+
+    def __init__(self, cfg: str) -> None:
+        entries = [
+            (n, v)
+            for n, v in cfgmod.parse_pairs(cfg)
+            if n not in ("data", "eval", "pred")
+            and not (n == "iter" and v == "end")
+        ]
+        self._iter = create_iterator(entries)
+        self._iter.init()
+        self.head = True
+        self.tail = False
+
+    def next(self) -> bool:
+        ret = self._iter.next()
+        self.head = False
+        self.tail = not ret
+        return ret
+
+    def before_first(self) -> None:
+        self._iter.before_first()
+        self.head = True
+        self.tail = False
+
+    def check_valid(self) -> None:
+        if self.head:
+            raise RuntimeError(
+                "iterator was at head state, call next to get to valid state"
+            )
+        if self.tail:
+            raise RuntimeError("iterator reaches end")
+
+    def value(self) -> DataBatch:
+        self.check_valid()
+        return self._iter.value()
+
+    def get_data(self) -> np.ndarray:
+        """Current batch data, NHWC (reference returned NCHW)."""
+        return np.asarray(self.value().data)
+
+    def get_label(self) -> np.ndarray:
+        return np.asarray(self.value().label)
+
+    def __iter__(self):
+        self.before_first()
+        while self.next():
+            yield self._iter.value()
+
+
+def _as_batch(data: np.ndarray, label: Optional[np.ndarray]) -> DataBatch:
+    data = np.ascontiguousarray(data, np.float32)
+    if label is not None:
+        label = np.asarray(label, np.float32)
+        if label.ndim == 1:
+            label = label.reshape(label.shape[0], 1)
+        if label.ndim != 2:
+            raise ValueError("label must be 1-D or 2-D")
+        if label.shape[0] != data.shape[0]:
+            raise ValueError("Net.update: data size mismatch")
+    else:
+        label = np.zeros((data.shape[0], 1), np.float32)
+    return DataBatch(data=data, label=label)
+
+
+ParamSpec = Union[Dict[str, object], Iterable[Tuple[str, object]]]
+
+
+class Net:
+    """Trainer handle (reference ``Net``, cxxnet.py:105-280).
+
+    ``dev`` is the device string (``tpu``, ``tpu:0-3``, ``cpu``); ``cfg`` is
+    full ``.conf`` text (netconfig section + globals). Further settings can
+    be layered on with :meth:`set_param` before :meth:`init_model`.
+    """
+
+    def __init__(self, dev: str = "tpu", cfg: str = "") -> None:
+        self._trainer = NetTrainer()
+        self._trainer.set_param("dev", dev)
+        if cfg:
+            self._trainer.set_params(cfgmod.parse_pairs(cfg))
+
+    @property
+    def trainer(self) -> NetTrainer:
+        """The underlying NetTrainer (escape hatch; no reference analog)."""
+        return self._trainer
+
+    def set_param(self, name: str, value: object) -> None:
+        self._trainer.set_param(str(name), str(value))
+
+    def init_model(self) -> None:
+        self._trainer.init_model()
+
+    def load_model(self, fname: str) -> None:
+        self._trainer.load_model(fname)
+
+    def save_model(self, fname: str) -> None:
+        self._trainer.save_model(fname)
+
+    def start_round(self, round_counter: int) -> None:
+        self._trainer.start_round(round_counter)
+
+    def update(
+        self,
+        data: Union[DataIter, np.ndarray],
+        label: Optional[np.ndarray] = None,
+    ) -> None:
+        if isinstance(data, DataIter):
+            self._trainer.update(data.value())
+        elif isinstance(data, np.ndarray):
+            if label is None:
+                raise ValueError("Net.update: need label to use update")
+            self._trainer.update(_as_batch(data, label))
+        else:
+            raise TypeError(f"update does not support type {type(data)}")
+
+    def evaluate(self, data: DataIter, name: str) -> str:
+        if not isinstance(data, DataIter):
+            raise TypeError(f"evaluate does not support type {type(data)}")
+        return self._trainer.evaluate(data._iter, name)
+
+    def predict(self, data: Union[DataIter, np.ndarray]) -> np.ndarray:
+        """Prediction for the current batch (iter) or the given array."""
+        if isinstance(data, DataIter):
+            batch = data.value()
+            n = batch.batch_size - batch.num_batch_padd
+            return self._trainer.predict(batch)[:n]
+        return self._trainer.predict(_as_batch(np.asarray(data), None))
+
+    def extract(self, data: Union[DataIter, np.ndarray], name: str) -> np.ndarray:
+        """Feature extraction by node name or ``top[-k]``."""
+        if isinstance(data, DataIter):
+            batch = data.value()
+            n = batch.batch_size - batch.num_batch_padd
+            return self._trainer.extract_feature(batch, name)[:n]
+        return self._trainer.extract_feature(_as_batch(np.asarray(data), None), name)
+
+    def set_weight(self, weight: np.ndarray, layer_name: str, tag: str) -> None:
+        self._trainer.set_weight(np.asarray(weight, np.float32), layer_name, tag)
+
+    def get_weight(self, layer_name: str, tag: str) -> Optional[np.ndarray]:
+        w = self._trainer.get_weight(layer_name, tag)
+        return None if w.size == 0 else w
+
+
+def train(
+    cfg: str,
+    data: Union[DataIter, np.ndarray],
+    num_round: int,
+    param: ParamSpec,
+    eval_data: Optional[DataIter] = None,
+    label: Optional[np.ndarray] = None,
+    dev: str = "tpu",
+    print_step: int = 100,
+) -> Net:
+    """Config-in, trained-``Net``-out loop (reference ``train``, :281-307)."""
+    net = Net(dev=dev, cfg=cfg)
+    items = param.items() if isinstance(param, dict) else param
+    for k, v in items:
+        net.set_param(k, v)
+    net.init_model()
+    if isinstance(data, DataIter):
+        for r in range(num_round):
+            net.start_round(r)
+            data.before_first()
+            scounter = 0
+            while data.next():
+                net.update(data)
+                scounter += 1
+                if print_step and scounter % print_step == 0:
+                    print(f"[{r}] {scounter} batch passed")
+            if eval_data is not None:
+                seval = net.evaluate(eval_data, "eval")
+                sys.stderr.write(seval + "\n")
+        return net
+    for r in range(num_round):
+        net.start_round(r)
+        net.update(data=data, label=label)
+    return net

@@ -35,6 +35,10 @@ namespace {
 
 constexpr uint32_t kPageMagic = 0x43584250;  // "CXBP"
 constexpr size_t kInQueueCap = 512;          // encoded blobs in flight
+// Sanity bounds on untrusted on-disk length fields: a 64 MB page format
+// cannot legitimately exceed these; reject instead of bad_alloc-ing.
+constexpr uint32_t kMaxRecordsPerPage = 1u << 24;
+constexpr uint32_t kMaxRecordBytes = 1u << 30;
 constexpr size_t kOutWindowCap = 256;        // decoded images buffered
 
 struct Record {
@@ -151,6 +155,22 @@ class Pipeline {
 
  private:
   void ReadLoop() {
+    // Length fields come from untrusted on-disk pages: an exception escaping
+    // a std::thread is std::terminate, so route every failure (including
+    // bad_alloc from a corrupt nrec/len) into error_ for the Python side.
+    try {
+      ReadLoopImpl();
+    } catch (const std::exception& e) {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (error_.empty()) error_ = std::string("page reader: ") + e.what();
+      eof_seq_ = consume_seq_;  // Next() returns false; consumer reads Error()
+      reader_done_ = true;
+      cv_in_.notify_all();
+      cv_out_.notify_all();
+    }
+  }
+
+  void ReadLoopImpl() {
     uint64_t seq = 0;
     std::string err;
     for (const auto& path : paths_) {
@@ -170,6 +190,11 @@ class Pipeline {
           break;
         }
         uint32_t nrec = hdr[1];
+        if (nrec > kMaxRecordsPerPage) {
+          err = "corrupt page (record count) in shard: " + path;
+          shard_ok = false;
+          break;
+        }
         std::vector<uint32_t> lens(nrec);
         if (nrec && std::fread(lens.data(), sizeof(uint32_t), nrec, f) != nrec) {
           err = "truncated page in shard: " + path;
@@ -177,6 +202,11 @@ class Pipeline {
           break;
         }
         for (uint32_t i = 0; i < nrec && shard_ok; ++i) {
+          if (lens[i] > kMaxRecordBytes) {
+            err = "corrupt record length in shard: " + path;
+            shard_ok = false;
+            break;
+          }
           Record r;
           r.seq = seq;
           r.blob.resize(lens[i]);

@@ -13,21 +13,10 @@ from cxxnet_tpu.models import MODEL_BUILDERS
 from cxxnet_tpu.nnet.trainer import NetTrainer
 
 
-def _build_trainer(conf_text: str) -> NetTrainer:
-    cfg = cfgmod.parse_pairs(conf_text)
-    split = cfgmod.split_sections(cfg)
-    tr = NetTrainer()
-    tr.set_params(split.global_cfg if hasattr(split, "global_cfg") else cfg)
-    return tr
-
-
 def _global_cfg(conf_text: str):
-    cfg = cfgmod.parse_pairs(conf_text)
-    sc = cfgmod.split_sections(cfg)
-    for attr in ("global_cfg", "net_cfg", "rest", "other"):
-        if hasattr(sc, attr):
-            return getattr(sc, attr)
-    return cfg
+    """Netconfig + globals only — iterator sections stripped the way the
+    CLI does before handing entries to the trainer."""
+    return cfgmod.split_sections(cfgmod.parse_pairs(conf_text)).global_entries
 
 
 @pytest.mark.parametrize("name", sorted(MODEL_BUILDERS))
