@@ -215,7 +215,8 @@ class LearnTask:
                 if self.test_io == 0:
                     self.net_trainer.update(self.itr_train.value())
                 sample_counter += 1
-                if sample_counter % self.print_step == 0 and not self.silent:
+                if (self.print_step > 0 and sample_counter % self.print_step == 0
+                        and not self.silent):
                     elapsed = int(time.time() - start)
                     print(
                         f"round {self.start_counter - 1:8d}:"

@@ -307,9 +307,12 @@ class BatchNormLayer(Layer):
     def apply(self, params, inputs, *, train=False, rng=None, step=None):
         x = inputs[0]
         axes = tuple(range(x.ndim - 1))  # all but channel
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.mean((x - mean) ** 2, axis=axes)
-        inv = lax.rsqrt(var + jnp.asarray(self.eps, var.dtype))
-        slope = params["wmat"].astype(x.dtype)
-        bias = params["bias"].astype(x.dtype)
-        return [(x - mean) * inv * slope + bias]
+        # statistics always in f32: bf16 mean/var loses too many mantissa
+        # bits over a 100k-element reduction
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.mean((xf - mean) ** 2, axis=axes)
+        inv = lax.rsqrt(var + jnp.float32(self.eps))
+        slope = params["wmat"].astype(jnp.float32)
+        bias = params["bias"].astype(jnp.float32)
+        return [((xf - mean) * inv * slope + bias).astype(x.dtype)]

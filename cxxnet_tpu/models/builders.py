@@ -216,6 +216,7 @@ def googlenet_conf(
     synthetic: bool = True,
     nsample: int = 0,
     dev: str = "tpu",
+    compute_dtype: str = "bfloat16",
 ) -> str:
     """GoogLeNet (inception v1) — the BASELINE.json benchmark model.
 
@@ -276,6 +277,7 @@ def googlenet_conf(
         "wmat:lr = 0.01\nwmat:wd = 0.0002\n"
         "bias:lr = 0.02\nbias:wd = 0.0\n"
         "lr:schedule = polydecay\nlr:alpha = 0.5\nlr:max_round = 2400000\n"
+        f"compute_dtype = {compute_dtype}\n"
     )
     return data + net + _tail(batch_size, shape, 100, eta=0.01, dev=dev, extra=extra)
 

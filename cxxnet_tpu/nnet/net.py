@@ -44,6 +44,7 @@ class FunctionalNet:
         self.graph = graph
         self.batch_size = 0
         self.update_period = 1
+        self.compute_dtype = jnp.float32
         # instantiate layers (shared layers alias the primary instance)
         self.layer_objs: List[Layer] = []
         self.param_key: List[Optional[str]] = []  # params pytree key per layer
@@ -61,6 +62,14 @@ class FunctionalNet:
             self.param_key.append(f"l{i}_{tag}")
         self._configure_layers()
         self.node_shapes: List[Optional[Tuple[int, ...]]] = []
+        # params kept in f32 even under mixed precision (norm layers)
+        from ..layers.conv import BatchNormLayer
+
+        self._f32_param_keys = {
+            self.param_key[i]
+            for i, lay in enumerate(self.layer_objs)
+            if isinstance(lay, BatchNormLayer)
+        }
 
     # ------------------------------------------------------------------
     def _configure_layers(self) -> None:
@@ -70,6 +79,15 @@ class FunctionalNet:
                 self.batch_size = int(val)
             elif name == "update_period":
                 self.update_period = int(val)
+            elif name == "compute_dtype":
+                if val in ("bfloat16", "bf16"):
+                    self.compute_dtype = jnp.bfloat16
+                elif val in ("float32", "fp32"):
+                    self.compute_dtype = jnp.float32
+                else:
+                    raise ValueError(
+                        f"compute_dtype must be bfloat16 or float32, got {val!r}"
+                    )
         for i, spec in enumerate(g.layers):
             if spec.type_name == "shared":
                 continue
@@ -166,6 +184,20 @@ class FunctionalNet:
         time — loss is then 0 and loss layers only transform).
         """
         g = self.graph
+        cdt = self.compute_dtype
+        if cdt != jnp.float32:
+            # mixed precision: layer math (MXU) in bf16, master params and
+            # loss in f32 — jax.grad through the cast yields f32 grads.
+            # Norm-layer params are excluded: BN does its math in f32, so
+            # rounding gamma/beta through bf16 would only lose precision.
+            params = {
+                key: (tags if key in self._f32_param_keys
+                      else {t: v.astype(cdt) for t, v in tags.items()})
+                for key, tags in params.items()
+            }
+            data = data.astype(cdt)
+            extras = [e.astype(cdt) for e in extras]
+        out_idx = self.out_node_index()
         nodes: List[Optional[jnp.ndarray]] = [None] * g.num_nodes
         nodes[0] = data
         for k, e in enumerate(extras):
@@ -179,12 +211,17 @@ class FunctionalNet:
                 raise ValueError(f"layer {i}: unset input node")
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
             if isinstance(lay, LossLayer):
-                logits = inputs[0]
+                logits = inputs[0].astype(jnp.float32)
                 if labels is not None:
                     field = self._label_field(labels, lay.target)
                     scale = lay.grad_scale / (batch * self.update_period)
                     total_loss = total_loss + scale * lay.loss(logits, field)
-                nodes[spec.nindex_out[0]] = lay.transform(logits)
+                # transform is f32 math; only downcast if a downstream layer
+                # consumes it — the terminal node goes to host metrics in f32
+                out = lay.transform(logits)
+                if spec.nindex_out[0] != out_idx:
+                    out = out.astype(cdt)
+                nodes[spec.nindex_out[0]] = out
             else:
                 outs = lay.apply(
                     params.get(self.param_key[i], {}),

@@ -191,7 +191,8 @@ class NetTrainer:
             params, data, labels=labels, extras=extras,
             train=True, rng=rng, step=epoch,
         )
-        return loss, nodes[net.out_node_index()]
+        # metrics consume the out node on host: always hand back f32
+        return loss, nodes[net.out_node_index()].astype(jnp.float32)
 
     def _fused_step_fn(self):
         """fwd + bwd + updater math as ONE donated SPMD program.
@@ -269,7 +270,7 @@ class NetTrainer:
 
             def f(params, data, extras):
                 nodes, _ = net.forward(params, data, extras=extras, train=False)
-                return nodes[out_idx]
+                return nodes[out_idx].astype(jnp.float32)
 
             rep, dsh, ex = self._sh()
             self._jit_cache["eval"] = jax.jit(
@@ -284,7 +285,7 @@ class NetTrainer:
 
             def f(params, data, extras):
                 nodes, _ = net.forward(params, data, extras=extras, train=False)
-                return nodes[node_id]
+                return nodes[node_id].astype(jnp.float32)
 
             rep, dsh, ex = self._sh()
             self._jit_cache[key] = jax.jit(

@@ -153,7 +153,12 @@ class Net:
     def evaluate(self, data: DataIter, name: str) -> str:
         if not isinstance(data, DataIter):
             raise TypeError(f"evaluate does not support type {type(data)}")
-        return self._trainer.evaluate(data._iter, name)
+        ret = self._trainer.evaluate(data._iter, name)
+        # the trainer drained the underlying iterator; mark the wrapper
+        # exhausted so a stale value()/update() raises instead of silently
+        # reusing the last eval batch
+        data.head, data.tail = False, True
+        return ret
 
     def predict(self, data: Union[DataIter, np.ndarray]) -> np.ndarray:
         """Prediction for the current batch (iter) or the given array."""
@@ -212,4 +217,6 @@ def train(
     for r in range(num_round):
         net.start_round(r)
         net.update(data=data, label=label)
+        if eval_data is not None:
+            sys.stderr.write(net.evaluate(eval_data, "eval") + "\n")
     return net

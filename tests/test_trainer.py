@@ -244,3 +244,23 @@ eta = 0.1
     assert tr.epoch_counter == 1
     out = tr.predict(b)
     assert out.shape == (8,)
+
+
+def test_bfloat16_mixed_precision_converges():
+    """compute_dtype=bfloat16: bf16 layer math, f32 master params + loss."""
+    import jax.numpy as jnp
+
+    tr = make_trainer("compute_dtype = bfloat16\n")
+    assert tr.net.compute_dtype == jnp.bfloat16
+    x, y = toy_data()
+    for _ in range(60):
+        for b in batches(x, y):
+            tr.update(b)
+    # master params stay f32
+    for leaf in __import__("jax").tree_util.tree_leaves(tr.params):
+        assert leaf.dtype == jnp.float32
+    errs = []
+    for b in batches(x, y):
+        pred = tr.predict(b)
+        errs.append((pred != b.label[:, 0]).mean())
+    assert float(np.mean(errs)) <= 0.1
