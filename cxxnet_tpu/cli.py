@@ -86,6 +86,12 @@ class LearnTask:
             self.set_param(name, val)
         for name, val in cfgmod.parse_cli_overrides(argv[1:]):
             self.set_param(name, val)
+        # join the multi-process job (if any) before any JAX backend use;
+        # the distributed-PS replacement (SURVEY §2.8): bigger mesh, same
+        # SPMD program, collectives over ICI/DCN
+        from .parallel import maybe_init_distributed
+
+        maybe_init_distributed(self.cfg)
         if self.task not in ("train", "finetune", "pred", "extract"):
             raise ValueError(f"unknown task {self.task!r}")
         self.init()

@@ -238,6 +238,7 @@ class LRNLayer(Layer):
         self.alpha = 0.001
         self.beta = 0.75
         self.knorm = 1.0
+        self.impl = "auto"  # auto: Pallas kernel on TPU, stock XLA elsewhere
 
     def set_param(self, name, val):
         if name == "local_size":
@@ -248,29 +249,37 @@ class LRNLayer(Layer):
             self.beta = float(val)
         elif name == "knorm":
             self.knorm = float(val)
+        elif name == "lrn_impl":
+            if val not in ("auto", "pallas", "xla"):
+                raise ValueError(f"lrn_impl must be auto|pallas|xla, got {val!r}")
+            self.impl = val
         else:
             super().set_param(name, val)
+
+    def _use_pallas(self) -> bool:
+        if self.impl == "pallas":
+            return True
+        if self.impl == "xla":
+            return False
+        try:
+            return jax.default_backend() == "tpu"
+        except RuntimeError:
+            return False
 
     def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
         self._check_arity(in_shapes, 1)
         return [tuple(in_shapes[0])]
 
     def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        from ..ops.lrn import lrn, lrn_xla
+
         x = inputs[0]
-        half = self.nsize // 2
-        # cross-channel sum of squares over a window of nsize channels
-        sq = x * x
-        # literal init (see _pool): traced init breaks reduce_window autodiff
-        norm_win = lax.reduce_window(
-            sq,
-            sq.dtype.type(0.0),
-            lax.add,
-            window_dimensions=(1, 1, 1, self.nsize),
-            window_strides=(1, 1, 1, 1),
-            padding=((0, 0), (0, 0), (0, 0), (half, self.nsize - 1 - half)),
-        )
-        norm = self.knorm + (self.alpha / self.nsize) * norm_win
-        return [x * norm ** (-self.beta)]
+        if self._use_pallas():
+            interp = jax.default_backend() != "tpu"  # forced-on off-TPU
+            y = lrn(x, self.nsize, self.alpha, self.beta, self.knorm, interp)
+        else:
+            y = lrn_xla(x, self.nsize, self.alpha, self.beta, self.knorm)
+        return [y]
 
 
 @register

@@ -40,6 +40,7 @@ from .. import config as cfgmod
 from ..io.data import DataBatch
 from ..layers import LossLayer
 from ..parallel import MeshPlan, make_mesh
+from ..parallel.distributed import fetch_array, fetch_local_rows
 from ..updater import Updater, create_updater
 from ..utils.metric import MetricSet
 from .graph import NetGraph
@@ -146,11 +147,6 @@ class NetTrainer:
 
     def _build_mesh(self) -> None:
         """dev=tpu:0-3 → ('data','model') mesh; the mshadow-ps replacement."""
-        if self.model_parallel != 1:
-            raise ValueError(
-                "model_parallel>1: tensor-parallel shardings are not wired "
-                "into the layer zoo yet; use data parallelism (dev=tpu:0-N)"
-            )
         self.mesh_plan = make_mesh(self.dev, self.model_parallel)
         if self.batch_size:
             self.mesh_plan.check_batch(self.batch_size)
@@ -163,6 +159,15 @@ class NetTrainer:
             plan = self.mesh_plan
         rep, dsh = plan.replicated(), plan.data_sharding()
         return rep, dsh, (dsh,) * self._n_extras()
+
+    def _param_sh(self):
+        """Sharding pytrees for (params, ustates): tensor-parallel weight
+        placement over the mesh's model axis (pure DP → all replicated)."""
+        plan = self.mesh_plan
+        spec = lambda v: plan.param_sharding(np.shape(v))  # noqa: E731
+        psh = jax.tree_util.tree_map(spec, self.params)
+        ush = jax.tree_util.tree_map(spec, self.ustates)
+        return psh, ush
 
     # ------------------------------------------------------------------
     # jitted step functions (built lazily, cached per (train, accum) kind)
@@ -207,6 +212,7 @@ class NetTrainer:
         if "fused" not in self._jit_cache:
             updaters = dict(self.updaters)
             rep, dsh, ex = self._sh()
+            psh, ush = self._param_sh()
             loss_and_out = self._loss_and_out
             apply_updates = self._apply_updates
 
@@ -220,8 +226,8 @@ class NetTrainer:
 
             self._jit_cache["fused"] = jax.jit(
                 step,
-                in_shardings=(rep, rep, dsh, dsh, rep, rep, ex),
-                out_shardings=(rep, rep, rep, dsh),
+                in_shardings=(psh, ush, dsh, dsh, rep, rep, ex),
+                out_shardings=(psh, ush, rep, dsh),
                 donate_argnums=(0, 1),
             )
         return self._jit_cache["fused"]
@@ -236,10 +242,11 @@ class NetTrainer:
                 )
 
             rep, dsh, ex = self._sh()
+            psh, _ = self._param_sh()
             self._jit_cache["grad"] = jax.jit(
                 jax.value_and_grad(loss_fn),
-                in_shardings=(rep, dsh, dsh, rep, rep, ex),
-                out_shardings=(rep, rep),
+                in_shardings=(psh, dsh, dsh, rep, rep, ex),
+                out_shardings=(rep, psh),
             )
         return self._jit_cache["grad"]
 
@@ -256,10 +263,11 @@ class NetTrainer:
                 return loss, out, grads
 
             rep, dsh, ex = self._sh()
+            psh, _ = self._param_sh()
             self._jit_cache["fwd_train"] = jax.jit(
                 f,
-                in_shardings=(rep, dsh, dsh, rep, rep, ex),
-                out_shardings=(rep, dsh, rep),
+                in_shardings=(psh, dsh, dsh, rep, rep, ex),
+                out_shardings=(rep, dsh, psh),
             )
         return self._jit_cache["fwd_train"]
 
@@ -273,8 +281,9 @@ class NetTrainer:
                 return nodes[out_idx].astype(jnp.float32)
 
             rep, dsh, ex = self._sh()
+            psh, _ = self._param_sh()
             self._jit_cache["eval"] = jax.jit(
-                f, in_shardings=(rep, dsh, ex), out_shardings=dsh
+                f, in_shardings=(psh, dsh, ex), out_shardings=dsh
             )
         return self._jit_cache["eval"]
 
@@ -288,8 +297,9 @@ class NetTrainer:
                 return nodes[node_id].astype(jnp.float32)
 
             rep, dsh, ex = self._sh()
+            psh, _ = self._param_sh()
             self._jit_cache[key] = jax.jit(
-                f, in_shardings=(rep, dsh, ex), out_shardings=dsh
+                f, in_shardings=(psh, dsh, ex), out_shardings=dsh
             )
         return self._jit_cache[key]
 
@@ -301,7 +311,13 @@ class NetTrainer:
             def f(params, ustates, grads, epoch):
                 return apply_updates(updaters, params, ustates, grads, epoch)
 
-            self._jit_cache["apply"] = jax.jit(f)
+            rep = self._sh()[0]
+            psh, ush = self._param_sh()
+            self._jit_cache["apply"] = jax.jit(
+                f,
+                in_shardings=(psh, ush, psh, rep),
+                out_shardings=(psh, ush),
+            )
         return self._jit_cache["apply"]
 
     # ------------------------------------------------------------------
@@ -312,12 +328,27 @@ class NetTrainer:
         self._rng_key, sub = jax.random.split(self._rng_key)
         return sub
 
+    def _to_device(self, x: np.ndarray) -> jax.Array:
+        """Batch-major host array → (possibly multi-process) global array.
+
+        Single process: plain transfer, jit's in_shardings places it.
+        Multi-process (jax.distributed job): this process holds only its
+        shard of the global batch; assemble the global array over the
+        data axis (the DCN-spanning-mesh analog of the reference's
+        per-worker data sharding, SURVEY §2.8).
+        """
+        if jax.process_count() == 1:
+            return jnp.asarray(x)
+        return jax.make_array_from_process_local_data(
+            self.mesh_plan.data_sharding(), np.asarray(x)
+        )
+
     def update(self, batch: DataBatch) -> None:
         """One micro-batch: fwd/bwd + (every update_period-th call) update."""
         assert self.net is not None, "init_model/load_model first"
-        data = jnp.asarray(batch.data)
-        labels = jnp.asarray(batch.label)
-        extras = tuple(jnp.asarray(e) for e in batch.extra_data)
+        data = self._to_device(batch.data)
+        labels = self._to_device(batch.label)
+        extras = tuple(self._to_device(e) for e in batch.extra_data)
         step = jnp.asarray(self.epoch_counter, jnp.int32)
         if self.update_period == 1:
             # fused SPMD fast path: fwd+bwd+update in one donated program
@@ -327,7 +358,8 @@ class NetTrainer:
             )
             if self.eval_train:
                 self.train_metric.add_eval(
-                    np.asarray(out), np.asarray(batch.label), self._label_ranges()
+                    fetch_local_rows(out), np.asarray(batch.label),
+                    self._label_ranges(),
                 )
             self.epoch_counter += 1
             return
@@ -336,7 +368,8 @@ class NetTrainer:
                 self.params, data, labels, self._next_rng(), step, extras
             )
             self.train_metric.add_eval(
-                np.asarray(out), np.asarray(batch.label), self._label_ranges()
+                fetch_local_rows(out), np.asarray(batch.label),
+                self._label_ranges(),
             )
         else:
             loss, grads = self._grad_fn()(
@@ -384,8 +417,10 @@ class NetTrainer:
                 np.concatenate([e, np.zeros((pad,) + e.shape[1:], e.dtype)], 0)
                 for e in extras
             )
-        out = np.asarray(fn(self.params, jnp.asarray(data),
-                            tuple(jnp.asarray(e) for e in extras)))
+        out = fetch_local_rows(
+            fn(self.params, self._to_device(data),
+               tuple(self._to_device(e) for e in extras))
+        )
         return out[:n] if pad else out
 
     def evaluate(self, iter_eval, data_name: str) -> str:
@@ -443,7 +478,7 @@ class NetTrainer:
         key = self.net.param_key[i]
         if key not in self.params or tag not in self.params[key]:
             return np.zeros((0, 0), np.float32)
-        w = np.asarray(self.params[key][tag])
+        w = fetch_array(self.params[key][tag])
         return self._to_2d(w, self.graph.layers[i].type_name, tag)
 
     def set_weight(self, weight: np.ndarray, layer_name: str, tag: str) -> None:
@@ -451,7 +486,7 @@ class NetTrainer:
             raise ValueError("tag must be wmat or bias")
         i = self.graph.layer_index_of(layer_name)
         key = self.net.param_key[i]
-        cur = np.asarray(self.params[key][tag])
+        cur = fetch_array(self.params[key][tag])
         new = self._from_2d(np.asarray(weight, np.float32), cur.shape,
                             self.graph.layers[i].type_name, tag)
         self.params[key][tag] = jnp.asarray(new)
@@ -504,7 +539,7 @@ class NetTrainer:
         flat = {}
         for key, tags in self.params.items():
             for tag, w in tags.items():
-                flat[f"{key}/{tag}"] = np.asarray(w)
+                flat[f"{key}/{tag}"] = fetch_array(w)
         np.savez(buf, **flat)
         with open(path, "wb") as f:
             f.write(MODEL_MAGIC)

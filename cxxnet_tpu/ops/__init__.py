@@ -1,0 +1,14 @@
+"""Hand-tuned TPU kernels (Pallas).
+
+The reference's analog is user-extensible mshadow expressions — e.g. the
+custom ``Plan`` structs in
+``/root/reference/src/layer/insanity_pooling_layer-inl.hpp:13-215`` that
+extend the tensor compiler where stock expressions fall short.  Here the
+stock compiler is XLA; where its lowering of an op is not TPU-shaped, the
+op gets a Pallas kernel with a custom VJP.  Every kernel has an
+``interpret=True`` path so the same code runs (slowly) on CPU for golden
+tests against the pure-XLA implementation (the PairTest discipline,
+SURVEY §4.1).
+"""
+
+from .lrn import lrn, lrn_xla  # noqa: F401

@@ -13,4 +13,9 @@ which subsumes the reference's per-layer WFBP priorities
 (``updater_impl-inl.hpp:82``).
 """
 
+from .distributed import (  # noqa: F401
+    distributed_spec,
+    maybe_init_distributed,
+    process_info,
+)
 from .mesh import MeshPlan, make_mesh, parse_device  # noqa: F401

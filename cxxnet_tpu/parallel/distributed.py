@@ -1,0 +1,136 @@
+"""Multi-process (multi-host) runtime: the distributed-PS replacement.
+
+Parity target: the reference's distributed mode — ps-lite workers/servers
+launched from ``mpi.conf`` with ``param_server = dist`` and data sharded by
+``PS_RANK`` (SURVEY §2.7/§2.8, ``/root/reference/src/nnet/nnet_impl-inl.hpp:
+376-390``, ``iter_thread_imbin_x-inl.hpp:108-139``).
+
+TPU-native design: there are no parameter servers.  Every process joins one
+`jax.distributed` job (GRPC coordination), the device mesh spans all
+processes' chips, and gradient exchange is XLA collectives over ICI within a
+host/pod and DCN across hosts — the same SPMD program as single-host, just a
+bigger mesh.  The reference's ``update_on_server`` maps to sharded optimizer
+state (params/updater state sharded over the mesh instead of replicated).
+
+Config keys (set on every process, e.g. by a launcher):
+
+* ``dist_coordinator = host:port`` — process-0 address
+  (``jax.distributed.initialize`` coordinator)
+* ``dist_num_proc`` — number of processes in the job
+* ``dist_proc_id`` — this process's rank
+
+or the corresponding environment variables ``CXN_COORDINATOR`` /
+``CXN_NUM_PROC`` / ``CXN_PROC_ID`` (the env route mirrors the reference's
+``PS_RANK`` convention).  When none are present this is a no-op single-process
+run.  The data iterators independently honor ``dist_num_worker`` /
+``dist_worker_rank`` / ``PS_RANK`` for shard-per-worker reading; a launcher
+normally sets both groups from the same rank.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+
+ConfigEntry = Tuple[str, str]
+
+_initialized = False
+
+
+def distributed_spec(
+    cfg: Sequence[ConfigEntry],
+) -> Optional[Tuple[str, int, int]]:
+    """Extract (coordinator, num_proc, proc_id) from config or env."""
+    coord = os.environ.get("CXN_COORDINATOR")
+    num = os.environ.get("CXN_NUM_PROC")
+    pid = os.environ.get("CXN_PROC_ID", os.environ.get("PS_RANK"))
+    for name, val in cfg:
+        if name == "dist_coordinator":
+            coord = val
+        elif name == "dist_num_proc":
+            num = val
+        elif name == "dist_proc_id":
+            pid = val
+    if coord is None and num is None:
+        return None
+    if coord is None or num is None or pid is None:
+        raise ValueError(
+            "distributed run needs all of dist_coordinator, dist_num_proc, "
+            "dist_proc_id (or CXN_COORDINATOR/CXN_NUM_PROC/CXN_PROC_ID)"
+        )
+    return coord, int(num), int(pid)
+
+
+def maybe_init_distributed(cfg: Sequence[ConfigEntry]) -> bool:
+    """Join the jax.distributed job if the config asks for one.
+
+    Idempotent; returns True when running multi-process.  Must be called
+    before any other JAX API touches the backend.
+    """
+    global _initialized
+    spec = distributed_spec(cfg)
+    if spec is None:
+        return False
+    if _initialized:
+        return True
+    coord, num, pid = spec
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=num, process_id=pid
+    )
+    _initialized = True
+    return True
+
+
+def process_info() -> Tuple[int, int]:
+    """(process_id, process_count) — (0, 1) for single-process runs."""
+    try:
+        return jax.process_index(), jax.process_count()
+    except RuntimeError:
+        return 0, 1
+
+
+def fetch_array(x) -> "np.ndarray":
+    """Global jax.Array → full host ndarray, multi-process safe.
+
+    Replicated arrays (params) read from the local shard; sharded arrays
+    are allgathered across processes first.
+    """
+    import numpy as np
+
+    if not hasattr(x, "sharding") or jax.process_count() == 1:
+        return np.asarray(x)
+    if x.sharding.is_fully_replicated:
+        return np.asarray(x.addressable_shards[0].data)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def fetch_local_rows(x) -> "np.ndarray":
+    """Batch-major global array → this process's rows (device order)."""
+    import numpy as np
+
+    if not hasattr(x, "sharding") or jax.process_count() == 1:
+        return np.asarray(x)
+    # one shard per row range: replication (e.g. over the model axis) puts
+    # identical row blocks on several local devices — keep the first each
+    by_start = {}
+    for s in x.addressable_shards:
+        start = s.index[0].start or 0
+        if start not in by_start:
+            by_start[start] = s
+    return np.concatenate(
+        [np.asarray(by_start[k].data) for k in sorted(by_start)], axis=0
+    )
+
+
+def global_batch_parts(n: int) -> List[int]:
+    """Deterministic split of a global batch over processes (equal shards)."""
+    _, count = process_info()
+    if n % count != 0:
+        raise ValueError(
+            f"global batch {n} must divide process count {count}"
+        )
+    return [n // count] * count

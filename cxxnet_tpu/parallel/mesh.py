@@ -68,6 +68,33 @@ class MeshPlan:
         """Batch-major arrays: shard dim 0 over the data axis."""
         return NamedSharding(self.mesh, P("data"))
 
+    def param_sharding(self, shape: Sequence[int]) -> NamedSharding:
+        """Tensor-parallel weight sharding over the ``model`` axis.
+
+        The GSPMD recipe (SURVEY §2.8 TPU mapping): annotate each weight's
+        output-feature dimension as sharded and let XLA partition the
+        matmuls/convs and insert the collectives.  Layout convention:
+
+        * fullc ``(nout, nin)`` → shard ``nout`` (dim 0)
+        * conv HWIO ``(kh, kw, cin_g, cout)`` → shard ``cout`` (dim 3)
+        * per-channel 1-D params (bias, prelu slope, BN gamma/beta) →
+          shard the channel dim
+
+        A dim that does not divide by the model-axis size is replicated —
+        correctness never depends on the annotation, only placement.
+        """
+        if self.n_model == 1:
+            return self.replicated()
+        shape = tuple(shape)
+        if not shape:
+            return self.replicated()
+        axis = 3 if len(shape) == 4 else 0
+        if shape[axis] % self.n_model == 0:
+            spec = [None] * len(shape)
+            spec[axis] = "model"
+            return NamedSharding(self.mesh, P(*spec))
+        return self.replicated()
+
     def check_batch(self, batch_size: int) -> None:
         if batch_size % self.n_data != 0:
             raise ValueError(

@@ -125,6 +125,11 @@ class Net:
         self._trainer.set_param(str(name), str(value))
 
     def init_model(self) -> None:
+        # join a multi-process job if dist_* keys are present (same entry
+        # condition as the CLI, cli.py run()); no-op for single-process
+        from .parallel import maybe_init_distributed
+
+        maybe_init_distributed(self._trainer.cfg)
         self._trainer.init_model()
 
     def load_model(self, fname: str) -> None:
@@ -154,10 +159,11 @@ class Net:
         if not isinstance(data, DataIter):
             raise TypeError(f"evaluate does not support type {type(data)}")
         ret = self._trainer.evaluate(data._iter, name)
-        # the trainer drained the underlying iterator; mark the wrapper
-        # exhausted so a stale value()/update() raises instead of silently
-        # reusing the last eval batch
-        data.head, data.tail = False, True
+        if len(self._trainer.metric) > 0:
+            # the trainer drained the iterator; mark the wrapper exhausted
+            # so a stale value()/update() raises instead of silently
+            # reusing the last eval batch
+            data.head, data.tail = False, True
         return ret
 
     def predict(self, data: Union[DataIter, np.ndarray]) -> np.ndarray:

@@ -41,3 +41,9 @@ jax.config.update("jax_platforms", "cpu")
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running tests"
+    )

@@ -1,0 +1,65 @@
+"""Pallas kernels vs their XLA golden models (the PairTest discipline,
+SURVEY §4.1): identical inputs, compare outputs and input-gradients.
+
+Kernels run in ``interpret=True`` mode on the CPU harness; on TPU the
+same code compiles natively.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu.ops.lrn import lrn, lrn_xla
+
+
+@pytest.mark.parametrize("shape", [(2, 5, 5, 64), (16, 192), (2, 7, 7, 96)])
+@pytest.mark.parametrize("nsize", [3, 5])
+def test_lrn_pallas_matches_xla_forward(rng, shape, nsize):
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    got = lrn(x, nsize, 0.0001, 0.75, 1.0, True)
+    want = lrn_xla(x, nsize, 0.0001, 0.75, 1.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("nsize", [3, 5])
+def test_lrn_pallas_matches_xla_grad(rng, nsize):
+    x = jnp.asarray(rng.randn(2, 4, 4, 32).astype(np.float32))
+
+    def loss_pallas(x):
+        return jnp.sum(lrn(x, nsize, 0.001, 0.75, 1.0, True) ** 2)
+
+    def loss_xla(x):
+        return jnp.sum(lrn_xla(x, nsize, 0.001, 0.75, 1.0) ** 2)
+
+    g1 = jax.grad(loss_pallas)(x)
+    g2 = jax.grad(loss_xla)(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lrn_pallas_bf16(rng):
+    x = jnp.asarray(rng.randn(4, 3, 3, 128).astype(np.float32)).astype(
+        jnp.bfloat16
+    )
+    got = lrn(x, 5, 0.0001, 0.75, 1.0, True)
+    assert got.dtype == jnp.bfloat16
+    want = lrn_xla(x.astype(jnp.float32), 5, 0.0001, 0.75, 1.0)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_lrn_layer_uses_xla_on_cpu(rng):
+    """lrn_impl=auto falls back to stock XLA off-TPU; pallas forced works."""
+    from cxxnet_tpu.layers import create_layer
+
+    lay = create_layer("lrn")
+    lay.set_param("local_size", "5")
+    assert not lay._use_pallas()
+    x = jnp.asarray(rng.randn(2, 4, 4, 16).astype(np.float32))
+    (y_xla,) = lay.apply({}, [x])
+    lay.set_param("lrn_impl", "pallas")
+    with pytest.raises(Exception):
+        lay.set_param("lrn_impl", "bogus")
