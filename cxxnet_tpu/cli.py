@@ -209,6 +209,12 @@ class LearnTask:
             return
         if self.test_io:
             print("start I/O test")
+        from .utils.profiler import StepTimer, TraceController
+
+        timer = StepTimer()
+        tracer = TraceController()
+        tracer.configure(self.cfg)
+        global_step = 0
         cc = self.max_round
         while self.start_counter <= self.num_round and cc > 0:
             cc -= 1
@@ -217,9 +223,19 @@ class LearnTask:
             sample_counter = 0
             self.net_trainer.start_round(self.start_counter)
             self.itr_train.before_first()
+            timer.clear()
             while self.itr_train.next():
                 if self.test_io == 0:
+                    tracer.step(global_step)
+                    timer.start()
                     self.net_trainer.update(self.itr_train.value())
+                    if not self.net_trainer.eval_train:
+                        # async dispatch: fence so the timer measures the
+                        # step, not the enqueue (eval_train's metric fetch
+                        # already synchronizes)
+                        self.net_trainer.sync()
+                    timer.stop()
+                    global_step += 1
                 sample_counter += 1
                 if (self.print_step > 0 and sample_counter % self.print_step == 0
                         and not self.silent):
@@ -230,6 +246,12 @@ class LearnTask:
                         flush=True,
                     )
             if self.test_io == 0:
+                if not self.silent and timer.count:
+                    print(
+                        f"round {self.start_counter - 1:8d}: "
+                        + timer.report(self.net_trainer.batch_size),
+                        flush=True,
+                    )
                 sys.stderr.write(f"[{self.start_counter}]")
                 if not self.itr_evals:
                     sys.stderr.write(self.net_trainer.evaluate(None, "train"))
@@ -238,6 +260,7 @@ class LearnTask:
                 sys.stderr.write("\n")
                 sys.stderr.flush()
             self._save_model()
+        tracer.close()
         if not self.silent:
             print(f"\nupdating end, {int(time.time() - start)} sec in all")
 

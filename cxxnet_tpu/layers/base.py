@@ -248,6 +248,10 @@ def create_layer(type_name: str) -> Layer:
             )
         master_name, slave_name = rest.split("-", 1)
         return PairTestLayer(create_layer(master_name), create_layer(slave_name))
+    if type_name == "torch" and type_name not in _REGISTRY:
+        # plugin layer, loaded on demand (the reference gates its caffe
+        # adapter behind CXXNET_USE_CAFFE_ADAPTOR the same way)
+        from ..plugin import torch_adapter  # noqa: F401 - registers "torch"
     if type_name not in _REGISTRY:
         raise ValueError(f'unknown layer type: "{type_name}"')
     return _REGISTRY[type_name]()

@@ -67,6 +67,7 @@ class NetTrainer:
         self.seed = 0
         self.dev = "tpu"
         self.model_parallel = 1
+        self.update_on_server = 0
         self.mesh_plan: Optional[MeshPlan] = None
         self.metric = MetricSet()
         self.train_metric = MetricSet()
@@ -90,6 +91,10 @@ class NetTrainer:
             self.dev = val
         elif name == "model_parallel":
             self.model_parallel = int(val)
+        elif name == "update_on_server":
+            # reference: SGD runs on the PS (nnet_ps_server.cpp); here the
+            # optimizer state is ZeRO-1-sharded over the data axis instead
+            self.update_on_server = int(val)
         if self.metric.try_add_from_config(name, val):
             self.train_metric.try_add_from_config(name, val)
         self.cfg.append((name, val))
@@ -162,11 +167,17 @@ class NetTrainer:
 
     def _param_sh(self):
         """Sharding pytrees for (params, ustates): tensor-parallel weight
-        placement over the mesh's model axis (pure DP → all replicated)."""
+        placement over the mesh's model axis (pure DP → all replicated);
+        with ``update_on_server=1`` the updater state is additionally
+        ZeRO-1-sharded over the data axis (see MeshPlan.state_sharding)."""
         plan = self.mesh_plan
         spec = lambda v: plan.param_sharding(np.shape(v))  # noqa: E731
         psh = jax.tree_util.tree_map(spec, self.params)
-        ush = jax.tree_util.tree_map(spec, self.ustates)
+        if self.update_on_server:
+            sspec = lambda v: plan.state_sharding(np.shape(v))  # noqa: E731
+            ush = jax.tree_util.tree_map(sspec, self.ustates)
+        else:
+            ush = jax.tree_util.tree_map(spec, self.ustates)
         return psh, ush
 
     # ------------------------------------------------------------------
@@ -323,6 +334,11 @@ class NetTrainer:
     # ------------------------------------------------------------------
     def start_round(self, round_: int) -> None:
         self.round = round_
+
+    def sync(self) -> None:
+        """Block until all dispatched device work is done (step timing)."""
+        if self.params is not None:
+            jax.block_until_ready(self.params)
 
     def _next_rng(self) -> jax.Array:
         self._rng_key, sub = jax.random.split(self._rng_key)

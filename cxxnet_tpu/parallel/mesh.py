@@ -95,6 +95,30 @@ class MeshPlan:
             return NamedSharding(self.mesh, P(*spec))
         return self.replicated()
 
+    def state_sharding(self, shape: Sequence[int]) -> NamedSharding:
+        """Optimizer-state sharding: the ``update_on_server=1`` analog.
+
+        The reference moved the SGD step onto the parameter server so each
+        worker held no optimizer state (``nnet_ps_server.cpp:83-89``); the
+        TPU-native equivalent is ZeRO-1: momentum/Adam state sharded over
+        the data axis, each DP rank computing its slice of the update and
+        GSPMD all-gathering the result (SURVEY §5 distributed backend
+        mapping).  On top of any model-axis placement, the largest
+        still-unsharded dim divisible by the data-axis size is sharded.
+        """
+        base = self.param_sharding(shape)
+        if self.n_data == 1 or not shape:
+            return base
+        spec = list(base.spec) + [None] * (len(shape) - len(base.spec))
+        best, best_size = None, 0
+        for d, size in enumerate(shape):
+            if spec[d] is None and size % self.n_data == 0 and size > best_size:
+                best, best_size = d, size
+        if best is None:
+            return base
+        spec[best] = "data"
+        return NamedSharding(self.mesh, P(*spec))
+
     def check_batch(self, batch_size: int) -> None:
         if batch_size % self.n_data != 0:
             raise ValueError(

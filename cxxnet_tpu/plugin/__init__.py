@@ -1,0 +1,14 @@
+"""Foreign-framework layer adapters (off the hot path).
+
+Parity: the reference's Caffe adapter plugin
+(``/root/reference/src/plugin/caffe_adapter-inl.hpp``) — a layer that
+hosts another framework's implementation "to allow some correct
+comparisons": it existed chiefly as the trusted slave in ``pairtest``
+differential runs (SURVEY §4.1).  The equivalent foreign framework in
+this image is CPU torch; :mod:`torch_adapter` wraps a ``torch.nn.Module``
+as a graph layer via ``jax.pure_callback`` so it slots into the same
+pairtest discipline.  Like the reference plugin it is opt-in and costs
+extra host↔device copies by design.
+"""
+
+from .torch_adapter import TorchAdapterLayer  # noqa: F401

@@ -1,0 +1,182 @@
+"""A graph layer hosting a ``torch.nn.Module`` (CPU) inside the JAX net.
+
+Parity: ``CaffeLayer`` (``/root/reference/src/plugin/caffe_adapter-inl.hpp``)
+— blob-for-node data marshalling, foreign params exposed through the weight
+visitor as flat ``blob%d`` tags, train/eval phase switching.  Config:
+
+    layer[a->b] = torch:name
+      torch_op = torch.nn.Conv2d(3, 8, 3, padding=1)
+
+``torch_op`` is evaluated with only the ``torch`` module in scope.  The
+module's parameters are pulled into the JAX param pytree (tags ``blob0``,
+``blob1``, …) so updaters/checkpoints treat them like any other weights;
+forward and backward run under ``jax.pure_callback`` with torch autograd
+supplying the VJP.  NHWC node data is marshalled to torch's NCHW and back.
+
+This is a correctness harness, not a fast path: every call round-trips
+host memory, exactly like the reference plugin's extra blob copies.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..layers.base import Layer, Params, Shape, register
+
+
+def _to_torch_layout(x: np.ndarray) -> np.ndarray:
+    if x.ndim == 4:
+        return np.transpose(x, (0, 3, 1, 2))  # NHWC -> NCHW
+    return x
+
+
+def _from_torch_layout(x: np.ndarray) -> np.ndarray:
+    if x.ndim == 4:
+        return np.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC
+    return x
+
+
+@register
+class TorchAdapterLayer(Layer):
+    type_name = "torch"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.torch_op = ""
+        self._module = None
+        self._pshapes: List[tuple] = []
+        self._out_shape: Shape = ()
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "torch_op":
+            self.torch_op = val
+        else:
+            super().set_param(name, val)
+
+    # -- module construction -------------------------------------------
+    def _build(self):
+        if self._module is None:
+            if not self.torch_op:
+                raise ValueError("torch layer: must set torch_op")
+            import torch
+
+            self._module = eval(  # noqa: S307 - config-authored expression,
+                # same trust model as the reference's caffe prototxt configs
+                self.torch_op, {"__builtins__": {}}, {"torch": torch}
+            )
+            self._module = self._module.cpu().float()
+            self._pshapes = [
+                tuple(p.shape) for p in self._module.parameters()
+            ]
+        return self._module
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        self._check_arity(in_shapes, 1)
+        import torch
+
+        mod = self._build()
+        x = torch.zeros(*_to_torch_layout(np.zeros(in_shapes[0], np.float32)).shape)
+        with torch.no_grad():
+            y = mod(x)
+        self._out_shape = _from_torch_layout(y.numpy()).shape
+        return [tuple(self._out_shape)]
+
+    def init_params(self, key, in_shapes) -> Params:
+        mod = self._build()
+        # foreign params exposed as blob%d, the reference visitor's tags
+        return {
+            f"blob{i}": jnp.asarray(p.detach().numpy())
+            for i, p in enumerate(mod.parameters())
+        }
+
+    # -- forward/backward through pure_callback ------------------------
+    def _run_torch(self, xs, need_grads: bool, train_mode: bool):
+        """Run the module with the phase from the graph's ``train`` flag.
+
+        The backward pass *recomputes* the forward under torch autograd, so
+        the torch RNG is re-seeded deterministically before every run —
+        stochastic modules (Dropout) then draw the same mask in the fwd
+        call and the bwd recomputation. Stateful eval statistics
+        (BatchNorm running stats) update on both runs; like the reference
+        caffe adapter, this layer is a correctness harness, not a
+        production path.
+        """
+        import torch
+
+        mod = self._build()
+        x_np, *p_np = xs
+        with torch.no_grad():
+            for p, v in zip(mod.parameters(), p_np):
+                p.copy_(torch.from_numpy(np.asarray(v)))
+        mod.train(train_mode)
+        torch.manual_seed(0)
+        xt = torch.from_numpy(_to_torch_layout(np.asarray(x_np)))
+        if not need_grads:
+            with torch.no_grad():
+                y = mod(xt)
+            return _from_torch_layout(y.numpy())
+        xt.requires_grad_(True)
+        y = mod(xt)
+        return y, xt
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        ptags = sorted(params, key=lambda t: int(t[4:]))
+        pvals = [params[t] for t in ptags]
+        x = inputs[0]
+        out_dtype = x.dtype
+        out_sd = jax.ShapeDtypeStruct(self._out_shape, jnp.float32)
+
+        @jax.custom_vjp
+        def torch_apply(x, *ps):
+            return jax.pure_callback(
+                lambda *a: np.asarray(
+                    self._run_torch(
+                        [v.astype(np.float32) for v in a], False, train
+                    ),
+                    np.float32,
+                ),
+                out_sd, x, *ps,
+            )
+
+        def fwd(x, *ps):
+            return torch_apply(x, *ps), (x, ps)
+
+        def bwd(res, g):
+            x, ps = res
+
+            def run_bwd(*a):
+                import torch
+
+                g_np, x_np, *p_np = a
+                y, xt = self._run_torch([x_np, *p_np], True, train)
+                gt = torch.from_numpy(
+                    _to_torch_layout(np.asarray(g_np, np.float32))
+                )
+                mod = self._module
+                grads = torch.autograd.grad(
+                    y, [xt] + list(mod.parameters()), grad_outputs=gt
+                )
+                dx = _from_torch_layout(grads[0].numpy()).astype(np.float32)
+                return (dx,) + tuple(
+                    gp.numpy().astype(np.float32) for gp in grads[1:]
+                )
+
+            shapes = (jax.ShapeDtypeStruct(np.shape(x), jnp.float32),) + tuple(
+                jax.ShapeDtypeStruct(s, jnp.float32) for s in self._pshapes
+            )
+            outs = jax.pure_callback(
+                run_bwd, shapes,
+                g.astype(jnp.float32), x.astype(jnp.float32),
+                *[p.astype(jnp.float32) for p in ps],
+            )
+            return tuple(outs)
+
+        torch_apply.defvjp(fwd, bwd)
+        y = torch_apply(
+            x.astype(jnp.float32), *[p.astype(jnp.float32) for p in pvals]
+        )
+        return [y.astype(out_dtype)]

@@ -1,0 +1,128 @@
+"""Step timing + XLA trace capture: the tracing/profiling subsystem.
+
+The reference's only observability was elapsed-time progress lines every
+``print_step`` batches (``/root/reference/src/cxxnet_main.cpp:378-386``)
+and a ``GetTime`` helper (``src/utils/timer.h``).  SURVEY §5 calls for the
+TPU-native upgrade: per-step wall-time statistics plus on-demand XLA
+profiler traces (xplane protos viewable in TensorBoard/XProf).
+
+Config keys (all global):
+
+* ``profile = 1`` — capture a jax.profiler trace window
+* ``profile_dir = <dir>`` — trace output dir (default ``profile_out``)
+* ``profile_start = 5`` — global step index to start the trace
+* ``profile_steps = 10`` — number of steps to trace
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+ConfigEntry = Tuple[str, str]
+
+
+class StepTimer:
+    """Wall-clock statistics over training steps (one round at a time)."""
+
+    def __init__(self) -> None:
+        self._times: List[float] = []
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> None:
+        if self._t0 is not None:
+            self._times.append(time.perf_counter() - self._t0)
+            self._t0 = None
+
+    def clear(self) -> None:
+        self._times = []
+        self._t0 = None
+
+    @property
+    def count(self) -> int:
+        return len(self._times)
+
+    def summary(self, batch_size: int = 0) -> Dict[str, float]:
+        """mean/p50/p99 step ms (+ samples/sec if batch_size given).
+
+        The first step of a round is dropped when there are enough
+        samples — it absorbs compile time.
+        """
+        if not self._times:
+            return {}
+        ts = sorted(self._times[1:] if len(self._times) > 4 else self._times)
+        n = len(ts)
+        mean = sum(ts) / n
+        out = {
+            "steps": float(len(self._times)),
+            "mean_ms": mean * 1e3,
+            "p50_ms": ts[n // 2] * 1e3,
+            "p99_ms": ts[min(n - 1, int(n * 0.99))] * 1e3,
+        }
+        if batch_size:
+            out["samples_per_sec"] = batch_size / mean
+        return out
+
+    def report(self, batch_size: int = 0) -> str:
+        s = self.summary(batch_size)
+        if not s:
+            return ""
+        msg = (
+            f"step {s['mean_ms']:.1f} ms avg "
+            f"(p50 {s['p50_ms']:.1f}, p99 {s['p99_ms']:.1f})"
+        )
+        if "samples_per_sec" in s:
+            msg += f", {s['samples_per_sec']:.1f} samples/sec"
+        return msg
+
+
+class TraceController:
+    """Starts/stops a jax.profiler trace over a configured step window."""
+
+    def __init__(self) -> None:
+        self.enabled = 0
+        self.trace_dir = "profile_out"
+        self.start_step = 5
+        self.num_steps = 10
+        self._active = False
+        self._done = False
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "profile":
+            self.enabled = int(val)
+        elif name == "profile_dir":
+            self.trace_dir = val
+        elif name == "profile_start":
+            self.start_step = int(val)
+        elif name == "profile_steps":
+            self.num_steps = int(val)
+
+    def configure(self, cfg: Sequence[ConfigEntry]) -> None:
+        for n, v in cfg:
+            self.set_param(n, v)
+
+    def step(self, global_step: int) -> None:
+        """Call once per training step with the global step index."""
+        if not self.enabled or self._done:
+            return
+        import jax
+
+        if not self._active and global_step >= self.start_step:
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+            self._stop_at = global_step + self.num_steps
+        elif self._active and global_step >= self._stop_at:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True

@@ -186,3 +186,32 @@ def test_test_io_mode(tmp_path):
     r = run_cli([conf, "test_io=1"], str(tmp_path))
     assert r.returncode == 0, r.stderr
     assert "start I/O test" in r.stdout
+
+
+def test_profiler_utils(tmp_path):
+    """StepTimer stats + TraceController trace files on disk."""
+    import time as _time
+
+    from cxxnet_tpu.utils.profiler import StepTimer, TraceController
+
+    t = StepTimer()
+    for _ in range(6):
+        t.start(); _time.sleep(0.002); t.stop()
+    s = t.summary(batch_size=16)
+    assert s["steps"] == 6 and s["mean_ms"] >= 1.5
+    assert s["samples_per_sec"] > 0
+    assert "p99" in t.report(16)
+
+    tr = TraceController()
+    tr.configure([("profile", "1"), ("profile_dir", str(tmp_path)),
+                  ("profile_start", "1"), ("profile_steps", "2")])
+    for i in range(5):
+        tr.step(i)
+    tr.close()
+    assert tr._done
+    import os
+    found = []
+    for root, _, files in os.walk(str(tmp_path)):
+        found.extend(files)
+    assert any("xplane" in f or f.endswith(".json.gz") or "trace" in f
+               for f in found), found

@@ -294,3 +294,38 @@ def test_affine_rotate90_exact(tmp_path):
     got = np.unravel_index(np.argmax(out[..., 0]), out[..., 0].shape)
     assert abs(got[0] - 2) <= 1 and abs(got[1] - 2) <= 1, got
     assert out.max() > 50  # mass preserved through bilinear resample
+
+
+def test_imgbin_partition_maker(tmp_path):
+    """Shard-splitting tool: size-bounded partitions + direct packing."""
+    import subprocess
+    import sys as _sys
+
+    from cxxnet_tpu.io.imgbin import iter_bin_pages
+
+    root = tmp_path / "imgs"
+    root.mkdir()
+    lst = tmp_path / "all.lst"
+    with open(lst, "w") as f:
+        for i in range(6):
+            p = root / f"im{i}.jpg"
+            p.write_bytes(b"x" * 2048)
+            f.write(f"{i}\t{float(i)}\t{p.name}\n")
+    out = tmp_path / "shards"
+    r = subprocess.run(
+        [_sys.executable, "tools/imgbin_partition_maker.py",
+         "--img_list", str(lst), "--img_root", str(root),
+         "--prefix", "train", "--out", str(out),
+         "--partition_size", "1", "--pack"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 0, r.stderr
+    pairs = [ln.split("\t") for ln in r.stdout.strip().splitlines()]
+    assert len(pairs) >= 1
+    total = 0
+    for lst_path, bin_path in pairs:
+        assert os.path.exists(lst_path) and os.path.exists(bin_path)
+        for page in iter_bin_pages(bin_path):
+            total += len(page)
+    assert total == 6  # every image landed in some shard

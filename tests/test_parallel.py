@@ -157,3 +157,32 @@ def test_tensor_parallel_weights_are_sharded():
         )
     )
     assert pred.shape == (16,)
+
+
+def test_update_on_server_zero1_state_sharding():
+    """update_on_server=1 -> optimizer state sharded over the data axis
+    (the server-side-SGD analog); training still matches single-device."""
+    from jax.sharding import PartitionSpec as P
+
+    cfg = [(k, v.format(n=7) if k == "dev" else v) for k, v in MLP_CFG]
+    cfg.append(("update_on_server", "1"))
+    tr = NetTrainer()
+    tr.set_params(cfg)
+    tr.init_model()
+    rng = np.random.RandomState(0)
+    data = rng.randn(5, 16, 10).astype(np.float32)
+    labels = rng.randint(0, 4, size=(5, 16, 1)).astype(np.float32)
+    for i in range(5):
+        tr.update_all(data[i], labels[i])
+    # momentum for fc1 (32,10): dim0 32 % 8 == 0 -> sharded over data
+    m = tr.ustates["l0_fc1"]["wmat"]["m"]
+    assert m.sharding.spec == P("data", None)
+    t1 = _train(1)
+    for key in t1.params:
+        for tag in t1.params[key]:
+            np.testing.assert_allclose(
+                np.asarray(t1.params[key][tag]),
+                np.asarray(tr.params[key][tag]),
+                rtol=2e-4, atol=2e-5,
+                err_msg=f"{key}/{tag} diverged under update_on_server",
+            )

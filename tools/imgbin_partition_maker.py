@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Split an image list into size-bounded shards and pack each to a CXBP bin.
+
+Parity: ``/root/reference/tools/imgbin-partition-maker.py`` (emits a
+Makefile of ``im2bin`` invocations, partitions bounded by cumulative file
+size, optional shuffle with a fixed seed).  This version can also pack
+directly (``--pack``), since the packer is in-process Python here; the
+resulting ``prefix_NNN.{lst,bin}`` pairs are what the ``imgbin`` iterator's
+``image_bin``/``image_list`` multi-shard config consumes (one shard per
+distributed worker, ``iter_thread_imbin_x-inl.hpp:108-139`` semantics).
+
+Usage:
+    python tools/imgbin_partition_maker.py --img_list all.lst \
+        --img_root /data/images --prefix train --out ./shards \
+        --partition_size 256 --shuffle 1 [--pack | --makefile Gen.mk]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def split_partitions(lines, img_root, max_bytes):
+    """Greedy split by cumulative image file size (reference rule:
+    start a new partition when adding ~10KB headroom would overflow)."""
+    parts = []
+    cur, sz = [], 0
+    for line in lines:
+        fname = line.rstrip("\n").split("\t")[-1]
+        path = os.path.join(img_root, fname)
+        fsz = os.path.getsize(path) if os.path.exists(path) else 10240
+        if cur and sz + 10240 > max_bytes:
+            parts.append(cur)
+            cur, sz = [], 0
+        cur.append(line)
+        sz += fsz
+    if cur:
+        parts.append(cur)
+    return parts
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--img_list", required=True)
+    ap.add_argument("--img_root", required=True)
+    ap.add_argument("--prefix", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--partition_size", default="256",
+                    help="max size of one bin, MB")
+    ap.add_argument("--shuffle", default="0")
+    ap.add_argument("--pack", action="store_true",
+                    help="pack shards now instead of emitting a Makefile")
+    ap.add_argument("--makefile", default="Gen.mk")
+    ap.add_argument("--im2bin", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "im2bin.py"))
+    args = ap.parse_args(argv)
+
+    random.seed(888)  # reference's fixed shuffle seed
+    with open(args.img_list, "r", encoding="utf-8") as f:
+        lst = [line for line in f if line.strip()]
+    if args.shuffle == "1":
+        random.shuffle(lst)
+
+    missing = [
+        line.rstrip("\n").split("\t")[-1]
+        for line in lst
+        if not os.path.exists(
+            os.path.join(args.img_root, line.rstrip("\n").split("\t")[-1])
+        )
+    ]
+    if missing and args.pack:
+        # fail before writing anything rather than leaving partial shards
+        raise SystemExit(
+            f"{len(missing)} listed images missing under {args.img_root} "
+            f"(first: {missing[0]})"
+        )
+    os.makedirs(args.out, exist_ok=True)
+    parts = split_partitions(
+        lst, args.img_root, int(args.partition_size) << 20
+    )
+    lst_bin = []
+    for i, part in enumerate(parts, start=1):
+        lst_path = os.path.join(args.out, f"{args.prefix}_{i:03d}.lst")
+        bin_path = os.path.join(args.out, f"{args.prefix}_{i:03d}.bin")
+        with open(lst_path, "w", encoding="utf-8") as f:
+            f.writelines(part)
+        lst_bin.append((lst_path, bin_path))
+
+    if args.pack:
+        from cxxnet_tpu.io.imgbin import BinPageWriter, parse_lst_line
+
+        for lst_path, bin_path in lst_bin:
+            writer = BinPageWriter(bin_path)
+            with open(lst_path, "r", encoding="utf-8") as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    _, _, fname = parse_lst_line(line)
+                    with open(os.path.join(args.img_root, fname), "rb") as im:
+                        writer.push(im.read())
+            writer.close()
+    else:
+        with open(args.makefile, "w", encoding="utf-8") as mk:
+            objs = " ".join(b for _, b in lst_bin)
+            mk.write(f"all: {objs}\n\n")
+            for lst_path, bin_path in lst_bin:
+                mk.write(
+                    f"{bin_path}: {lst_path}\n\tpython {args.im2bin} "
+                    f"{lst_path} {args.img_root} {bin_path}\n\n"
+                )
+    print(f"{len(parts)} partitions -> {args.out}", file=sys.stderr)
+    for lst_path, bin_path in lst_bin:
+        print(f"{lst_path}\t{bin_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
