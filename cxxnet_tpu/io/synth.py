@@ -29,6 +29,7 @@ class SyntheticIterator(DataIter):
         self.input_shape = (1, 1, 16)
         self.nclass = 10
         self.label_width = 1
+        self.layout = "auto"  # seq: emit (N, T, D) sequence batches
         self.batch_size = 0
         self.seed = 0
         self._loc = 0
@@ -49,13 +50,17 @@ class SyntheticIterator(DataIter):
             self.batch_size = int(val)
         elif name == "seed_data":
             self.seed = int(val)
+        elif name == "layout":
+            self.layout = val
 
     def init(self):
         if self.batch_size <= 0:
             raise ValueError("SyntheticIterator: batch_size must be set")
         rng = np.random.RandomState(1234 + self.seed)
         c, h, w = self.input_shape
-        if c == 1 and h == 1:
+        if self.layout == "seq":
+            shape = (self.nsample, h, w)
+        elif c == 1 and h == 1:
             shape = (self.nsample, w)
         else:
             shape = (self.nsample, h, w, c)

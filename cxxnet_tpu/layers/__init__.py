@@ -13,5 +13,5 @@ from .base import (  # noqa: F401
     layer_types,
     register,
 )
-from . import conv, elemwise, linear, loss, structure  # noqa: F401
+from . import conv, elemwise, linear, loss, sequence, structure  # noqa: F401
 from .pairtest import PairTestLayer  # noqa: F401

@@ -71,6 +71,17 @@ class SoftplusLayer(_UnaryLayer):
 
 
 @register
+class GeluLayer(_UnaryLayer):
+    """Gaussian error linear unit (transformer blocks; no reference
+    analog — the reference predates it)."""
+
+    type_name = "gelu"
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        return [jax.nn.gelu(inputs[0])]
+
+
+@register
 class XeluLayer(_UnaryLayer):
     """Leaky ReLU with negative slope ``1/b`` (xelu_layer-inl.hpp:17-45)."""
 

@@ -28,20 +28,23 @@ class FullConnectLayer(Layer):
     def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
         self._check_arity(in_shapes, 1)
         (shape,) = in_shapes
-        if len(shape) != 2:
-            raise ValueError("FullcLayer: input needs to be a matrix node")
+        if len(shape) not in (2, 3):
+            raise ValueError(
+                "FullcLayer: input needs to be a matrix or sequence node"
+            )
         if self.param.num_hidden <= 0:
             raise ValueError("FullcLayer: must set nhidden correctly")
-        nin = shape[1]
+        nin = shape[-1]
         if self.param.num_input_node == 0:
             self.param.num_input_node = nin
         elif self.param.num_input_node != nin:
             raise ValueError("FullcLayer: input hidden nodes inconsistent")
-        return [(shape[0], self.param.num_hidden)]
+        # sequence nodes (N, T, D) project per position
+        return [tuple(shape[:-1]) + (self.param.num_hidden,)]
 
     def init_params(self, key, in_shapes) -> Params:
         p = self.param
-        nin, nout = in_shapes[0][1], p.num_hidden
+        nin, nout = in_shapes[0][-1], p.num_hidden
         out: Params = {"wmat": p.rand_init_weight(key, (nout, nin), nin, nout)}
         if p.no_bias == 0:
             out["bias"] = jnp.full((nout,), p.init_bias, jnp.float32)

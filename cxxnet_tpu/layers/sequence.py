@@ -1,0 +1,165 @@
+"""Sequence layers: multi-head attention, layer norm, sequence pooling.
+
+New TPU-first scope — the reference is a CNN framework with no sequence
+axis (SURVEY §5), so these layers have no parity source; they follow the
+framework's own conventions (config-driven params, ``(nout, nin)`` weight
+layout, NHWC-style batch-major nodes).  Sequence nodes are ``(N, T, D)``
+(``input_layout = seq`` with ``input_shape = 1,T,D``).
+
+``attention`` config keys:
+
+* ``nhead`` — number of attention heads (D % nhead == 0)
+* ``causal`` — 1 for autoregressive masking
+* ``seq_parallel`` — 1 to run **ring attention** over the mesh's
+  ``model`` axis (sequence sharded, kv blocks rotating over ICI —
+  ``ops/attention.py``); requires T % model_axis == 0. Off the mesh (or
+  model axis 1) it falls back to plain attention.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .base import Layer, Params, Shape, register
+
+
+@register
+class AttentionLayer(Layer):
+    type_name = "attention"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.nhead = 1
+        self.causal = 0
+        self.seq_parallel = 0
+        self.mesh_plan = None  # bound by the trainer (bind_mesh)
+
+    def set_param(self, name, val):
+        if name == "nhead":
+            self.nhead = int(val)
+        elif name == "causal":
+            self.causal = int(val)
+        elif name == "seq_parallel":
+            self.seq_parallel = int(val)
+        else:
+            super().set_param(name, val)
+
+    def bind_mesh(self, plan) -> None:
+        self.mesh_plan = plan
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        self._check_arity(in_shapes, 1)
+        (shape,) = in_shapes
+        if len(shape) != 3:
+            raise ValueError(
+                "attention: input must be a sequence node (N, T, D); set "
+                "input_layout = seq"
+            )
+        n, t, d = shape
+        if self.nhead <= 0 or d % self.nhead != 0:
+            raise ValueError(
+                f"attention: nhead={self.nhead} must divide model dim {d}"
+            )
+        if self.seq_parallel and self.mesh_plan is not None:
+            nm = self.mesh_plan.n_model
+            if nm > 1 and t % nm != 0:
+                raise ValueError(
+                    f"attention: seq_parallel needs T={t} divisible by the "
+                    f"model axis ({nm})"
+                )
+        return [tuple(shape)]
+
+    def init_params(self, key, in_shapes) -> Params:
+        d = in_shapes[0][2]
+        p = self.param
+        k1, k2 = jax.random.split(key)
+        sigma = p.init_sigma if p.init_sigma else 0.02
+        return {
+            # framework (nout, nin) layout: fused qkv then output proj
+            "wmat": jax.random.normal(k1, (3 * d, d), jnp.float32) * sigma,
+            "bias": jnp.zeros((3 * d,), jnp.float32),
+            "wproj": jax.random.normal(k2, (d, d), jnp.float32) * sigma,
+            "bproj": jnp.zeros((d,), jnp.float32),
+        }
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        from ..ops.attention import mha, ring_self_attention
+
+        x = inputs[0]
+        n, t, d = x.shape
+        h = self.nhead
+        dh = d // h
+        qkv = x @ params["wmat"].astype(x.dtype).T + params["bias"].astype(
+            x.dtype
+        )
+        qkv = qkv.reshape(n, t, 3, h, dh)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        plan = self.mesh_plan
+        if self.seq_parallel and plan is not None and plan.n_model > 1:
+            o = ring_self_attention(
+                q, k, v, plan.mesh, "model", causal=bool(self.causal)
+            )
+        else:
+            o = mha(q, k, v, causal=bool(self.causal))
+        o = o.reshape(n, t, d)
+        return [
+            o @ params["wproj"].astype(x.dtype).T
+            + params["bproj"].astype(x.dtype)
+        ]
+
+
+@register
+class LayerNormLayer(Layer):
+    type_name = "layer_norm"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.eps = 1e-6
+
+    def set_param(self, name, val):
+        if name == "eps":
+            self.eps = float(val)
+        else:
+            super().set_param(name, val)
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        self._check_arity(in_shapes, 1)
+        return [tuple(in_shapes[0])]
+
+    def init_params(self, key, in_shapes) -> Params:
+        d = in_shapes[0][-1]
+        return {
+            "wmat": jnp.ones((d,), jnp.float32),
+            "bias": jnp.zeros((d,), jnp.float32),
+        }
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        x = inputs[0]
+        xf = x.astype(jnp.float32)  # stats in f32 under mixed precision
+        mu = xf.mean(axis=-1, keepdims=True)
+        var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + jnp.float32(self.eps))
+        y = y * params["wmat"].astype(jnp.float32) + params["bias"].astype(
+            jnp.float32
+        )
+        return [y.astype(x.dtype)]
+
+
+@register
+class SeqPoolLayer(Layer):
+    """Mean-pool the time axis: (N, T, D) -> (N, D) classification head."""
+
+    type_name = "seq_pool"
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        self._check_arity(in_shapes, 1)
+        (shape,) = in_shapes
+        if len(shape) != 3:
+            raise ValueError("seq_pool: input must be a sequence node")
+        return [(shape[0], shape[2])]
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        return [inputs[0].mean(axis=1)]

@@ -76,3 +76,28 @@ class ChConcatLayer(_ConcatBase):
         if len(shape) != 4:
             raise ValueError("ch_concat: input must be an NHWC image node")
         return 3
+
+
+@register
+class ElemwiseSumLayer(Layer):
+    """n-ary elementwise sum (residual connections; no reference analog —
+    the reference's CNNs had none, transformer blocks need them)."""
+
+    type_name = "eltwise_sum"
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        if len(in_shapes) < 2:
+            raise ValueError("eltwise_sum: needs at least 2 inputs")
+        first = tuple(in_shapes[0])
+        for s in in_shapes[1:]:
+            if tuple(s) != first:
+                raise ValueError(
+                    f"eltwise_sum: shape mismatch {tuple(s)} vs {first}"
+                )
+        return [first]
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        out = inputs[0]
+        for x in inputs[1:]:
+            out = out + x
+        return [out]

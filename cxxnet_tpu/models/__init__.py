@@ -22,6 +22,7 @@ from .builders import (  # noqa: F401
     kaggle_bowl_conf,
     mnist_conv_conf,
     mnist_mlp_conf,
+    transformer_conf,
     vgg16_conf,
 )
 
@@ -32,4 +33,5 @@ MODEL_BUILDERS = {
     "googlenet": googlenet_conf,
     "vgg16": vgg16_conf,
     "kaggle_bowl": kaggle_bowl_conf,
+    "transformer": transformer_conf,
 }

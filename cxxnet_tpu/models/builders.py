@@ -386,3 +386,73 @@ def kaggle_bowl_conf(
     )
     extra = "metric = logloss\n"
     return data + net + _tail(batch_size, shape, 100, eta=0.01, dev=dev, extra=extra)
+
+
+# ---------------------------------------------------------------------------
+def transformer_conf(
+    batch_size: int = 32,
+    seq_len: int = 128,
+    dim: int = 128,
+    nhead: int = 4,
+    nlayer: int = 2,
+    num_class: int = 10,
+    causal: int = 0,
+    seq_parallel: int = 0,
+    synthetic: bool = False,
+    nsample: int = 0,
+    dev: str = "tpu",
+    compute_dtype: str = "bfloat16",
+) -> str:
+    """Pre-norm transformer encoder classifier over dense sequences.
+
+    New TPU-first scope (the reference has no sequence models): blocks of
+    layer_norm -> attention -> residual -> layer_norm -> mlp -> residual,
+    then mean pooling and a softmax head.  ``seq_parallel=1`` runs ring
+    attention with the sequence sharded over the mesh model axis
+    (``ops/attention.py``).
+    """
+    nsample = nsample or batch_size * 4
+    data = ""
+    if synthetic:
+        for kind, n in (("data", nsample), ("eval", batch_size * 2)):
+            data += (
+                f"{kind} = {'train' if kind == 'data' else 'test'}\n"
+                "iter = synthetic\n"
+                f"  nsample = {n}\n"
+                f"  input_shape = 1,{seq_len},{dim}\n"
+                f"  nclass = {num_class}\n"
+                "  layout = seq\n"
+                "iter = end\n"
+            )
+    s = "netconfig = start\n"
+    prev = "0"
+    for i in range(nlayer):
+        b = f"b{i}"
+        s += (
+            f"layer[{prev}->{b}_n1] = layer_norm:{b}_ln1\n"
+            f"layer[{b}_n1->{b}_a] = attention:{b}_attn\n"
+            f"  nhead = {nhead}\n"
+            f"  causal = {causal}\n"
+            f"  seq_parallel = {seq_parallel}\n"
+            f"layer[{prev},{b}_a->{b}_r1] = eltwise_sum\n"
+            f"layer[{b}_r1->{b}_n2] = layer_norm:{b}_ln2\n"
+            f"layer[{b}_n2->{b}_h] = fullc:{b}_fc1\n"
+            f"  nhidden = {dim * 4}\n  init_sigma = 0.02\n"
+            f"layer[+1:{b}_g] = gelu\n"
+            f"layer[{b}_g->{b}_o] = fullc:{b}_fc2\n"
+            f"  nhidden = {dim}\n  init_sigma = 0.02\n"
+            f"layer[{b}_r1,{b}_o->{b}_r2] = eltwise_sum\n"
+        )
+        prev = f"{b}_r2"
+    s += (
+        f"layer[{prev}->pool] = seq_pool\n"
+        f"layer[pool->fc] = fullc:head\n"
+        f"  nhidden = {num_class}\n  init_sigma = 0.02\n"
+        "layer[fc->fc] = softmax\n"
+        "netconfig = end\n"
+        "input_layout = seq\n"
+    )
+    extra = f"compute_dtype = {compute_dtype}\n"
+    return data + s + _tail(
+        batch_size, f"1,{seq_len},{dim}", 10, eta=0.01, dev=dev, extra=extra
+    )

@@ -64,6 +64,7 @@ class NetGraph:
         self.layercfg: List[List[ConfigEntry]] = []
         self.defcfg: List[ConfigEntry] = []
         self.input_shape: Tuple[int, int, int] = (0, 0, 0)  # (C, H, W)
+        self.input_layout = "auto"  # auto: flat/NHWC by shape; seq: (N,T,D)
         self.extra_data_num = 0
         self.extra_shape: List[Tuple[int, int, int]] = []
         self.updater_type = "sgd"
@@ -108,6 +109,10 @@ class NetGraph:
                     )
                 z, y, x = (int(p) for p in parts)
                 self.input_shape = (z, y, x)
+            if not self._initialized and name == "input_layout":
+                if val not in ("auto", "seq"):
+                    raise ValueError("input_layout must be auto or seq")
+                self.input_layout = val
             if netcfg_mode != 2:
                 self._set_global_param(name, val)
             if name == "netconfig" and val == "start":
@@ -258,6 +263,7 @@ class NetGraph:
         return json.dumps(
             {
                 "input_shape": list(self.input_shape),
+                "input_layout": self.input_layout,
                 "extra_data_num": self.extra_data_num,
                 "extra_shape": [list(s) for s in self.extra_shape],
                 "node_names": self.node_names,
@@ -270,6 +276,7 @@ class NetGraph:
         d = json.loads(s)
         g = cls()
         g.input_shape = tuple(d["input_shape"])
+        g.input_layout = d.get("input_layout", "auto")
         g.extra_data_num = d["extra_data_num"]
         g.extra_shape = [tuple(x) for x in d["extra_shape"]]
         for nm in d["node_names"]:

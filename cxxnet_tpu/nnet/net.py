@@ -62,13 +62,15 @@ class FunctionalNet:
             self.param_key.append(f"l{i}_{tag}")
         self._configure_layers()
         self.node_shapes: List[Optional[Tuple[int, ...]]] = []
-        # params kept in f32 even under mixed precision (norm layers)
+        # params kept in f32 even under mixed precision (norm layers,
+        # whose math runs in f32 — a bf16 round-trip would only lose bits)
         from ..layers.conv import BatchNormLayer
+        from ..layers.sequence import LayerNormLayer
 
         self._f32_param_keys = {
             self.param_key[i]
             for i, lay in enumerate(self.layer_objs)
-            if isinstance(lay, BatchNormLayer)
+            if isinstance(lay, (BatchNormLayer, LayerNormLayer))
         }
 
     # ------------------------------------------------------------------
@@ -112,6 +114,9 @@ class FunctionalNet:
     # ------------------------------------------------------------------
     def input_node_shape(self, batch_size: int) -> Tuple[int, ...]:
         c, h, w = self.graph.input_shape
+        if self.graph.input_layout == "seq":
+            # sequence node: input_shape = 1,T,D -> (N, T, D)
+            return (batch_size, h, w)
         if c == 1 and h == 1:
             return (batch_size, w)
         return (batch_size, h, w, c)

@@ -139,9 +139,17 @@ class NetTrainer:
                 ustates[key][tag] = up.init_state(w)
         self.ustates = ustates
 
+    def _bind_mesh_to_layers(self) -> None:
+        """Hand the mesh plan to layers that run their own collectives
+        (ring attention's shard_map needs the Mesh object)."""
+        for lay in self.net.layer_objs:
+            if hasattr(lay, "bind_mesh"):
+                lay.bind_mesh(self.mesh_plan)
+
     def init_model(self) -> None:
         self._build_net()
         self._build_mesh()
+        self._bind_mesh_to_layers()
         self._rng_key = jax.random.PRNGKey(self.seed)
         self._rng_key, sub = jax.random.split(self._rng_key)
         self.params = self.net.init_params(sub, self.batch_size)
@@ -568,6 +576,7 @@ class NetTrainer:
         graph = NetGraph.structure_from_json(json.dumps(header["structure"]))
         self._build_net(graph)
         self._build_mesh()
+        self._bind_mesh_to_layers()
         self.epoch_counter = int(header["epoch_counter"])
         self.sample_counter = 0
         self._rng_key = jax.random.PRNGKey(self.seed + 1)

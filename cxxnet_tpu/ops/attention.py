@@ -1,0 +1,158 @@
+"""Multi-head attention: plain, and ring (sequence-parallel) variants.
+
+The reference framework predates attention entirely (SURVEY §5: no
+sequence axis anywhere), so this op is new TPU-first scope: long-context
+support via **ring attention** — the sequence is sharded over a mesh
+axis, each device holds a query block, and key/value blocks rotate
+around the ring with ``lax.ppermute`` while a numerically-stable
+streaming softmax (log-sum-exp merging, the flash-attention recurrence)
+accumulates the output.  Compute on each hop overlaps the neighbour
+exchange; memory per device is O(T/n) instead of O(T), and the ICI ring
+is exactly the topology TPU slices provide.
+
+Layouts: ``q, k, v`` are ``(B, T, H, Dh)`` (batch, time, heads, head
+dim).  ``mha`` is the single-device golden model; ``ring_attention`` is
+the per-shard computation to run under ``shard_map`` with the time axis
+sharded on ``axis_name``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _scores(q: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """(B,Tq,H,D),(B,Tk,H,D) -> (B,H,Tq,Tk) scaled dot product (f32)."""
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    )
+    return s * (1.0 / jnp.sqrt(jnp.float32(d)))
+
+
+def _causal_mask(tq: int, tk: int, q_off, k_off) -> jnp.ndarray:
+    """True where query position >= key position (may attend)."""
+    qi = q_off + lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+    ki = k_off + lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+    return qi >= ki
+
+
+def mha(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Plain softmax attention — the golden model for the ring variant."""
+    s = _scores(q, k)
+    if causal:
+        mask = _causal_mask(q.shape[1], k.shape[1], 0, 0)
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(v.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Blockwise ring attention over a sequence-sharded mesh axis.
+
+    Call under ``shard_map`` with q/k/v time-sharded on ``axis_name``;
+    each of the ``n`` devices sees ``(B, T/n, H, Dh)`` blocks.  The kv
+    block makes ``n`` hops around the ring; the output never leaves its
+    device.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    qf = q.astype(jnp.float32)
+
+    o0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    m0 = jnp.full((b, h, tq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tq), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, hop):
+        """One ring hop: streaming-softmax merge of the kv block that
+        arrived from device (idx - hop) % n, then rotate kv onward."""
+        o, m, l, kb, vb = carry
+        src = (idx - hop) % n
+        s = _scores(qf, kb.astype(jnp.float32))
+        if causal:
+            mask = _causal_mask(tq, tk, idx * tq, src * tk)
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        l_new = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vb.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+        kb, vb = lax.ppermute((kb, vb), axis_name, perm)
+        return (o_new, m_new, l_new, kb, vb), None
+
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(n)
+    )
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows (strict causal pad)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(v.dtype)
+
+
+def ring_self_attention(
+    x_q: jnp.ndarray,
+    x_k: jnp.ndarray,
+    x_v: jnp.ndarray,
+    mesh,
+    seq_axis: str = "model",
+    *,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """shard_map wrapper: global (B,T,H,Dh) arrays, T sharded on
+    ``seq_axis`` (batch on ``data``); returns the same global layout."""
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    spec = P("data", seq_axis, None, None)
+    kw = {"check_vma": False}  # jax >= 0.9 name; older jax: check_rep
+    try:
+        fn = shard_map(
+            functools.partial(
+                ring_attention, axis_name=seq_axis, causal=causal
+            ),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **kw,
+        )
+    except TypeError:  # pragma: no cover - pre-0.9 jax
+        fn = shard_map(
+            functools.partial(
+                ring_attention, axis_name=seq_axis, causal=causal
+            ),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False,
+        )
+    return fn(x_q, x_k, x_v)

@@ -33,7 +33,8 @@ def test_model_shapes(name):
     # output layer is softmax over the right class count
     out = shapes[tr.net.out_node_index()]
     expect = {"mnist_mlp": 10, "mnist_conv": 10, "alexnet": 1000,
-              "googlenet": 1000, "vgg16": 1000, "kaggle_bowl": 121}[name]
+              "googlenet": 1000, "vgg16": 1000, "kaggle_bowl": 121,
+              "transformer": 10}[name]
     assert out[-1] == expect
 
 
