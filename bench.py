@@ -24,6 +24,8 @@ def main() -> None:
 
     from __graft_entry__ import _build_googlenet
 
+    # lrn layers self-probe the Pallas kernel (lrn_impl=auto) and fall
+    # back to the XLA lowering if the backend can't compile it
     tr = _build_googlenet(batch_size=batch, input_size=224, dev="tpu")
     tr.eval_train = 0  # pure step time; no per-step metric fetch
 

@@ -228,6 +228,24 @@ class InsanityPoolingLayer(_PoolBase):
         return [self._pool(x, lax.max, -jnp.inf)]
 
 
+_PALLAS_LRN_OK: dict = {}
+
+
+def _pallas_lrn_works() -> bool:
+    """One-time compile probe so ``lrn_impl=auto`` can never take down a
+    run on a backend whose Pallas lowering is broken/unavailable."""
+    if "ok" not in _PALLAS_LRN_OK:
+        try:
+            from ..ops.lrn import lrn
+
+            lrn(jnp.ones((8, 128), jnp.float32), 5, 1e-4, 0.75, 1.0
+                ).block_until_ready()
+            _PALLAS_LRN_OK["ok"] = True
+        except Exception:  # pragma: no cover - backend-specific
+            _PALLAS_LRN_OK["ok"] = False
+    return _PALLAS_LRN_OK["ok"]
+
+
 @register
 class LRNLayer(Layer):
     type_name = "lrn"
@@ -262,7 +280,7 @@ class LRNLayer(Layer):
         if self.impl == "xla":
             return False
         try:
-            return jax.default_backend() == "tpu"
+            return jax.default_backend() == "tpu" and _pallas_lrn_works()
         except RuntimeError:
             return False
 
@@ -291,6 +309,8 @@ class BatchNormLayer(Layer):
         self.init_slope = 1.0
         self.init_bias_bn = 0.0
         self.eps = 1e-10
+        self.bn_eval = "batch"  # reference parity; "running" for EMA stats
+        self.bn_momentum = 0.9
 
     def set_param(self, name, val):
         if name == "init_slope":
@@ -299,6 +319,12 @@ class BatchNormLayer(Layer):
             self.init_bias_bn = float(val)
         elif name == "eps":
             self.eps = float(val)
+        elif name == "bn_eval":
+            if val not in ("batch", "running"):
+                raise ValueError("bn_eval must be batch or running")
+            self.bn_eval = val
+        elif name == "bn_momentum":
+            self.bn_momentum = float(val)
         else:
             super().set_param(name, val)
 
@@ -313,15 +339,56 @@ class BatchNormLayer(Layer):
             "bias": jnp.full((ch,), self.init_bias_bn, jnp.float32),
         }
 
-    def apply(self, params, inputs, *, train=False, rng=None, step=None):
-        x = inputs[0]
-        axes = tuple(range(x.ndim - 1))  # all but channel
-        # statistics always in f32: bf16 mean/var loses too many mantissa
-        # bits over a 100k-element reduction
-        xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=axes)
-        var = jnp.mean((xf - mean) ** 2, axis=axes)
+    def init_aux(self, in_shapes):
+        """EMA statistics state (only with ``bn_eval = running``).
+
+        The reference always normalized with *current-minibatch* stats,
+        even at eval (doc/layer.md:235-240 caveat) — that stays the
+        default; ``bn_eval = running`` upgrades eval to the standard
+        moving-average statistics carried as trainer aux state."""
+        if self.bn_eval != "running":
+            return {}
+        ch = in_shapes[0][-1]
+        return {
+            "rmean": jnp.zeros((ch,), jnp.float32),
+            "rvar": jnp.ones((ch,), jnp.float32),
+        }
+
+    def _normalize(self, x, mean, var, params):
         inv = lax.rsqrt(var + jnp.float32(self.eps))
         slope = params["wmat"].astype(jnp.float32)
         bias = params["bias"].astype(jnp.float32)
-        return [((xf - mean) * inv * slope + bias).astype(x.dtype)]
+        return ((x.astype(jnp.float32) - mean) * inv * slope + bias).astype(
+            x.dtype
+        )
+
+    def _batch_stats(self, x):
+        # statistics always in f32: bf16 mean/var loses too many mantissa
+        # bits over a 100k-element reduction
+        axes = tuple(range(x.ndim - 1))  # all but channel
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.mean((xf - mean) ** 2, axis=axes)
+        return mean, var
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        x = inputs[0]
+        mean, var = self._batch_stats(x)
+        return [self._normalize(x, mean, var, params)]
+
+    def apply_stateful(self, params, aux, inputs, *, train=False, rng=None,
+                       step=None):
+        """(outs, new_aux): batch stats + EMA update in train, running
+        stats at eval.  Only routed when init_aux returned state."""
+        x = inputs[0]
+        if train:
+            mean, var = self._batch_stats(x)
+            mom = jnp.float32(self.bn_momentum)
+            new_aux = {
+                "rmean": aux["rmean"] * mom + (1.0 - mom) * mean,
+                "rvar": aux["rvar"] * mom + (1.0 - mom) * var,
+            }
+            return [self._normalize(x, mean, var, params)], new_aux
+        return [
+            self._normalize(x, aux["rmean"], aux["rvar"], params)
+        ], aux

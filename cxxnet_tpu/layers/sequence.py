@@ -76,7 +76,7 @@ class AttentionLayer(Layer):
         d = in_shapes[0][2]
         p = self.param
         k1, k2 = jax.random.split(key)
-        sigma = p.init_sigma if p.init_sigma else 0.02
+        sigma = p.init_sigma  # framework default 0.01; set via init_sigma
         return {
             # framework (nout, nin) layout: fused qkv then output proj
             "wmat": jax.random.normal(k1, (3 * d, d), jnp.float32) * sigma,

@@ -434,6 +434,7 @@ def transformer_conf(
             f"  nhead = {nhead}\n"
             f"  causal = {causal}\n"
             f"  seq_parallel = {seq_parallel}\n"
+            "  init_sigma = 0.02\n"
             f"layer[{prev},{b}_a->{b}_r1] = eltwise_sum\n"
             f"layer[{b}_r1->{b}_n2] = layer_norm:{b}_ln2\n"
             f"layer[{b}_n2->{b}_h] = fullc:{b}_fc1\n"

@@ -45,6 +45,7 @@ class FunctionalNet:
         self.batch_size = 0
         self.update_period = 1
         self.compute_dtype = jnp.float32
+        self.remat = 0
         # instantiate layers (shared layers alias the primary instance)
         self.layer_objs: List[Layer] = []
         self.param_key: List[Optional[str]] = []  # params pytree key per layer
@@ -81,6 +82,11 @@ class FunctionalNet:
                 self.batch_size = int(val)
             elif name == "update_period":
                 self.update_period = int(val)
+            elif name == "remat":
+                # jax.checkpoint each layer: recompute activations in
+                # backprop instead of keeping them in HBM (memory for
+                # FLOPs — lets bigger batches fit per chip)
+                self.remat = int(val)
             elif name == "compute_dtype":
                 if val in ("bfloat16", "bf16"):
                     self.compute_dtype = jnp.bfloat16
@@ -156,6 +162,21 @@ class FunctionalNet:
         return shapes  # type: ignore[return-value]
 
     # ------------------------------------------------------------------
+    def init_aux(self, batch_size: int) -> Dict[str, dict]:
+        """Non-gradient layer state (e.g. batch-norm running statistics
+        with ``bn_eval = running``); empty dict when no layer carries any."""
+        shapes = self.infer_shapes(batch_size)
+        aux: Dict[str, dict] = {}
+        for i, spec in enumerate(self.graph.layers):
+            if spec.type_name == "shared":
+                continue
+            lay = self.layer_objs[i]
+            if hasattr(lay, "init_aux"):
+                st = lay.init_aux([shapes[n] for n in spec.nindex_in])
+                if st:
+                    aux[self.param_key[i]] = st
+        return aux
+
     def init_params(self, key: jax.Array, batch_size: int) -> Dict[str, dict]:
         shapes = self.infer_shapes(batch_size)
         params: Dict[str, dict] = {}
@@ -181,7 +202,9 @@ class FunctionalNet:
         train: bool = False,
         rng: Optional[jax.Array] = None,
         step: Optional[jnp.ndarray] = None,
-    ) -> Tuple[List[Optional[jnp.ndarray]], jnp.ndarray]:
+        aux: Optional[Dict[str, dict]] = None,
+        return_aux: bool = False,
+    ):
         """Execute the graph.
 
         Returns ``(node_values, total_scaled_loss)``.  ``labels`` is the
@@ -203,6 +226,10 @@ class FunctionalNet:
             data = data.astype(cdt)
             extras = [e.astype(cdt) for e in extras]
         out_idx = self.out_node_index()
+        # collect per-layer state updates when the caller threads aux in
+        new_aux: Optional[Dict[str, dict]] = (
+            dict(aux) if (aux is not None and return_aux) else None
+        )
         nodes: List[Optional[jnp.ndarray]] = [None] * g.num_nodes
         nodes[0] = data
         for k, e in enumerate(extras):
@@ -228,15 +255,39 @@ class FunctionalNet:
                     out = out.astype(cdt)
                 nodes[spec.nindex_out[0]] = out
             else:
-                outs = lay.apply(
-                    params.get(self.param_key[i], {}),
-                    inputs,
-                    train=train,
-                    rng=lrng,
-                    step=step,
-                )
+                key = self.param_key[i]
+                lparams = params.get(key, {})
+                # shared stateful layers chain their state: a later
+                # occurrence reads the state the earlier one produced
+                if new_aux is not None:
+                    lstate = new_aux.get(key)
+                elif aux is not None:
+                    lstate = aux.get(key)
+                else:
+                    lstate = None
+                if lstate is not None and hasattr(lay, "apply_stateful"):
+                    outs, new_state = lay.apply_stateful(
+                        lparams, lstate, inputs,
+                        train=train, rng=lrng, step=step,
+                    )
+                    if new_aux is not None:
+                        new_aux[key] = new_state
+                elif self.remat and train:
+
+                    def run(p, xs, lay=lay, lrng=lrng):
+                        return lay.apply(
+                            p, xs, train=True, rng=lrng, step=step
+                        )
+
+                    outs = jax.checkpoint(run)(lparams, inputs)
+                else:
+                    outs = lay.apply(
+                        lparams, inputs, train=train, rng=lrng, step=step
+                    )
                 for n, v in zip(spec.nindex_out, outs):
                     nodes[n] = v
+        if return_aux:
+            return nodes, total_loss, (new_aux if new_aux is not None else {})
         return nodes, total_loss
 
     def _label_field(self, labels: jnp.ndarray, target: str) -> jnp.ndarray:

@@ -69,6 +69,7 @@ class NetTrainer:
         self.model_parallel = 1
         self.update_on_server = 0
         self.mesh_plan: Optional[MeshPlan] = None
+        self.aux = {}  # non-gradient layer state (BN running stats)
         self.metric = MetricSet()
         self.train_metric = MetricSet()
         self._grad_accum = None
@@ -153,6 +154,7 @@ class NetTrainer:
         self._rng_key = jax.random.PRNGKey(self.seed)
         self._rng_key, sub = jax.random.split(self._rng_key)
         self.params = self.net.init_params(sub, self.batch_size)
+        self.aux = self.net.init_aux(self.batch_size)
         self._build_updaters()
         self.epoch_counter = 0
         self.sample_counter = 0
@@ -208,15 +210,15 @@ class NetTrainer:
                 new_s[key][tag] = s2
         return new_p, new_s
 
-    def _loss_and_out(self, params, data, labels, rng, epoch, extras):
-        """(loss, out_node) with train=True — shared by fused/fwd_train."""
+    def _loss_and_out(self, params, aux, data, labels, rng, epoch, extras):
+        """(loss, (out_node, new_aux)) with train=True — fused/fwd_train."""
         net = self.net
-        nodes, loss = net.forward(
+        nodes, loss, new_aux = net.forward(
             params, data, labels=labels, extras=extras,
-            train=True, rng=rng, step=epoch,
+            train=True, rng=rng, step=epoch, aux=aux, return_aux=True,
         )
         # metrics consume the out node on host: always hand back f32
-        return loss, nodes[net.out_node_index()].astype(jnp.float32)
+        return loss, (nodes[net.out_node_index()].astype(jnp.float32), new_aux)
 
     def _fused_step_fn(self):
         """fwd + bwd + updater math as ONE donated SPMD program.
@@ -235,19 +237,21 @@ class NetTrainer:
             loss_and_out = self._loss_and_out
             apply_updates = self._apply_updates
 
-            def step(params, ustates, data, labels, rng, epoch, extras):
-                (loss, out), grads = jax.value_and_grad(
-                    lambda p: loss_and_out(p, data, labels, rng, epoch, extras),
+            def step(params, ustates, aux, data, labels, rng, epoch, extras):
+                (loss, (out, new_aux)), grads = jax.value_and_grad(
+                    lambda p: loss_and_out(
+                        p, aux, data, labels, rng, epoch, extras
+                    ),
                     has_aux=True,
                 )(params)
                 new_p, new_s = apply_updates(updaters, params, ustates, grads, epoch)
-                return new_p, new_s, loss, out
+                return new_p, new_s, new_aux, loss, out
 
             self._jit_cache["fused"] = jax.jit(
                 step,
-                in_shardings=(psh, ush, dsh, dsh, rep, rep, ex),
-                out_shardings=(psh, ush, rep, dsh),
-                donate_argnums=(0, 1),
+                in_shardings=(psh, ush, rep, dsh, dsh, rep, rep, ex),
+                out_shardings=(psh, ush, rep, rep, dsh),
+                donate_argnums=(0, 1, 2),
             )
         return self._jit_cache["fused"]
 
@@ -255,17 +259,19 @@ class NetTrainer:
         if "grad" not in self._jit_cache:
             net = self.net
 
-            def loss_fn(params, data, labels, rng, step, extras):
-                return net.loss_fn(
-                    params, data, labels, train=True, rng=rng, step=step, extras=extras
+            def loss_fn(params, aux, data, labels, rng, step, extras):
+                _, loss, new_aux = net.forward(
+                    params, data, labels=labels, extras=extras,
+                    train=True, rng=rng, step=step, aux=aux, return_aux=True,
                 )
+                return loss, new_aux
 
             rep, dsh, ex = self._sh()
             psh, _ = self._param_sh()
             self._jit_cache["grad"] = jax.jit(
-                jax.value_and_grad(loss_fn),
-                in_shardings=(psh, dsh, dsh, rep, rep, ex),
-                out_shardings=(rep, psh),
+                jax.value_and_grad(loss_fn, has_aux=True),
+                in_shardings=(psh, rep, dsh, dsh, rep, rep, ex),
+                out_shardings=((rep, rep), psh),
             )
         return self._jit_cache["grad"]
 
@@ -274,19 +280,21 @@ class NetTrainer:
         if "fwd_train" not in self._jit_cache:
             loss_and_out = self._loss_and_out
 
-            def f(params, data, labels, rng, step, extras):
-                (loss, out), grads = jax.value_and_grad(
-                    lambda p: loss_and_out(p, data, labels, rng, step, extras),
+            def f(params, aux, data, labels, rng, step, extras):
+                (loss, (out, new_aux)), grads = jax.value_and_grad(
+                    lambda p: loss_and_out(
+                        p, aux, data, labels, rng, step, extras
+                    ),
                     has_aux=True,
                 )(params)
-                return loss, out, grads
+                return loss, out, new_aux, grads
 
             rep, dsh, ex = self._sh()
             psh, _ = self._param_sh()
             self._jit_cache["fwd_train"] = jax.jit(
                 f,
-                in_shardings=(psh, dsh, dsh, rep, rep, ex),
-                out_shardings=(rep, dsh, psh),
+                in_shardings=(psh, rep, dsh, dsh, rep, rep, ex),
+                out_shardings=(rep, dsh, rep, psh),
             )
         return self._jit_cache["fwd_train"]
 
@@ -295,14 +303,16 @@ class NetTrainer:
             net = self.net
             out_idx = net.out_node_index()
 
-            def f(params, data, extras):
-                nodes, _ = net.forward(params, data, extras=extras, train=False)
+            def f(params, aux, data, extras):
+                nodes, _ = net.forward(
+                    params, data, extras=extras, train=False, aux=aux
+                )
                 return nodes[out_idx].astype(jnp.float32)
 
             rep, dsh, ex = self._sh()
             psh, _ = self._param_sh()
             self._jit_cache["eval"] = jax.jit(
-                f, in_shardings=(psh, dsh, ex), out_shardings=dsh
+                f, in_shardings=(psh, rep, dsh, ex), out_shardings=dsh
             )
         return self._jit_cache["eval"]
 
@@ -311,14 +321,16 @@ class NetTrainer:
         if key not in self._jit_cache:
             net = self.net
 
-            def f(params, data, extras):
-                nodes, _ = net.forward(params, data, extras=extras, train=False)
+            def f(params, aux, data, extras):
+                nodes, _ = net.forward(
+                    params, data, extras=extras, train=False, aux=aux
+                )
                 return nodes[node_id].astype(jnp.float32)
 
             rep, dsh, ex = self._sh()
             psh, _ = self._param_sh()
             self._jit_cache[key] = jax.jit(
-                f, in_shardings=(psh, dsh, ex), out_shardings=dsh
+                f, in_shardings=(psh, rep, dsh, ex), out_shardings=dsh
             )
         return self._jit_cache[key]
 
@@ -376,9 +388,11 @@ class NetTrainer:
         step = jnp.asarray(self.epoch_counter, jnp.int32)
         if self.update_period == 1:
             # fused SPMD fast path: fwd+bwd+update in one donated program
-            self.params, self.ustates, loss, out = self._fused_step_fn()(
-                self.params, self.ustates, data, labels,
-                self._next_rng(), step, extras,
+            (self.params, self.ustates, self.aux, loss, out) = (
+                self._fused_step_fn()(
+                    self.params, self.ustates, self.aux, data, labels,
+                    self._next_rng(), step, extras,
+                )
             )
             if self.eval_train:
                 self.train_metric.add_eval(
@@ -388,16 +402,18 @@ class NetTrainer:
             self.epoch_counter += 1
             return
         if self.eval_train:
-            loss, out, grads = self._fwd_train_fn()(
-                self.params, data, labels, self._next_rng(), step, extras
+            loss, out, self.aux, grads = self._fwd_train_fn()(
+                self.params, self.aux, data, labels,
+                self._next_rng(), step, extras,
             )
             self.train_metric.add_eval(
                 fetch_local_rows(out), np.asarray(batch.label),
                 self._label_ranges(),
             )
         else:
-            loss, grads = self._grad_fn()(
-                self.params, data, labels, self._next_rng(), step, extras
+            (loss, self.aux), grads = self._grad_fn()(
+                self.params, self.aux, data, labels,
+                self._next_rng(), step, extras,
             )
         if self._grad_accum is None:
             self._grad_accum = grads
@@ -442,7 +458,7 @@ class NetTrainer:
                 for e in extras
             )
         out = fetch_local_rows(
-            fn(self.params, self._to_device(data),
+            fn(self.params, self.aux, self._to_device(data),
                tuple(self._to_device(e) for e in extras))
         )
         return out[:n] if pad else out
@@ -548,10 +564,14 @@ class NetTrainer:
             blob = f.read()
         npz = np.load(_io.BytesIO(blob))
         params: Dict[str, dict] = {}
+        aux: Dict[str, dict] = {}
         for k in npz.files:
             key, tag = k.rsplit("/", 1)
-            params.setdefault(key, {})[tag] = npz[k]
-        return header, params
+            if key.startswith("aux:"):
+                aux.setdefault(key[4:], {})[tag] = npz[k]
+            else:
+                params.setdefault(key, {})[tag] = npz[k]
+        return header, params, aux
 
     def save_model(self, path: str) -> None:
         header = {
@@ -564,6 +584,9 @@ class NetTrainer:
         for key, tags in self.params.items():
             for tag, w in tags.items():
                 flat[f"{key}/{tag}"] = fetch_array(w)
+        for key, tags in self.aux.items():
+            for tag, w in tags.items():
+                flat[f"aux:{key}/{tag}"] = fetch_array(w)
         np.savez(buf, **flat)
         with open(path, "wb") as f:
             f.write(MODEL_MAGIC)
@@ -572,7 +595,7 @@ class NetTrainer:
             f.write(buf.getvalue())
 
     def load_model(self, path: str) -> None:
-        header, raw = self._read_model_file(path)
+        header, raw, raw_aux = self._read_model_file(path)
         graph = NetGraph.structure_from_json(json.dumps(header["structure"]))
         self._build_net(graph)
         self._build_mesh()
@@ -584,6 +607,10 @@ class NetTrainer:
             key: {tag: jnp.asarray(w) for tag, w in tags.items()}
             for key, tags in raw.items()
         }
+        self.aux = self.net.init_aux(self.batch_size)
+        for key, tags in raw_aux.items():
+            if key in self.aux:
+                self.aux[key] = {t: jnp.asarray(w) for t, w in tags.items()}
         self.net.infer_shapes(self.batch_size)
         self._build_updaters()
 
@@ -591,7 +618,7 @@ class NetTrainer:
         """Finetune: fresh init, then copy name-matched layers' weights
         (nnet_impl-inl.hpp:101-134); epoch restarts at 0."""
         self.init_model()
-        header, old_params = self._read_model_file(path)
+        header, old_params, _old_aux = self._read_model_file(path)
         old = NetGraph.structure_from_json(json.dumps(header["structure"]))
         old_keys = {}
         for i, spec in enumerate(old.layers):

@@ -89,10 +89,9 @@ def ring_attention(
     l0 = jnp.zeros((b, h, tq), jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(carry, hop):
-        """One ring hop: streaming-softmax merge of the kv block that
-        arrived from device (idx - hop) % n, then rotate kv onward."""
-        o, m, l, kb, vb = carry
+    def merge(o, m, l, kb, vb, hop):
+        """Streaming-softmax merge of the kv block that arrived from
+        device (idx - hop) % n."""
         src = (idx - hop) % n
         s = _scores(qf, kb.astype(jnp.float32))
         if causal:
@@ -109,12 +108,22 @@ def ring_attention(
             preferred_element_type=jnp.float32,
         )
         o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
-        kb, vb = lax.ppermute((kb, vb), axis_name, perm)
-        return (o_new, m_new, l_new, kb, vb), None
+        return o_new, m_new, l_new
 
-    (o, m, l, _, _), _ = lax.scan(
-        step, (o0, m0, l0, k, v), jnp.arange(n)
-    )
+    # hop 0 merges the resident kv block; n-1 rotations follow (not n —
+    # the final block must not be rotated onward, that hop is wasted ICI)
+    o, m, l = merge(o0, m0, l0, k, v, 0)
+
+    def step(carry, hop):
+        o, m, l, kb, vb = carry
+        kb, vb = lax.ppermute((kb, vb), axis_name, perm)
+        o, m, l = merge(o, m, l, kb, vb, hop)
+        return (o, m, l, kb, vb), None
+
+    if n > 1:
+        (o, m, l, _, _), _ = lax.scan(
+            step, (o, m, l, k, v), jnp.arange(1, n)
+        )
     l = jnp.maximum(l, 1e-30)  # fully-masked rows (strict causal pad)
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(v.dtype)

@@ -98,7 +98,7 @@ def test_step_output_is_sharded():
     leaf = jax.tree_util.tree_leaves(tr.params)[0]
     assert leaf.sharding.is_fully_replicated
     # the eval output is data-sharded over all 8 devices
-    out = tr._eval_fn()(tr.params, jnp.zeros((16, 10), jnp.float32), ())
+    out = tr._eval_fn()(tr.params, tr.aux, jnp.zeros((16, 10), jnp.float32), ())
     assert out.sharding.spec == P("data")
     assert len(out.sharding.device_set) == 8
 
