@@ -1,8 +1,9 @@
 """Spatial layers: conv, pooling family, LRN, batch-norm.
 
-All operate on NHWC arrays and lower to XLA's native TPU ops
-(``lax.conv_general_dilated`` → MXU, ``lax.reduce_window`` → vector unit)
-instead of the reference's im2col-GEMM / mshadow ``pool`` expressions.
+All operate on NHWC arrays and lower TPU-shaped: conv via
+``lax.conv_general_dilated`` (MXU); pooling as shifted-slice max/add trees
+(VPU — avoiding reduce_window's select-and-scatter backward); LRN via a
+Pallas kernel on TPU.  No im2col-GEMM / mshadow ``pool`` expressions.
 
 Parity sources:
 * conv — ``/root/reference/src/layer/convolution_layer-inl.hpp``
@@ -113,7 +114,7 @@ class ConvolutionLayer(Layer):
 
 
 class _PoolBase(Layer):
-    """Shared ceil-shape pooling over NHWC via ``lax.reduce_window``."""
+    """Shared ceil-shape pooling over NHWC (shifted-slice tree, see _pool)."""
 
     def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
         self._check_arity(in_shapes, 1)
@@ -136,20 +137,39 @@ class _PoolBase(Layer):
         ]
 
     def _pool(self, x: jnp.ndarray, reducer, init_val) -> jnp.ndarray:
+        """Pooling as a max/add tree over k*k statically-shifted strided
+        slices — NOT ``lax.reduce_window``.
+
+        TPU-shaped on purpose: the backward of ``reduce_window(max)`` is
+        select-and-scatter, which XLA lowers poorly on TPU (orders of
+        magnitude slower than the forward for overlapping windows, e.g.
+        the stride-1 3x3 pools in every inception block).  A shifted
+        max/add tree autodiffs to pad + select chains: pure VPU work,
+        and XLA fuses the whole tree.
+        """
         p = self.param
+        kh, kw, s = p.kernel_height, p.kernel_width, p.stride
         h, w = x.shape[1], x.shape[2]
-        pad_h = _pool_pad(h, p.kernel_height, p.stride, p.pad_y)
-        pad_w = _pool_pad(w, p.kernel_width, p.stride, p.pad_x)
-        # init must stay a Python-scalar literal: a traced array init
-        # defeats reduce_window's monoid-recognition and kills autodiff
-        return lax.reduce_window(
+        (plh, prh) = _pool_pad(h, kh, s, p.pad_y)
+        (plw, prw) = _pool_pad(w, kw, s, p.pad_x)
+        oh = _ceil_pool_shape(h, kh, s, p.pad_y)
+        ow = _ceil_pool_shape(w, kw, s, p.pad_x)
+        xp = jnp.pad(
             x,
-            x.dtype.type(init_val),
-            reducer,
-            window_dimensions=(1, p.kernel_height, p.kernel_width, 1),
-            window_strides=(1, p.stride, p.stride, 1),
-            padding=((0, 0), pad_h, pad_w, (0, 0)),
+            ((0, 0), (plh, prh), (plw, prw), (0, 0)),
+            constant_values=x.dtype.type(init_val),
         )
+        acc = None
+        for dy in range(kh):
+            for dx in range(kw):
+                sl = xp[
+                    :,
+                    dy : dy + (oh - 1) * s + 1 : s,
+                    dx : dx + (ow - 1) * s + 1 : s,
+                    :,
+                ]
+                acc = sl if acc is None else reducer(acc, sl)
+        return acc
 
 
 @register

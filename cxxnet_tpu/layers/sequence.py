@@ -163,3 +163,76 @@ class SeqPoolLayer(Layer):
 
     def apply(self, params, inputs, *, train=False, rng=None, step=None):
         return [inputs[0].mean(axis=1)]
+
+
+@register
+class MoELayer(Layer):
+    """Mixture-of-experts projection with expert parallelism.
+
+    New TPU-first scope (no reference analog).  ``nexpert`` expert
+    projections ``(nhidden, D)`` live in one ``(E, nhidden, D)`` tensor
+    whose expert dim is sharded over the mesh ``model`` axis
+    (``MeshPlan.param_sharding`` 3-D rule) — GSPMD partitions the expert
+    einsums across devices and inserts the combine reduction, which IS
+    expert parallelism.  Routing is a softmax gate, optionally top-k
+    masked (``topk = 0`` keeps the dense soft mixture).
+
+    Works on flat ``(N, D)`` and sequence ``(N, T, D)`` nodes.
+    """
+
+    type_name = "moe"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.nexpert = 4
+        self.topk = 0
+
+    def set_param(self, name, val):
+        if name == "nexpert":
+            self.nexpert = int(val)
+        elif name == "topk":
+            self.topk = int(val)
+        else:
+            super().set_param(name, val)
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        self._check_arity(in_shapes, 1)
+        (shape,) = in_shapes
+        if len(shape) not in (2, 3):
+            raise ValueError("moe: input must be a matrix or sequence node")
+        if self.param.num_hidden <= 0:
+            raise ValueError("moe: must set nhidden correctly")
+        if self.nexpert < 1 or not (0 <= self.topk <= self.nexpert):
+            raise ValueError("moe: need nexpert >= 1 and 0 <= topk <= nexpert")
+        return [tuple(shape[:-1]) + (self.param.num_hidden,)]
+
+    def init_params(self, key, in_shapes) -> Params:
+        d = in_shapes[0][-1]
+        nh = self.param.num_hidden
+        e = self.nexpert
+        k1, k2 = jax.random.split(key)
+        sigma = self.param.init_sigma
+        return {
+            "wgate": jax.random.normal(k1, (e, d), jnp.float32) * sigma,
+            "wmat": jax.random.normal(k2, (e, nh, d), jnp.float32) * sigma,
+            "bias": jnp.zeros((e, nh), jnp.float32),
+        }
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        x = inputs[0]
+        wg = params["wgate"].astype(x.dtype)
+        wm = params["wmat"].astype(x.dtype)
+        b = params["bias"].astype(x.dtype)
+        logits = jnp.einsum("...d,ed->...e", x, wg).astype(jnp.float32)
+        gate = jax.nn.softmax(logits, axis=-1)
+        if self.topk:
+            # keep top-k gates, renormalize; the masked experts' outputs
+            # are zero-weighted (FLOPs still run — dense dispatch)
+            kth = jnp.sort(gate, axis=-1)[..., -self.topk][..., None]
+            gate = jnp.where(gate >= kth, gate, 0.0)
+            gate = gate / jnp.maximum(
+                gate.sum(axis=-1, keepdims=True), 1e-30
+            )
+        gate = gate.astype(x.dtype)
+        h = jnp.einsum("...d,eod->...eo", x, wm) + b
+        return [jnp.einsum("...e,...eo->...o", gate, h)]

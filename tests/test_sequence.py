@@ -95,3 +95,73 @@ def test_seq_indivisible_ring_raises():
     with pytest.raises(ValueError):
         _build(seq_parallel=1, model_parallel=8, dev="cpu:0-7",
                seq_len=20)  # 20 % 8 != 0
+
+
+MOE_CFG = [
+    ("batch_size", "16"),
+    ("input_shape", "1,1,10"),
+    ("seed", "7"),
+    ("eta", "0.1"),
+    ("momentum", "0.9"),
+    ("netconfig", "start"),
+    ("layer[0->1]", "moe:mx"),
+    ("nexpert", "4"),
+    ("nhidden", "32"),
+    ("topk", "2"),
+    ("layer[1->2]", "relu:r"),
+    ("layer[2->3]", "fullc:fc"),
+    ("nhidden", "4"),
+    ("layer[3->3]", "softmax"),
+    ("netconfig", "end"),
+]
+
+
+def _train_moe(dev, model_parallel=1, steps=5):
+    tr = NetTrainer()
+    tr.set_params([("dev", dev)] + MOE_CFG)
+    if model_parallel != 1:
+        tr.set_param("model_parallel", str(model_parallel))
+    tr.init_model()
+    rng = np.random.RandomState(3)
+    for _ in range(steps):
+        x = rng.randn(16, 10).astype(np.float32)
+        y = rng.randint(0, 4, (16, 1)).astype(np.float32)
+        tr.update(DataBatch(data=x, label=y))
+    return tr
+
+
+def test_moe_expert_parallel_matches_single():
+    """Expert-parallel MoE (experts sharded over the model axis) computes
+    the same weights as the unsharded run."""
+    from jax.sharding import PartitionSpec as P
+
+    t1 = _train_moe("cpu")
+    tep = _train_moe("cpu:0-7", model_parallel=4)  # 2 data x 4 experts
+    w = tep.params["l0_mx"]["wmat"]  # (4, 32, 10): E sharded
+    assert w.sharding.spec == P("model", None, None)
+    for key in t1.params:
+        for tag in t1.params[key]:
+            np.testing.assert_allclose(
+                np.asarray(t1.params[key][tag]),
+                np.asarray(tep.params[key][tag]),
+                rtol=3e-4, atol=3e-5,
+                err_msg=f"{key}/{tag} diverged under expert parallelism",
+            )
+
+
+def test_moe_topk_masks_gates():
+    import jax.numpy as jnp
+    from cxxnet_tpu.layers import create_layer
+
+    lay = create_layer("moe")
+    lay.set_param("nexpert", "8")
+    lay.set_param("nhidden", "4")
+    lay.set_param("topk", "2")
+    p = lay.init_params(jax.random.PRNGKey(0), [(4, 6)])
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 6).astype(np.float32))
+    (y,) = lay.apply(p, [x])
+    assert y.shape == (4, 4)
+    # dense (topk=0) differs from top-2 routing
+    lay.topk = 0
+    (y0,) = lay.apply(p, [x])
+    assert not np.allclose(np.asarray(y), np.asarray(y0))
