@@ -236,3 +236,94 @@ class MoELayer(Layer):
         gate = gate.astype(x.dtype)
         h = jnp.einsum("...d,eod->...eo", x, wm) + b
         return [jnp.einsum("...e,...eo->...o", gate, h)]
+
+
+@register
+class PipeMLPLayer(Layer):
+    """A stack of ``nblock`` identical relu-MLP blocks runnable as a
+    GPipe pipeline (``ops/pipeline.py``) over the mesh model axis.
+
+    The config-grammar entry point for pipeline parallelism: blocks are
+    homogeneous (``y = relu(x W_i + b_i)``, width = input dim), their
+    params live in one ``(L, D, D)`` stack sharded one-stage-per-device
+    when ``pipeline_parallel = 1``, and microbatches stream through the
+    stages with activations hopping a ppermute ring.
+    """
+
+    type_name = "pipe_mlp"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.nblock = 2
+        self.pipeline_parallel = 0
+        self.n_microbatch = 4
+        self.mesh_plan = None
+
+    def set_param(self, name, val):
+        if name == "nblock":
+            self.nblock = int(val)
+        elif name == "pipeline_parallel":
+            self.pipeline_parallel = int(val)
+        elif name == "n_microbatch":
+            self.n_microbatch = int(val)
+        else:
+            super().set_param(name, val)
+
+    def bind_mesh(self, plan) -> None:
+        self.mesh_plan = plan
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        self._check_arity(in_shapes, 1)
+        (shape,) = in_shapes
+        if len(shape) != 2:
+            raise ValueError("pipe_mlp: input must be a matrix node")
+        if self.pipeline_parallel and self.mesh_plan is not None:
+            nm = self.mesh_plan.n_model
+            if nm > 1 and self.nblock % nm != 0:
+                raise ValueError(
+                    f"pipe_mlp: nblock={self.nblock} must divide over the "
+                    f"model axis ({nm} stages)"
+                )
+            if nm > 1 and shape[0] % self.n_microbatch != 0:
+                raise ValueError(
+                    f"pipe_mlp: batch {shape[0]} must divide into "
+                    f"{self.n_microbatch} microbatches"
+                )
+        return [tuple(shape)]
+
+    def init_params(self, key, in_shapes) -> Params:
+        d = in_shapes[0][1]
+        sigma = self.param.init_sigma
+        return {
+            "wmat": jax.random.normal(
+                key, (self.nblock, d, d), jnp.float32
+            ) * sigma,
+            "bias": jnp.zeros((self.nblock, d), jnp.float32),
+        }
+
+    @staticmethod
+    def _block(p, x):
+        return jax.nn.relu(x @ p["wmat"] + p["bias"])
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        x = inputs[0]
+        stack = {
+            "wmat": params["wmat"].astype(x.dtype),
+            "bias": params["bias"].astype(x.dtype),
+        }
+        plan = self.mesh_plan
+        if self.pipeline_parallel and plan is not None and plan.n_model > 1:
+            from ..ops.pipeline import pipeline_apply
+
+            return [
+                pipeline_apply(
+                    self._block, stack, x, plan.mesh,
+                    n_microbatch=self.n_microbatch, stage_axis="model",
+                )
+            ]
+
+        def body(h, p):
+            return self._block(p, h), None
+
+        y, _ = jax.lax.scan(body, x, stack)
+        return [y]

@@ -11,4 +11,6 @@ tests against the pure-XLA implementation (the PairTest discipline,
 SURVEY §4.1).
 """
 
+from .attention import mha, ring_attention, ring_self_attention  # noqa: F401
 from .lrn import lrn, lrn_xla  # noqa: F401
+from .pipeline import gpipe, pipeline_apply  # noqa: F401

@@ -1,0 +1,23 @@
+"""Version-compat shims shared by the ops kernels."""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import inspect
+
+_PARAMS = inspect.signature(_shard_map).parameters
+# replication checking was renamed check_rep -> check_vma in jax 0.9; the
+# ring/pipeline kernels disable it (ppermute under scan confuses it)
+_CHECK_KW = "check_vma" if "check_vma" in _PARAMS else "check_rep"
+
+
+def shard_map_nocheck(f, mesh, in_specs, out_specs):
+    """shard_map with replication checking off, any jax version."""
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KW: False},
+    )

@@ -142,26 +142,11 @@ def ring_self_attention(
     ``seq_axis`` (batch on ``data``); returns the same global layout."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map  # jax >= 0.8
-    except ImportError:  # pragma: no cover - older jax
-        from jax.experimental.shard_map import shard_map
+    from ._compat import shard_map_nocheck
 
     spec = P("data", seq_axis, None, None)
-    kw = {"check_vma": False}  # jax >= 0.9 name; older jax: check_rep
-    try:
-        fn = shard_map(
-            functools.partial(
-                ring_attention, axis_name=seq_axis, causal=causal
-            ),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **kw,
-        )
-    except TypeError:  # pragma: no cover - pre-0.9 jax
-        fn = shard_map(
-            functools.partial(
-                ring_attention, axis_name=seq_axis, causal=causal
-            ),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_rep=False,
-        )
+    fn = shard_map_nocheck(
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh, (spec, spec, spec), spec,
+    )
     return fn(x_q, x_k, x_v)

@@ -1,0 +1,57 @@
+"""The reference's shipped example confs are the grammar fixture
+(SURVEY §4.5): they must tokenize, section-split, and — where the layer
+graph is complete — build a net with correct shapes.  Data files are
+absent, so only parsing/graph construction is exercised, never IO.
+"""
+
+import os
+
+import pytest
+
+from cxxnet_tpu import config as C
+from cxxnet_tpu.nnet.trainer import NetTrainer
+
+REF = "/root/reference/example"
+
+ALL_CONFS = [
+    "MNIST/MNIST.conf",
+    "MNIST/MNIST_CONV.conf",
+    "MNIST/mpi.conf",
+    "ImageNet/ImageNet.conf",
+    "kaggle_bowl/bowl.conf",
+    "kaggle_bowl/pred.conf",
+]
+
+
+@pytest.mark.parametrize("rel", ALL_CONFS)
+def test_reference_conf_parses(rel):
+    path = os.path.join(REF, rel)
+    if not os.path.exists(path):
+        pytest.skip(f"{rel} not present")
+    cfg = C.parse_file(path)
+    assert cfg, f"{rel}: no pairs parsed"
+    split = C.split_sections(cfg)
+    # every opened iterator section must have been closed by iter=end
+    for sec in split.sections:
+        assert sec.entries is not None
+
+
+@pytest.mark.parametrize(
+    "rel,nclass",
+    [("MNIST/MNIST.conf", 10), ("MNIST/MNIST_CONV.conf", 10),
+     ("ImageNet/ImageNet.conf", 1000), ("kaggle_bowl/bowl.conf", 121)],
+)
+def test_reference_conf_builds_net(rel, nclass):
+    """The netconfig sections build, shape-infer, and end in the right
+    class count on this framework unchanged."""
+    path = os.path.join(REF, rel)
+    if not os.path.exists(path):
+        pytest.skip(f"{rel} not present")
+    cfg = C.split_sections(C.parse_file(path)).global_entries
+    tr = NetTrainer()
+    tr.set_params(cfg)
+    tr.set_param("dev", "cpu")
+    tr.set_param("batch_size", "4")  # tiny for CPU shape inference
+    tr.init_model()
+    out = tr.net.node_shapes[tr.net.out_node_index()]
+    assert out[-1] == nclass, f"{rel}: output {out}"
