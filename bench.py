@@ -3,49 +3,33 @@
 Run on the real TPU chip (no JAX_PLATFORMS override).  Prints ONE JSON
 line: ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
 Baseline: BASELINE.json north star = 2000 images/sec/chip (v5e).
+
+Budget discipline (round-1 postmortem: the driver killed the run at
+rc=124 with nothing parseable on stdout):
+
+* a persistent XLA compilation cache under ``.jax_cache/`` makes every
+  run after the first skip the multi-minute GoogLeNet compile entirely;
+* a provisional JSON line is emitted right after the first timed step,
+  so a timeout mid-measurement still leaves a parseable (conservative)
+  number on stdout; the final line overwrites it (drivers take the last
+  JSON line);
+* 1 warmup + 10 timed steps instead of 3 + 20.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 BASELINE_IMG_S = 2000.0
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
 
 
-def main() -> None:
-    import jax
-
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
-    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
-
-    from __graft_entry__ import _build_googlenet
-
-    # lrn layers self-probe the Pallas kernel (lrn_impl=auto) and fall
-    # back to the XLA lowering if the backend can't compile it
-    tr = _build_googlenet(batch_size=batch, input_size=224, dev="tpu")
-    tr.eval_train = 0  # pure step time; no per-step metric fetch
-
-    rng = np.random.RandomState(0)
-    data = rng.randn(batch, 224, 224, 3).astype(np.float32)
-    labels = rng.randint(0, 1000, size=(batch, 1)).astype(np.float32)
-
-    # warmup / compile
-    for _ in range(3):
-        tr.update_all(data, labels)
-    jax.block_until_ready(tr.params)
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        tr.update_all(data, labels)
-    jax.block_until_ready(tr.params)
-    dt = time.perf_counter() - t0
-
-    n_chips = max(1, tr.mesh_plan.n_devices if tr.mesh_plan else 1)
-    img_s = batch * steps / dt / n_chips
+def _emit(tag: str, img_s: float, batch: int) -> None:
     print(
         json.dumps(
             {
@@ -54,8 +38,56 @@ def main() -> None:
                 "unit": "images/sec/chip",
                 "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
             }
-        )
+        ),
+        flush=True,
     )
+    print(f"# bench[{tag}]: {img_s:.1f} img/s/chip", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+
+    from __graft_entry__ import _build_googlenet
+
+    t_build = time.perf_counter()
+    tr = _build_googlenet(batch_size=batch, input_size=224, dev="tpu")
+    tr.eval_train = 0  # pure step time; no per-step metric fetch
+
+    rng = np.random.RandomState(0)
+    data = rng.randn(batch, 224, 224, 3).astype(np.float32)
+    labels = rng.randint(0, 1000, size=(batch, 1)).astype(np.float32)
+
+    # warmup / compile (cached across runs via .jax_cache)
+    tr.update_all(data, labels)
+    jax.block_until_ready(tr.params)
+    print(
+        f"# compile+warmup: {time.perf_counter() - t_build:.1f}s",
+        file=sys.stderr,
+        flush=True,
+    )
+    n_chips = max(1, tr.mesh_plan.n_devices if tr.mesh_plan else 1)
+
+    # provisional number after ONE timed step — parseable even if the
+    # driver times the process out mid-measurement
+    t0 = time.perf_counter()
+    tr.update_all(data, labels)
+    jax.block_until_ready(tr.params)
+    _emit("provisional", batch / (time.perf_counter() - t0) / n_chips, batch)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        tr.update_all(data, labels)
+    jax.block_until_ready(tr.params)
+    dt = time.perf_counter() - t0
+    _emit("final", batch * steps / dt / n_chips, batch)
 
 
 if __name__ == "__main__":

@@ -216,6 +216,28 @@ class LossLayer(Layer):
         ``grad_scale / (batch_size * update_period)``."""
         raise NotImplementedError
 
+    def loss_masked(
+        self,
+        x: jnp.ndarray,
+        labels: jnp.ndarray,
+        weight: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        """``loss`` with an optional per-row weight vector ``(N,)``.
+
+        The static-shape analog of the reference's ``AdjustBatchSize``
+        (``neural_net-inl.hpp:266-277``): a short final train batch is
+        zero-padded to the compiled batch size and the padded rows are
+        masked out of the loss, so they contribute exactly zero gradient.
+        Implemented generically by vmapping the subclass ``loss`` over
+        rows — subclasses only ever define the summed form.
+        """
+        if weight is None:
+            return self.loss(x, labels)
+        per_row = jax.vmap(
+            lambda xi, yi: self.loss(xi[None], yi[None])
+        )(x, labels)
+        return jnp.sum(per_row * weight.astype(per_row.dtype))
+
 
 # ----------------------------------------------------------------------
 # registry

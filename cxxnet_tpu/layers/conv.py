@@ -251,19 +251,25 @@ class InsanityPoolingLayer(_PoolBase):
 _PALLAS_LRN_OK: dict = {}
 
 
-def _pallas_lrn_works() -> bool:
-    """One-time compile probe so ``lrn_impl=auto`` can never take down a
-    run on a backend whose Pallas lowering is broken/unavailable."""
-    if "ok" not in _PALLAS_LRN_OK:
+def _pallas_lrn_works(nchannel: int, dtype) -> bool:
+    """Compile probe so ``lrn_impl=auto`` can never take down a run on a
+    backend whose Pallas lowering is broken/unavailable.
+
+    Keyed on ``(channel count, dtype)`` and probed at the layer's real
+    channel width: a backend that compiles the aligned 128-lane case can
+    still reject the 64- or 192-lane blocks GoogLeNet actually runs.
+    """
+    key = (int(nchannel), jnp.dtype(dtype).name)
+    if key not in _PALLAS_LRN_OK:
         try:
             from ..ops.lrn import lrn
 
-            lrn(jnp.ones((8, 128), jnp.float32), 5, 1e-4, 0.75, 1.0
+            lrn(jnp.ones((8, key[0]), dtype), 5, 1e-4, 0.75, 1.0
                 ).block_until_ready()
-            _PALLAS_LRN_OK["ok"] = True
+            _PALLAS_LRN_OK[key] = True
         except Exception:  # pragma: no cover - backend-specific
-            _PALLAS_LRN_OK["ok"] = False
-    return _PALLAS_LRN_OK["ok"]
+            _PALLAS_LRN_OK[key] = False
+    return _PALLAS_LRN_OK[key]
 
 
 @register
@@ -294,13 +300,15 @@ class LRNLayer(Layer):
         else:
             super().set_param(name, val)
 
-    def _use_pallas(self) -> bool:
+    def _use_pallas(self, nchannel: int, dtype) -> bool:
         if self.impl == "pallas":
             return True
         if self.impl == "xla":
             return False
         try:
-            return jax.default_backend() == "tpu" and _pallas_lrn_works()
+            return jax.default_backend() == "tpu" and _pallas_lrn_works(
+                nchannel, dtype
+            )
         except RuntimeError:
             return False
 
@@ -312,7 +320,7 @@ class LRNLayer(Layer):
         from ..ops.lrn import lrn, lrn_xla
 
         x = inputs[0]
-        if self._use_pallas():
+        if self._use_pallas(x.shape[-1], x.dtype):
             interp = jax.default_backend() != "tpu"  # forced-on off-TPU
             y = lrn(x, self.nsize, self.alpha, self.beta, self.knorm, interp)
         else:

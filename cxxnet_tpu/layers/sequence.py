@@ -226,10 +226,14 @@ class MoELayer(Layer):
         logits = jnp.einsum("...d,ed->...e", x, wg).astype(jnp.float32)
         gate = jax.nn.softmax(logits, axis=-1)
         if self.topk:
-            # keep top-k gates, renormalize; the masked experts' outputs
-            # are zero-weighted (FLOPs still run — dense dispatch)
-            kth = jnp.sort(gate, axis=-1)[..., -self.topk][..., None]
-            gate = jnp.where(gate >= kth, gate, 0.0)
+            # keep exactly top-k gates (by index, so ties at the threshold
+            # never admit extra experts), renormalize; the masked experts'
+            # outputs are zero-weighted (FLOPs still run — dense dispatch)
+            _, idx = jax.lax.top_k(gate, self.topk)
+            mask = jax.nn.one_hot(idx, self.nexpert, dtype=gate.dtype).sum(
+                axis=-2
+            )
+            gate = gate * mask
             gate = gate / jnp.maximum(
                 gate.sum(axis=-1, keepdims=True), 1e-30
             )

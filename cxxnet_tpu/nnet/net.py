@@ -204,12 +204,19 @@ class FunctionalNet:
         step: Optional[jnp.ndarray] = None,
         aux: Optional[Dict[str, dict]] = None,
         return_aux: bool = False,
+        sample_mask: Optional[jnp.ndarray] = None,
     ):
         """Execute the graph.
 
         Returns ``(node_values, total_scaled_loss)``.  ``labels`` is the
         batch label matrix ``(N, label_width)`` (may be None at predict
         time — loss is then 0 and loss layers only transform).
+
+        ``sample_mask`` (N,) zero-weights padded rows of a short final
+        train batch out of every loss term (see LossLayer.loss_masked).
+        Masking is exact for row-independent nets; batch_norm's batch
+        statistics still see the padded rows (set ``round_batch=1`` on the
+        data iterator, or ``bn_eval=running``, when that matters).
         """
         g = self.graph
         cdt = self.compute_dtype
@@ -247,7 +254,9 @@ class FunctionalNet:
                 if labels is not None:
                     field = self._label_field(labels, lay.target)
                     scale = lay.grad_scale / (batch * self.update_period)
-                    total_loss = total_loss + scale * lay.loss(logits, field)
+                    total_loss = total_loss + scale * lay.loss_masked(
+                        logits, field, sample_mask
+                    )
                 # transform is f32 math; only downcast if a downstream layer
                 # consumes it — the terminal node goes to host metrics in f32
                 out = lay.transform(logits)
@@ -266,10 +275,23 @@ class FunctionalNet:
                 else:
                     lstate = None
                 if lstate is not None and hasattr(lay, "apply_stateful"):
-                    outs, new_state = lay.apply_stateful(
-                        lparams, lstate, inputs,
-                        train=train, rng=lrng, step=step,
-                    )
+                    if self.remat and train:
+                        # state outputs are non-differentiable, so
+                        # checkpointing the stateful call is safe — a
+                        # bn_eval=running net keeps activation recompute
+                        def run_st(p, st, xs, lay=lay, lrng=lrng):
+                            return lay.apply_stateful(
+                                p, st, xs, train=True, rng=lrng, step=step
+                            )
+
+                        outs, new_state = jax.checkpoint(run_st)(
+                            lparams, lstate, inputs
+                        )
+                    else:
+                        outs, new_state = lay.apply_stateful(
+                            lparams, lstate, inputs,
+                            train=train, rng=lrng, step=step,
+                        )
                     if new_aux is not None:
                         new_aux[key] = new_state
                 elif self.remat and train:

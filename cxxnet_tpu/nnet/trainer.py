@@ -210,12 +210,14 @@ class NetTrainer:
                 new_s[key][tag] = s2
         return new_p, new_s
 
-    def _loss_and_out(self, params, aux, data, labels, rng, epoch, extras):
+    def _loss_and_out(self, params, aux, data, labels, mask, rng, epoch,
+                      extras):
         """(loss, (out_node, new_aux)) with train=True — fused/fwd_train."""
         net = self.net
         nodes, loss, new_aux = net.forward(
             params, data, labels=labels, extras=extras,
             train=True, rng=rng, step=epoch, aux=aux, return_aux=True,
+            sample_mask=mask,
         )
         # metrics consume the out node on host: always hand back f32
         return loss, (nodes[net.out_node_index()].astype(jnp.float32), new_aux)
@@ -237,10 +239,11 @@ class NetTrainer:
             loss_and_out = self._loss_and_out
             apply_updates = self._apply_updates
 
-            def step(params, ustates, aux, data, labels, rng, epoch, extras):
+            def step(params, ustates, aux, data, labels, mask, rng, epoch,
+                     extras):
                 (loss, (out, new_aux)), grads = jax.value_and_grad(
                     lambda p: loss_and_out(
-                        p, aux, data, labels, rng, epoch, extras
+                        p, aux, data, labels, mask, rng, epoch, extras
                     ),
                     has_aux=True,
                 )(params)
@@ -249,7 +252,7 @@ class NetTrainer:
 
             self._jit_cache["fused"] = jax.jit(
                 step,
-                in_shardings=(psh, ush, rep, dsh, dsh, rep, rep, ex),
+                in_shardings=(psh, ush, rep, dsh, dsh, dsh, rep, rep, ex),
                 out_shardings=(psh, ush, rep, rep, dsh),
                 donate_argnums=(0, 1, 2),
             )
@@ -259,10 +262,11 @@ class NetTrainer:
         if "grad" not in self._jit_cache:
             net = self.net
 
-            def loss_fn(params, aux, data, labels, rng, step, extras):
+            def loss_fn(params, aux, data, labels, mask, rng, step, extras):
                 _, loss, new_aux = net.forward(
                     params, data, labels=labels, extras=extras,
                     train=True, rng=rng, step=step, aux=aux, return_aux=True,
+                    sample_mask=mask,
                 )
                 return loss, new_aux
 
@@ -270,7 +274,7 @@ class NetTrainer:
             psh, _ = self._param_sh()
             self._jit_cache["grad"] = jax.jit(
                 jax.value_and_grad(loss_fn, has_aux=True),
-                in_shardings=(psh, rep, dsh, dsh, rep, rep, ex),
+                in_shardings=(psh, rep, dsh, dsh, dsh, rep, rep, ex),
                 out_shardings=((rep, rep), psh),
             )
         return self._jit_cache["grad"]
@@ -280,10 +284,10 @@ class NetTrainer:
         if "fwd_train" not in self._jit_cache:
             loss_and_out = self._loss_and_out
 
-            def f(params, aux, data, labels, rng, step, extras):
+            def f(params, aux, data, labels, mask, rng, step, extras):
                 (loss, (out, new_aux)), grads = jax.value_and_grad(
                     lambda p: loss_and_out(
-                        p, aux, data, labels, rng, step, extras
+                        p, aux, data, labels, mask, rng, step, extras
                     ),
                     has_aux=True,
                 )(params)
@@ -293,7 +297,7 @@ class NetTrainer:
             psh, _ = self._param_sh()
             self._jit_cache["fwd_train"] = jax.jit(
                 f,
-                in_shardings=(psh, rep, dsh, dsh, rep, rep, ex),
+                in_shardings=(psh, rep, dsh, dsh, dsh, rep, rep, ex),
                 out_shardings=(rep, dsh, rep, psh),
             )
         return self._jit_cache["fwd_train"]
@@ -379,40 +383,101 @@ class NetTrainer:
             self.mesh_plan.data_sharding(), np.asarray(x)
         )
 
+    def _pad_train_batch(self, batch: DataBatch):
+        """Zero-pad a short final train batch to the compiled batch size.
+
+        The static-shape AdjustBatchSize (``neural_net-inl.hpp:266-277``):
+        XLA programs are compiled for one batch shape, so instead of
+        re-jitting for every tail size, pad up and hand the step a 0/1
+        sample mask that zeroes the padded rows' loss contribution.  Two
+        sources of dead rows are masked:
+
+        * a hand-fed short batch (wrapper API) — padded up here;
+        * the IO chain's full-size final batch whose trailing
+          ``num_batch_padd`` rows are filler (``io/batch.py`` with
+          ``round_batch=0``) — already full-size, only masked.
+
+        Returns ``(data, label, extras, mask, n_real)``.
+        """
+        n = batch.data.shape[0]
+        bs = self.batch_size or n
+        if jax.process_count() > 1:
+            # multi-process: update() receives this process's shard of the
+            # global batch (see _to_device); padding must happen upstream
+            local = bs // jax.process_count()
+            if n != local:
+                raise ValueError(
+                    f"distributed run: each process must feed exactly "
+                    f"batch_size/process_count = {local} rows, got {n}; "
+                    "use round_batch=1 in the data iterator"
+                )
+            return (batch.data, batch.label, tuple(batch.extra_data),
+                    np.ones(local, np.float32), n)
+        if n == bs:
+            n_real = n - int(batch.num_batch_padd or 0)
+            mask = np.ones(bs, np.float32)
+            if n_real < n:
+                mask[n_real:] = 0.0
+            return (batch.data, batch.label, tuple(batch.extra_data),
+                    mask, n_real)
+        if n > bs:
+            raise ValueError(
+                f"train batch of {n} rows exceeds batch_size={bs}"
+            )
+        pad = bs - n
+
+        def _pad(a):
+            a = np.asarray(a)
+            return np.concatenate(
+                [a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0
+            )
+
+        mask = np.concatenate(
+            [np.ones(n, np.float32), np.zeros(pad, np.float32)]
+        )
+        return (_pad(batch.data), _pad(batch.label),
+                tuple(_pad(e) for e in batch.extra_data), mask, n)
+
     def update(self, batch: DataBatch) -> None:
         """One micro-batch: fwd/bwd + (every update_period-th call) update."""
         assert self.net is not None, "init_model/load_model first"
-        data = self._to_device(batch.data)
-        labels = self._to_device(batch.label)
-        extras = tuple(self._to_device(e) for e in batch.extra_data)
+        data_np, label_np, extras_np, mask_np, n_real = (
+            self._pad_train_batch(batch)
+        )
+        data = self._to_device(data_np)
+        labels = self._to_device(label_np)
+        mask = self._to_device(mask_np)
+        extras = tuple(self._to_device(e) for e in extras_np)
         step = jnp.asarray(self.epoch_counter, jnp.int32)
         if self.update_period == 1:
             # fused SPMD fast path: fwd+bwd+update in one donated program
             (self.params, self.ustates, self.aux, loss, out) = (
                 self._fused_step_fn()(
                     self.params, self.ustates, self.aux, data, labels,
-                    self._next_rng(), step, extras,
+                    mask, self._next_rng(), step, extras,
                 )
             )
             if self.eval_train:
                 self.train_metric.add_eval(
-                    fetch_local_rows(out), np.asarray(batch.label),
+                    fetch_local_rows(out)[:n_real],
+                    np.asarray(batch.label)[:n_real],
                     self._label_ranges(),
                 )
             self.epoch_counter += 1
             return
         if self.eval_train:
             loss, out, self.aux, grads = self._fwd_train_fn()(
-                self.params, self.aux, data, labels,
+                self.params, self.aux, data, labels, mask,
                 self._next_rng(), step, extras,
             )
             self.train_metric.add_eval(
-                fetch_local_rows(out), np.asarray(batch.label),
+                fetch_local_rows(out)[:n_real],
+                np.asarray(batch.label)[:n_real],
                 self._label_ranges(),
             )
         else:
             (loss, self.aux), grads = self._grad_fn()(
-                self.params, self.aux, data, labels,
+                self.params, self.aux, data, labels, mask,
                 self._next_rng(), step, extras,
             )
         if self._grad_accum is None:

@@ -7,7 +7,8 @@ visitor as flat ``blob%d`` tags, train/eval phase switching.  Config:
     layer[a->b] = torch:name
       torch_op = torch.nn.Conv2d(3, 8, 3, padding=1)
 
-``torch_op`` is evaluated with only the ``torch`` module in scope.  The
+``torch_op`` is parsed as a whitelisted ``torch.nn.*`` constructor call
+(AST-validated, literal arguments only — never ``eval``-uated).  The
 module's parameters are pulled into the JAX param pytree (tags ``blob0``,
 ``blob1``, …) so updaters/checkpoints treat them like any other weights;
 forward and backward run under ``jax.pure_callback`` with torch autograd
@@ -19,6 +20,7 @@ host memory, exactly like the reference plugin's extra blob copies.
 
 from __future__ import annotations
 
+import ast
 from typing import Dict, List, Sequence
 
 import jax
@@ -26,6 +28,76 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..layers.base import Layer, Params, Shape, register
+
+
+def _build_torch_expr(expr: str):
+    """Construct the module described by ``torch_op`` WITHOUT ``eval``.
+
+    Configs are untrusted input (they get downloaded and shared), so the
+    expression grammar is a strict whitelist validated on the AST:
+
+    * calls whose callee is a dotted path rooted at ``torch.nn`` (nested
+      calls allowed, e.g. ``torch.nn.Sequential(torch.nn.ReLU())``),
+    * literal arguments: numbers, strings, booleans, ``None``, tuples/
+      lists of literals, unary minus.
+
+    Anything else — attribute chains escaping ``torch.nn``, subscripts,
+    lambdas, comprehensions, dunder tricks — raises ``ValueError``.
+    """
+    import torch
+
+    def build(node: ast.expr):
+        if isinstance(node, ast.Call):
+            path = _dotted_path(node.func)
+            if not path or path[:2] != ["torch", "nn"] or len(path) < 3:
+                raise ValueError(
+                    "torch_op: only torch.nn.* constructors are allowed, "
+                    f"got {'.'.join(path) if path else ast.dump(node.func)}"
+                )
+            obj = torch.nn
+            for name in path[2:]:
+                if name.startswith("_"):
+                    raise ValueError(f"torch_op: private attribute {name!r}")
+                obj = getattr(obj, name)
+            args = [literal(a) for a in node.args]
+            kwargs = {}
+            for kw in node.keywords:
+                if kw.arg is None:
+                    raise ValueError("torch_op: **kwargs not allowed")
+                kwargs[kw.arg] = literal(kw.value)
+            return obj(*args, **kwargs)
+        raise ValueError(
+            f"torch_op: expected a torch.nn.* call, got {ast.dump(node)}"
+        )
+
+    def literal(node: ast.expr):
+        if isinstance(node, ast.Call):
+            return build(node)  # nested module, e.g. inside Sequential
+        if isinstance(node, ast.Constant):
+            return node.value
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            v = literal(node.operand)
+            if isinstance(v, (int, float)):
+                return -v
+        if isinstance(node, (ast.Tuple, ast.List)):
+            vals = [literal(e) for e in node.elts]
+            return tuple(vals) if isinstance(node, ast.Tuple) else vals
+        raise ValueError(
+            f"torch_op: argument must be a literal, got {ast.dump(node)}"
+        )
+
+    def _dotted_path(node: ast.expr):
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return parts[::-1]
+        return None
+
+    tree = ast.parse(expr, mode="eval")
+    return build(tree.body)
 
 
 def _to_torch_layout(x: np.ndarray) -> np.ndarray:
@@ -62,13 +134,7 @@ class TorchAdapterLayer(Layer):
         if self._module is None:
             if not self.torch_op:
                 raise ValueError("torch layer: must set torch_op")
-            import torch
-
-            self._module = eval(  # noqa: S307 - config-authored expression,
-                # same trust model as the reference's caffe prototxt configs
-                self.torch_op, {"__builtins__": {}}, {"torch": torch}
-            )
-            self._module = self._module.cpu().float()
+            self._module = _build_torch_expr(self.torch_op).cpu().float()
             self._pshapes = [
                 tuple(p.shape) for p in self._module.parameters()
             ]

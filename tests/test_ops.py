@@ -57,7 +57,7 @@ def test_lrn_layer_uses_xla_on_cpu(rng):
 
     lay = create_layer("lrn")
     lay.set_param("local_size", "5")
-    assert not lay._use_pallas()
+    assert not lay._use_pallas(64, "float32")
     x = jnp.asarray(rng.randn(2, 4, 4, 16).astype(np.float32))
     (y_xla,) = lay.apply({}, [x])
     lay.set_param("lrn_impl", "pallas")

@@ -76,3 +76,35 @@ def test_pairtest_native_vs_torch(rng):
     np.testing.assert_allclose(
         np.asarray(y_n), np.asarray(y_f), rtol=1e-5, atol=1e-5
     )
+
+
+def test_torch_op_rejects_non_whitelisted_expressions():
+    """torch_op is untrusted config input: anything that is not a literal
+    torch.nn.* constructor call must be rejected (never eval'd)."""
+    from cxxnet_tpu.plugin.torch_adapter import _build_torch_expr
+
+    bad = [
+        "__import__('os').system('true')",
+        "torch.load('/etc/passwd')",                      # not torch.nn
+        "torch.nn.Linear.__init__.__globals__",           # not a call
+        "torch.nn.Linear(8, 4).__class__",                # attribute escape
+        "torch.nn.modules.linear.Linear.mro()[1]",        # subscript
+        "torch.nn.Linear(open('/etc/passwd'))",           # non-literal arg
+        "torch.nn._reduction.legacy_get_string(1, 2)",    # private path
+        "(lambda: 1)()",
+    ]
+    for expr in bad:
+        with pytest.raises((ValueError, SyntaxError)):
+            _build_torch_expr(expr)
+
+
+def test_torch_op_accepts_nested_and_literal_forms():
+    from cxxnet_tpu.plugin.torch_adapter import _build_torch_expr
+
+    m = _build_torch_expr(
+        "torch.nn.Sequential(torch.nn.Linear(8, 4, bias=False), "
+        "torch.nn.Hardtanh(-1.0, 1.0))"
+    )
+    assert isinstance(m, torch.nn.Sequential)
+    m2 = _build_torch_expr("torch.nn.AvgPool2d((2, 2), stride=2)")
+    assert isinstance(m2, torch.nn.AvgPool2d)

@@ -165,3 +165,29 @@ def test_moe_topk_masks_gates():
     lay.topk = 0
     (y0,) = lay.apply(p, [x])
     assert not np.allclose(np.asarray(y), np.asarray(y0))
+
+
+def test_moe_topk_exact_under_tied_gates():
+    """Tied gate logits (x = 0 -> uniform softmax) must still activate
+    EXACTLY topk experts — threshold-comparison routing kept every tied
+    expert and degenerated toward the dense mixture (ADVICE r1)."""
+    import jax.numpy as jnp
+    from cxxnet_tpu.layers import create_layer
+
+    lay = create_layer("moe")
+    lay.set_param("nexpert", "8")
+    lay.set_param("nhidden", "4")
+    lay.set_param("topk", "2")
+    p = lay.init_params(jax.random.PRNGKey(0), [(4, 6)])
+    x = jnp.zeros((4, 6), jnp.float32)
+    # reach into the routing math: reconstruct the gate the layer applies
+    logits = jnp.einsum("...d,ed->...e", x, p["wgate"]).astype(jnp.float32)
+    gate = jax.nn.softmax(logits, axis=-1)
+    _, idx = jax.lax.top_k(gate, 2)
+    mask = jax.nn.one_hot(idx, 8, dtype=gate.dtype).sum(axis=-2)
+    assert int(mask.sum(axis=-1).max()) == 2  # exactly k, despite ties
+    # end-to-end: output equals mean of the 2 selected experts' outputs
+    (y,) = lay.apply(p, [x])
+    h = jnp.einsum("...d,eod->...eo", x, p["wmat"]) + p["bias"]
+    want = jnp.einsum("ne,neo->no", mask / 2.0, h)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want), rtol=1e-5)

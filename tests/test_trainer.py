@@ -333,3 +333,108 @@ eta = 0.1
     tr3.set_params(C.parse_pairs(cfg.replace("  bn_eval = running\n", "")))
     tr3.init_model()
     assert tr3.aux == {}
+
+
+def test_remat_with_running_stats():
+    """remat=1 + bn_eval=running: stateful layers are checkpointed too
+    (state outputs are non-differentiable); numerics match no-remat."""
+    cfg = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+0] = batch_norm:bn1
+  bn_eval = running
+  bn_momentum = 0.5
+layer[+1:a1] = relu:a1
+layer[a1->out] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,8
+batch_size = 16
+eta = 0.1
+"""
+    x, y = toy_data(32)
+    trainers = []
+    for extra in ("", "remat = 1\n"):
+        tr = NetTrainer()
+        tr.set_params(C.parse_pairs(cfg + extra))
+        tr.init_model()
+        if extra:
+            assert tr.net.remat == 1
+        for b in batches(x, y):
+            tr.update(b)
+        trainers.append(tr)
+    t_plain, t_remat = trainers
+    key = [k for k in t_plain.aux if "bn1" in k][0]
+    np.testing.assert_allclose(
+        np.asarray(t_plain.aux[key]["rmean"]),
+        np.asarray(t_remat.aux[key]["rmean"]), rtol=1e-5, atol=1e-6)
+    for k in t_plain.params:
+        for tag in t_plain.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(t_plain.params[k][tag]),
+                np.asarray(t_remat.params[k][tag]),
+                rtol=1e-5, atol=1e-6, err_msg=f"{k}/{tag}")
+
+
+def test_short_final_train_batch_pad_and_mask():
+    """A short train batch is zero-padded to the compiled batch size with
+    padded rows masked out of the loss (the static-shape AdjustBatchSize,
+    neural_net-inl.hpp:266-277): gradient comes from real rows only."""
+    x, y = toy_data(10)
+    tr_b = make_trainer()  # batch_size = 16
+    tr_b.update(DataBatch(data=x, label=y))  # 10-row short batch
+    assert tr_b.epoch_counter == 1
+
+    # ground truth: masked loss = sum(real-row losses) / 16, which a
+    # batch_size=10 trainer reproduces with grad_scale = 10/16
+    cfg = MLP_CFG.replace("batch_size = 16", "batch_size = 10").replace(
+        "layer[+0] = softmax",
+        "layer[+0] = softmax\n  grad_scale = 0.625",
+    )
+    tr_a = NetTrainer()
+    tr_a.set_params(C.parse_pairs(cfg))
+    tr_a.init_model()
+    tr_a.update(DataBatch(data=x, label=y))
+
+    for key in tr_a.params:
+        for tag in tr_a.params[key]:
+            np.testing.assert_allclose(
+                np.asarray(tr_a.params[key][tag]),
+                np.asarray(tr_b.params[key][tag]),
+                rtol=1e-5, atol=1e-6, err_msg=f"{key}/{tag}")
+
+    # an oversize batch is a clear error, not silent truncation
+    xb, yb = toy_data(20)
+    with pytest.raises(ValueError, match="exceeds batch_size"):
+        tr_b.update(DataBatch(data=xb, label=yb))
+
+
+def test_num_batch_padd_rows_masked_in_training():
+    """The IO chain's full-size final batch carries num_batch_padd filler
+    rows (round_batch=0); update() must zero their loss contribution."""
+    x, y = toy_data(16)
+    garbage = DataBatch(
+        data=x, label=y, num_batch_padd=6
+    )  # rows 10..15 are filler
+    tr_b = make_trainer()
+    tr_b.update(garbage)
+
+    cfg = MLP_CFG.replace("batch_size = 16", "batch_size = 10").replace(
+        "layer[+0] = softmax",
+        "layer[+0] = softmax\n  grad_scale = 0.625",
+    )
+    tr_a = NetTrainer()
+    tr_a.set_params(C.parse_pairs(cfg))
+    tr_a.init_model()
+    tr_a.update(DataBatch(data=x[:10], label=y[:10]))
+
+    for key in tr_a.params:
+        for tag in tr_a.params[key]:
+            np.testing.assert_allclose(
+                np.asarray(tr_a.params[key][tag]),
+                np.asarray(tr_b.params[key][tag]),
+                rtol=1e-5, atol=1e-6, err_msg=f"{key}/{tag}")
