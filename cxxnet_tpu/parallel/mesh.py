@@ -64,9 +64,13 @@ class MeshPlan:
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
-    def data_sharding(self) -> NamedSharding:
-        """Batch-major arrays: shard dim 0 over the data axis."""
-        return NamedSharding(self.mesh, P("data"))
+    def data_sharding(self, axis: int = 0) -> NamedSharding:
+        """Batch-major arrays: shard the batch dim over the data axis.
+
+        ``axis=1`` covers step-stacked ``[K, B, ...]`` arrays fed to the
+        device-side multi-step scan (NetTrainer.update_scan)."""
+        spec = [None] * axis + ["data"]
+        return NamedSharding(self.mesh, P(*spec))
 
     def param_sharding(self, shape: Sequence[int]) -> NamedSharding:
         """Tensor-parallel weight sharding over the ``model`` axis.

@@ -438,3 +438,52 @@ def test_num_batch_padd_rows_masked_in_training():
                 np.asarray(tr_a.params[key][tag]),
                 np.asarray(tr_b.params[key][tag]),
                 rtol=1e-5, atol=1e-6, err_msg=f"{key}/{tag}")
+
+
+def test_update_scan_matches_sequential_updates():
+    """update_scan (lax.scan over the fused step, ONE device program)
+    must advance params/epoch exactly like K sequential update() calls.
+    The scan path is how a TPU training loop amortizes per-dispatch host
+    cost (doc/performance.md)."""
+    K = 5
+    rng = np.random.RandomState(3)
+    data = rng.randn(K, 16, 8).astype(np.float32)
+    w = rng.randn(8, 4).astype(np.float32)
+    labels = (data @ w).argmax(-1).astype(np.float32)[..., None]
+
+    tr_seq = make_trainer()
+    for i in range(K):
+        tr_seq.update(DataBatch(data=data[i], label=labels[i]))
+
+    tr_scan = make_trainer()
+    losses = tr_scan.update_scan(data, labels)
+    assert losses.shape == (K,)
+    assert tr_scan.epoch_counter == K == tr_seq.epoch_counter
+    for key in tr_seq.params:
+        for tag in tr_seq.params[key]:
+            np.testing.assert_allclose(
+                np.asarray(tr_seq.params[key][tag]),
+                np.asarray(tr_scan.params[key][tag]),
+                rtol=1e-5, atol=1e-5, err_msg=f"{key}/{tag}")
+    # train metrics were accumulated for all K steps
+    line = tr_scan.train_metric.print("train")
+    assert "train-error" in line
+
+
+def test_update_scan_single_batch_mode():
+    """[B,...] + n_steps: the same staged batch is reused each step
+    (synthetic benchmark mode); loss must strictly decrease."""
+    x, y = toy_data(16)
+    tr = make_trainer()
+    tr.eval_train = 0
+    losses = tr.update_scan(x, y, n_steps=6)
+    assert losses.shape == (6,)
+    assert tr.epoch_counter == 6
+    assert losses[-1] < losses[0], losses
+
+
+def test_update_scan_requires_update_period_1():
+    tr = make_trainer(extra="update_period = 2\n")
+    x, y = toy_data(16)
+    with pytest.raises(ValueError, match="update_period"):
+        tr.update_scan(x, y, n_steps=2)
