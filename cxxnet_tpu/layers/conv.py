@@ -26,6 +26,7 @@ Parity sources:
 
 from __future__ import annotations
 
+import functools
 from typing import List, Sequence, Tuple
 
 import jax
@@ -113,6 +114,111 @@ class ConvolutionLayer(Layer):
         return [y]
 
 
+def _pool_geometry(h: int, w: int, kh: int, kw: int, s: int, py: int,
+                   px: int):
+    """((plh, prh), (plw, prw), oh, ow) for the ceil-shape pooling."""
+    return (
+        _pool_pad(h, kh, s, py),
+        _pool_pad(w, kw, s, px),
+        _ceil_pool_shape(h, kh, s, py),
+        _ceil_pool_shape(w, kw, s, px),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _maxpool_eq(x, kh: int, kw: int, s: int, py: int, px: int):
+    """Ceil-shape max pooling whose backward is the reference's unpool.
+
+    Forward: max tree over the k*k statically-shifted strided slices
+    (see _PoolBase._pool).  Backward (custom VJP): the mshadow
+    ``unpool`` rule the reference's pooling layer uses
+    (``pooling_layer-inl.hpp:66-75``) — every input position equal to
+    its window's max receives that window's gradient:
+    ``dx_i = sum_w [x_i == y_w] * g_w``.
+
+    Two reasons to override autodiff here (measured on v5e, GoogLeNet
+    b128, doc/performance.md): the max tree's autodiff backward is an
+    8-deep select chain that materializes pred masks between fusions
+    (~29ms/step across the 13 pools — 40% of the whole train step), and
+    its single-winner tie handling differs from the reference.  The
+    equality rule is k*k fused compare-multiplies expanded back onto
+    the input grid with interior padding (the transpose of the strided
+    slice), the same pad+add shape XLA already lowers well for the sum
+    pool's backward.
+    """
+    (plh, prh), (plw, prw), oh, ow = _pool_geometry(
+        x.shape[1], x.shape[2], kh, kw, s, py, px
+    )
+    xp = jnp.pad(
+        x,
+        ((0, 0), (plh, prh), (plw, prw), (0, 0)),
+        constant_values=x.dtype.type(-jnp.inf),
+    )
+    acc = None
+    for dy in range(kh):
+        for dx in range(kw):
+            sl = xp[
+                :,
+                dy : dy + (oh - 1) * s + 1 : s,
+                dx : dx + (ow - 1) * s + 1 : s,
+                :,
+            ]
+            acc = sl if acc is None else lax.max(acc, sl)
+    return acc
+
+
+def _maxpool_eq_fwd(x, kh, kw, s, py, px):
+    y = _maxpool_eq(x, kh, kw, s, py, px)
+    return y, (x, y)
+
+
+def _maxpool_eq_bwd(kh, kw, s, py, px, res, g):
+    x, y = res
+    h, w = x.shape[1], x.shape[2]
+    (plh, prh), (plw, prw), oh, ow = _pool_geometry(
+        h, w, kh, kw, s, py, px
+    )
+    xp = jnp.pad(
+        x,
+        ((0, 0), (plh, prh), (plw, prw), (0, 0)),
+        constant_values=x.dtype.type(-jnp.inf),
+    )
+    hp, wp = xp.shape[1], xp.shape[2]
+    zero = jnp.zeros((), g.dtype)
+    # note: a gather-style s==1 formulation (read y/g at k*k shifts, one
+    # pass at input resolution) measured SLOWER on v5e than this
+    # pad-and-add form (2044 vs 2128 img/s GoogLeNet b128) — the pads
+    # below fuse better than the 2k²+1-operand compare fusion
+    total = None
+    for dy in range(kh):
+        for dx in range(kw):
+            xw = xp[
+                :,
+                dy : dy + (oh - 1) * s + 1 : s,
+                dx : dx + (ow - 1) * s + 1 : s,
+                :,
+            ]
+            contrib = jnp.where(xw == y, g, zero)
+            # transpose of the strided slice: interior-pad back onto the
+            # padded-input grid, then the contributions just add
+            exp = lax.pad(
+                contrib,
+                zero,
+                (
+                    (0, 0, 0),
+                    (dy, hp - (dy + (oh - 1) * s + 1), s - 1),
+                    (dx, wp - (dx + (ow - 1) * s + 1), s - 1),
+                    (0, 0, 0),
+                ),
+            )
+            total = exp if total is None else total + exp
+    dx_ = total[:, plh : plh + h, plw : plw + w, :]
+    return (dx_.astype(x.dtype),)
+
+
+_maxpool_eq.defvjp(_maxpool_eq_fwd, _maxpool_eq_bwd)
+
+
 class _PoolBase(Layer):
     """Shared ceil-shape pooling over NHWC (shifted-slice tree, see _pool)."""
 
@@ -171,13 +277,20 @@ class _PoolBase(Layer):
                 acc = sl if acc is None else reducer(acc, sl)
         return acc
 
+    def _max_pool(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Max pooling with the unpool-equality backward (_maxpool_eq)."""
+        p = self.param
+        return _maxpool_eq(
+            x, p.kernel_height, p.kernel_width, p.stride, p.pad_y, p.pad_x
+        )
+
 
 @register
 class MaxPoolingLayer(_PoolBase):
     type_name = "max_pooling"
 
     def apply(self, params, inputs, *, train=False, rng=None, step=None):
-        return [self._pool(inputs[0], lax.max, -jnp.inf)]
+        return [self._max_pool(inputs[0])]
 
 
 @register
@@ -204,7 +317,7 @@ class ReluMaxPoolingLayer(_PoolBase):
     type_name = "relu_max_pooling"
 
     def apply(self, params, inputs, *, train=False, rng=None, step=None):
-        return [self._pool(jax.nn.relu(inputs[0]), lax.max, -jnp.inf)]
+        return [self._max_pool(jax.nn.relu(inputs[0]))]
 
 
 @register
@@ -245,7 +358,7 @@ class InsanityPoolingLayer(_PoolBase):
                     ),
                 ),
             )
-        return [self._pool(x, lax.max, -jnp.inf)]
+        return [self._max_pool(x)]
 
 
 _PALLAS_LRN_OK: dict = {}

@@ -26,6 +26,17 @@ import jax.numpy as jnp
 from .base import Layer, Params, Shape, register
 
 
+def _layer_norm(x, w, b, eps: float):
+    """Shared layer-norm math: statistics in f32 under mixed precision."""
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + jnp.float32(eps))
+    return (
+        y * w.astype(jnp.float32) + b.astype(jnp.float32)
+    ).astype(x.dtype)
+
+
 @register
 class AttentionLayer(Layer):
     type_name = "attention"
@@ -138,14 +149,7 @@ class LayerNormLayer(Layer):
 
     def apply(self, params, inputs, *, train=False, rng=None, step=None):
         x = inputs[0]
-        xf = x.astype(jnp.float32)  # stats in f32 under mixed precision
-        mu = xf.mean(axis=-1, keepdims=True)
-        var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
-        y = (xf - mu) * jax.lax.rsqrt(var + jnp.float32(self.eps))
-        y = y * params["wmat"].astype(jnp.float32) + params["bias"].astype(
-            jnp.float32
-        )
-        return [y.astype(x.dtype)]
+        return [_layer_norm(x, params["wmat"], params["bias"], self.eps)]
 
 
 @register
@@ -242,19 +246,13 @@ class MoELayer(Layer):
         return [jnp.einsum("...e,...eo->...o", gate, h)]
 
 
-@register
-class PipeMLPLayer(Layer):
-    """A stack of ``nblock`` identical relu-MLP blocks runnable as a
-    GPipe pipeline (``ops/pipeline.py``) over the mesh model axis.
-
-    The config-grammar entry point for pipeline parallelism: blocks are
-    homogeneous (``y = relu(x W_i + b_i)``, width = input dim), their
-    params live in one ``(L, D, D)`` stack sharded one-stage-per-device
-    when ``pipeline_parallel = 1``, and microbatches stream through the
-    stages with activations hopping a ppermute ring.
-    """
-
-    type_name = "pipe_mlp"
+class _PipelineStackLayer(Layer):
+    """Shared plumbing for homogeneous block-stack layers that can run as
+    a GPipe pipeline over the mesh model axis: the
+    ``pipeline_parallel`` / ``n_microbatch`` config keys, mesh binding,
+    stage/microbatch divisibility checks, and the
+    pipeline-vs-scanned-stack dispatch.  Subclasses define ``nblock``,
+    ``_block(p, x)``, and their params stack."""
 
     def __init__(self) -> None:
         super().__init__()
@@ -276,23 +274,158 @@ class PipeMLPLayer(Layer):
     def bind_mesh(self, plan) -> None:
         self.mesh_plan = plan
 
+    def _check_pipeline_shape(self, batch: int) -> None:
+        if self.pipeline_parallel and self.mesh_plan is not None:
+            nm = self.mesh_plan.n_model
+            if nm > 1 and self.nblock % nm != 0:
+                raise ValueError(
+                    f"{self.type_name}: nblock={self.nblock} must divide "
+                    f"over the model axis ({nm} stages)"
+                )
+            if nm > 1 and batch % self.n_microbatch != 0:
+                raise ValueError(
+                    f"{self.type_name}: batch {batch} must divide into "
+                    f"{self.n_microbatch} microbatches"
+                )
+
+    def _apply_stack(self, stack, x):
+        """Run the block stack pipelined (when configured on a >1 model
+        axis) or as a plain lax.scan — identical math either way."""
+        plan = self.mesh_plan
+        if self.pipeline_parallel and plan is not None and plan.n_model > 1:
+            from ..ops.pipeline import pipeline_apply
+
+            return pipeline_apply(
+                self._block, stack, x, plan.mesh,
+                n_microbatch=self.n_microbatch, stage_axis="model",
+            )
+
+        def body(h, p):
+            return self._block(p, h), None
+
+        y, _ = jax.lax.scan(body, x, stack)
+        return y
+
+
+@register
+class PipeTransformerLayer(_PipelineStackLayer):
+    """A stack of ``nblock`` identical pre-LN transformer blocks runnable
+    as a GPipe pipeline (``ops/pipeline.py``) over the mesh model axis.
+
+    Pipeline parallelism over REAL model blocks: each block is
+    layer_norm -> multi-head attention -> residual -> layer_norm ->
+    gelu-MLP -> residual, exactly the ``transformer_conf`` block
+    structure, with all ``nblock`` blocks' parameters living in stacked
+    ``(L, ...)`` tensors.  With ``pipeline_parallel = 1`` the stack is
+    sharded one-stage-per-device and microbatches stream through the
+    gpipe schedule; with 0 the same blocks run as a plain ``lax.scan``
+    (identical math — the parity fixture in tests/test_pipeline.py).
+
+    SPMD pipelining requires homogeneous stages (every device runs the
+    same program), hence a block *stack* rather than arbitrary layer
+    ranges — the same constraint praxis/GSPMD pipelining has.
+    """
+
+    type_name = "pipe_transformer"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.nhead = 1
+        self.causal = 0
+        self.ffn_hidden = 0  # default 4*D
+        self.eps = 1e-6
+
+    def set_param(self, name, val):
+        if name == "nhead":
+            self.nhead = int(val)
+        elif name == "causal":
+            self.causal = int(val)
+        elif name == "ffn_hidden":
+            self.ffn_hidden = int(val)
+        elif name == "eps":
+            self.eps = float(val)
+        else:
+            super().set_param(name, val)
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        self._check_arity(in_shapes, 1)
+        (shape,) = in_shapes
+        if len(shape) != 3:
+            raise ValueError(
+                "pipe_transformer: input must be a sequence node (N, T, D)"
+            )
+        n, t, d = shape
+        if self.nhead <= 0 or d % self.nhead != 0:
+            raise ValueError(
+                f"pipe_transformer: nhead={self.nhead} must divide dim {d}"
+            )
+        self._check_pipeline_shape(n)
+        return [tuple(shape)]
+
+    def init_params(self, key, in_shapes) -> Params:
+        d = in_shapes[0][2]
+        h = self.ffn_hidden or 4 * d
+        l = self.nblock
+        sigma = self.param.init_sigma
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        return {
+            "ln1_w": jnp.ones((l, d), jnp.float32),
+            "ln1_b": jnp.zeros((l, d), jnp.float32),
+            "ln2_w": jnp.ones((l, d), jnp.float32),
+            "ln2_b": jnp.zeros((l, d), jnp.float32),
+            "wqkv": jax.random.normal(k1, (l, 3 * d, d), jnp.float32) * sigma,
+            "bqkv": jnp.zeros((l, 3 * d), jnp.float32),
+            "wproj": jax.random.normal(k2, (l, d, d), jnp.float32) * sigma,
+            "bproj": jnp.zeros((l, d), jnp.float32),
+            "wff1": jax.random.normal(k3, (l, h, d), jnp.float32) * sigma,
+            "bff1": jnp.zeros((l, h), jnp.float32),
+            "wff2": jax.random.normal(k4, (l, d, h), jnp.float32) * sigma,
+            "bff2": jnp.zeros((l, d), jnp.float32),
+        }
+
+    def _block(self, p, x):
+        from ..ops.attention import mha
+
+        n, t, d = x.shape
+        nh = self.nhead
+        h = _layer_norm(x, p["ln1_w"], p["ln1_b"], self.eps)
+        qkv = h @ p["wqkv"].T + p["bqkv"]
+        qkv = qkv.reshape(n, t, 3, nh, d // nh)
+        o = mha(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                causal=bool(self.causal))
+        x = x + o.reshape(n, t, d) @ p["wproj"].T + p["bproj"]
+        h2 = _layer_norm(x, p["ln2_w"], p["ln2_b"], self.eps)
+        f = (jax.nn.gelu(h2 @ p["wff1"].T + p["bff1"])
+             @ p["wff2"].T + p["bff2"])
+        return x + f
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        x = inputs[0]
+        stack = {k: v.astype(x.dtype) for k, v in params.items()}
+        return [self._apply_stack(stack, x)]
+
+
+@register
+class PipeMLPLayer(_PipelineStackLayer):
+    """A stack of ``nblock`` identical relu-MLP blocks runnable as a
+    GPipe pipeline (``ops/pipeline.py``) over the mesh model axis.
+
+    The minimal pipeline-parallel layer: blocks are homogeneous
+    (``y = relu(x W_i + b_i)``, width = input dim), their params live in
+    one ``(L, D, D)`` stack sharded one-stage-per-device when
+    ``pipeline_parallel = 1``, and microbatches stream through the
+    stages with activations hopping a ppermute ring.  For pipelining
+    real model blocks use ``pipe_transformer``.
+    """
+
+    type_name = "pipe_mlp"
+
     def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
         self._check_arity(in_shapes, 1)
         (shape,) = in_shapes
         if len(shape) != 2:
             raise ValueError("pipe_mlp: input must be a matrix node")
-        if self.pipeline_parallel and self.mesh_plan is not None:
-            nm = self.mesh_plan.n_model
-            if nm > 1 and self.nblock % nm != 0:
-                raise ValueError(
-                    f"pipe_mlp: nblock={self.nblock} must divide over the "
-                    f"model axis ({nm} stages)"
-                )
-            if nm > 1 and shape[0] % self.n_microbatch != 0:
-                raise ValueError(
-                    f"pipe_mlp: batch {shape[0]} must divide into "
-                    f"{self.n_microbatch} microbatches"
-                )
+        self._check_pipeline_shape(shape[0])
         return [tuple(shape)]
 
     def init_params(self, key, in_shapes) -> Params:
@@ -315,19 +448,4 @@ class PipeMLPLayer(Layer):
             "wmat": params["wmat"].astype(x.dtype),
             "bias": params["bias"].astype(x.dtype),
         }
-        plan = self.mesh_plan
-        if self.pipeline_parallel and plan is not None and plan.n_model > 1:
-            from ..ops.pipeline import pipeline_apply
-
-            return [
-                pipeline_apply(
-                    self._block, stack, x, plan.mesh,
-                    n_microbatch=self.n_microbatch, stage_axis="model",
-                )
-            ]
-
-        def body(h, p):
-            return self._block(p, h), None
-
-        y, _ = jax.lax.scan(body, x, stack)
-        return [y]
+        return [self._apply_stack(stack, x)]

@@ -402,6 +402,8 @@ def transformer_conf(
     nsample: int = 0,
     dev: str = "tpu",
     compute_dtype: str = "bfloat16",
+    pipeline_parallel: int = 0,
+    n_microbatch: int = 4,
 ) -> str:
     """Pre-norm transformer encoder classifier over dense sequences.
 
@@ -410,6 +412,12 @@ def transformer_conf(
     then mean pooling and a softmax head.  ``seq_parallel=1`` runs ring
     attention with the sequence sharded over the mesh model axis
     (``ops/attention.py``).
+
+    ``pipeline_parallel >= 1`` declares the SAME block stack as a
+    ``pipe_transformer`` layer (stacked params) so it can run as a GPipe
+    pipeline over the mesh model axis; ``pipeline_parallel = 1`` keeps
+    pipelining off (plain scanned stack) with identical math — the
+    parity pair for tests.
     """
     nsample = nsample or batch_size * 4
     data = ""
@@ -425,8 +433,27 @@ def transformer_conf(
                 "iter = end\n"
             )
     s = "netconfig = start\n"
-    prev = "0"
-    for i in range(nlayer):
+    if pipeline_parallel >= 1 and seq_parallel:
+        raise ValueError(
+            "transformer_conf: seq_parallel (ring attention) and "
+            "pipeline_parallel are mutually exclusive — both shard over "
+            "the mesh model axis"
+        )
+    if pipeline_parallel >= 1:
+        s += (
+            "layer[0->blocks] = pipe_transformer:blocks\n"
+            f"  nblock = {nlayer}\n"
+            f"  nhead = {nhead}\n"
+            f"  causal = {causal}\n"
+            f"  ffn_hidden = {dim * 4}\n"
+            f"  pipeline_parallel = {1 if pipeline_parallel > 1 else 0}\n"
+            f"  n_microbatch = {n_microbatch}\n"
+            "  init_sigma = 0.02\n"
+        )
+        prev = "blocks"
+    else:
+        prev = "0"
+    for i in range(nlayer) if pipeline_parallel < 1 else ():
         b = f"b{i}"
         s += (
             f"layer[{prev}->{b}_n1] = layer_norm:{b}_ln1\n"
@@ -454,6 +481,8 @@ def transformer_conf(
         "input_layout = seq\n"
     )
     extra = f"compute_dtype = {compute_dtype}\n"
+    if pipeline_parallel > 1:
+        extra += f"model_parallel = {pipeline_parallel}\n"
     return data + s + _tail(
         batch_size, f"1,{seq_len},{dim}", 10, eta=0.01, dev=dev, extra=extra
     )

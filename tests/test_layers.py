@@ -172,6 +172,44 @@ def test_relu_max_pooling(rng):
     np.testing.assert_allclose(out, pool_ref(np.maximum(x, 0), 2, 2, "max"), rtol=1e-5)
 
 
+def unpool_ref(x, g, k, s):
+    """mshadow unpool rule (pooling_layer-inl.hpp:66-75): every input
+    position equal to its window's max receives that window's gradient."""
+    n, h, w, c = x.shape
+    y = pool_ref(x, k, s, "max")
+    oh, ow = y.shape[1], y.shape[2]
+    dx = np.zeros_like(x)
+    for i in range(oh):
+        for j in range(ow):
+            for ii in range(i * s, min(i * s + k, h)):
+                for jj in range(j * s, min(j * s + k, w)):
+                    dx[:, ii, jj] += np.where(
+                        x[:, ii, jj] == y[:, i, j], g[:, i, j], 0.0
+                    )
+    return dx
+
+
+@pytest.mark.parametrize("hw,k,s", [(28, 3, 2), (6, 2, 2), (7, 3, 3), (8, 3, 1)])
+def test_maxpool_backward_is_reference_unpool(rng, hw, k, s):
+    """The custom-VJP backward (conv._maxpool_eq) == mshadow unpool,
+    including gradient duplication to ALL tied max positions (ties are
+    common post-relu where windows share zeros)."""
+    x = rng.randn(2, hw, hw, 3).astype(np.float32)
+    # force ties: zero out a block so multiple window positions tie at 0
+    x[:, : hw // 2] = np.maximum(x[:, : hw // 2], 0.0)
+    x[0, 0, :] = 0.0
+    lay = mk("max_pooling", [("kernel_size", str(k)), ("stride", str(s))])
+    out_shape = lay.infer_shape([x.shape])[0]
+    g = rng.randn(*out_shape).astype(np.float32)
+
+    def f(v):
+        return (lay.apply({}, [jnp.asarray(v)])[0] * jnp.asarray(g)).sum()
+
+    dx = np.asarray(jax.grad(f)(x))
+    np.testing.assert_allclose(dx, unpool_ref(x, g, k, s), rtol=1e-4,
+                               atol=1e-5)
+
+
 def test_insanity_pooling_eval_is_maxpool(rng):
     x = rng.randn(2, 6, 6, 2).astype(np.float32)
     lay = mk("insanity_max_pooling", [("kernel_size", "2"), ("stride", "2"), ("keep", "0.7")])

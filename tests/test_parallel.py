@@ -186,3 +186,59 @@ def test_update_on_server_zero1_state_sharding():
                 rtol=2e-4, atol=2e-5,
                 err_msg=f"{key}/{tag} diverged under update_on_server",
             )
+
+
+def test_tp_step_never_allgathers_weights():
+    """Communication sanity for tensor parallelism (VERDICT r1 #7): the
+    compiled fused step may all-gather *activations* (channel-sharded
+    conv/fullc outputs re-assembling for the next layer) but must never
+    all-gather a weight-shaped tensor per step — weights stay sharded on
+    the model axis for the whole program."""
+    import collections
+    import re
+
+    import jax.numpy as jnp
+
+    cfg = [
+        ("dev", "cpu:0-7"), ("model_parallel", "2"), ("batch_size", "16"),
+        ("input_shape", "3,16,16"), ("eta", "0.1"),
+        ("netconfig", "start"),
+        ("layer[0->1]", "conv:c1"), ("kernel_size", "3"), ("pad", "1"),
+        ("nchannel", "64"),
+        ("layer[1->2]", "relu"),
+        ("layer[2->3]", "flatten"),
+        ("layer[3->4]", "fullc:fc1"), ("nhidden", "128"),
+        ("layer[4->5]", "relu"),
+        ("layer[5->6]", "fullc:fc2"), ("nhidden", "10"),
+        ("layer[6->6]", "softmax"),
+        ("netconfig", "end"),
+    ]
+    tr = NetTrainer()
+    tr.set_params(cfg)
+    tr.init_model()
+    fn = tr._fused_step_fn()
+    rng = np.random.RandomState(0)
+    d = jnp.asarray(rng.randn(16, 16, 16, 3).astype(np.float32))
+    l = jnp.asarray(rng.randint(0, 10, (16, 1)).astype(np.float32))
+    mask = jnp.asarray(np.ones(16, np.float32))
+    txt = fn.lower(
+        tr.params, tr.ustates, tr.aux, d, l, mask,
+        jax.random.PRNGKey(0), jnp.asarray(0, jnp.int32), (),
+    ).compile().as_text()
+
+    weight_shapes = set()
+    for tags in jax.tree_util.tree_leaves(tr.params):
+        weight_shapes.add(
+            "[" + ",".join(str(s) for s in np.shape(tags)) + "]"
+        )
+    ag_shapes = [
+        m.group(1)
+        for m in re.finditer(r"=\s*\S*f32(\[[\d,]*\])\S*\s+all-gather\(", txt)
+    ]
+    offenders = [s for s in ag_shapes if s in weight_shapes]
+    assert not offenders, (
+        f"TP step all-gathers weight-shaped tensors {offenders}; "
+        "weights must stay model-axis-sharded"
+    )
+    # gradient sync over the data axis must exist
+    assert "all-reduce" in txt

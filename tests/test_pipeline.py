@@ -126,3 +126,79 @@ def test_pipe_mlp_layer_config_e2e(rng):
                 rtol=3e-4, atol=3e-5,
                 err_msg=f"{key}/{tag} diverged under pipeline parallelism",
             )
+
+
+def test_pipe_transformer_parity_and_sharding(rng):
+    """transformer_conf(pipeline_parallel=k) trains to IDENTICAL params as
+    the k=1 (plain scanned stack) run on the 8-dev mesh — the VERDICT r1
+    'promote PP from toy to capability' fixture: real pre-LN transformer
+    blocks (MHA + FFN + residuals), stacked params, gpipe schedule."""
+    from jax.sharding import PartitionSpec as P
+
+    from cxxnet_tpu import config as C
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.models import transformer_conf
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    def train(pp, dev):
+        text = transformer_conf(
+            batch_size=16, seq_len=8, dim=16, nhead=2, nlayer=4,
+            num_class=4, dev=dev, compute_dtype="float32",
+            pipeline_parallel=pp, n_microbatch=4,
+        )
+        tr = NetTrainer()
+        tr.set_params(C.parse_pairs(text))
+        tr.init_model()
+        r = np.random.RandomState(3)
+        for _ in range(3):
+            x = r.randn(16, 8, 16).astype(np.float32)
+            y = r.randint(0, 4, (16, 1)).astype(np.float32)
+            tr.update(DataBatch(data=x, label=y))
+        return tr
+
+    t1 = train(1, "cpu")
+    tpp = train(4, "cpu:0-7")  # 2 data x 4 pipeline stages
+    w = tpp.params["l0_blocks"]["wqkv"]  # (4, 48, 16) stage-sharded
+    assert w.sharding.spec == P("model", None, None)
+    for key in t1.params:
+        for tag in t1.params[key]:
+            np.testing.assert_allclose(
+                np.asarray(t1.params[key][tag]),
+                np.asarray(tpp.params[key][tag]),
+                rtol=3e-4, atol=3e-5,
+                err_msg=f"{key}/{tag} diverged under pipeline parallelism",
+            )
+
+
+def test_pipe_transformer_block_matches_reference_impl(rng):
+    """One pipe_transformer block == hand-computed pre-LN block math."""
+    from cxxnet_tpu.layers import create_layer
+    from cxxnet_tpu.ops.attention import mha
+
+    lay = create_layer("pipe_transformer")
+    lay.nblock = 1
+    lay.nhead = 2
+    key = jax.random.PRNGKey(0)
+    x = jnp.asarray(rng.randn(2, 8, 16).astype(np.float32))
+    params = lay.init_params(key, [(2, 8, 16)])
+    (y,) = lay.apply(params, [x])
+
+    def ln(v, w, b):
+        mu = v.mean(-1, keepdims=True)
+        var = ((v - mu) ** 2).mean(-1, keepdims=True)
+        return (v - mu) / np.sqrt(var + 1e-6) * w + b
+
+    p = {k: np.asarray(v)[0] for k, v in params.items()}
+    xn = np.asarray(x)
+    h = ln(xn, p["ln1_w"], p["ln1_b"])
+    qkv = h @ p["wqkv"].T + p["bqkv"]
+    qkv = qkv.reshape(2, 8, 3, 2, 8)
+    o = np.asarray(
+        mha(jnp.asarray(qkv[:, :, 0]), jnp.asarray(qkv[:, :, 1]),
+            jnp.asarray(qkv[:, :, 2]))
+    )
+    x1 = xn + o.reshape(2, 8, 16) @ p["wproj"].T + p["bproj"]
+    h2 = ln(x1, p["ln2_w"], p["ln2_b"])
+    f = (np.asarray(jax.nn.gelu(jnp.asarray(h2 @ p["wff1"].T + p["bff1"])))
+         @ p["wff2"].T + p["bff2"])
+    np.testing.assert_allclose(np.asarray(y), x1 + f, rtol=1e-4, atol=1e-5)
