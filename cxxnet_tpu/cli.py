@@ -92,15 +92,16 @@ class LearnTask:
         from .parallel import maybe_init_distributed
 
         maybe_init_distributed(self.cfg)
-        if self.task not in ("train", "finetune", "pred", "extract"):
+        if self.task not in ("train", "finetune", "pred", "pred_raw",
+                             "extract"):
             raise ValueError(f"unknown task {self.task!r}")
         self.init()
         if not self.silent:
             print("initializing end, start working")
         if self.task in ("train", "finetune"):
             self.task_train()
-        elif self.task == "pred":
-            self.task_predict()
+        elif self.task in ("pred", "pred_raw"):
+            self.task_predict(raw=self.task == "pred_raw")
         elif self.task == "extract":
             self.task_extract()
         else:
@@ -176,16 +177,16 @@ class LearnTask:
     def _create_iterators(self) -> None:
         split = cfgmod.split_sections(self.cfg)
         for sec in split.sections:
-            if sec.kind == "data" and self.task != "pred":
+            if sec.kind == "data" and self.task not in ("pred", "pred_raw"):
                 if self.itr_train is not None:
                     raise ValueError("can only have one data section")
                 self.itr_train = create_iterator(sec.entries)
-            elif sec.kind == "eval" and self.task != "pred":
+            elif sec.kind == "eval" and self.task not in ("pred", "pred_raw"):
                 self.itr_evals.append(create_iterator(sec.entries))
                 self.eval_names.append(sec.tag)
             elif sec.kind == "pred":
                 self.name_pred = sec.tag
-                if self.task in ("pred", "extract"):
+                if self.task in ("pred", "pred_raw", "extract"):
                     if self.itr_pred is not None:
                         raise ValueError("can only have one pred section")
                     self.itr_pred = create_iterator(sec.entries)
@@ -264,7 +265,12 @@ class LearnTask:
         if not self.silent:
             print(f"\nupdating end, {int(time.time() - start)} sec in all")
 
-    def task_predict(self) -> None:
+    def task_predict(self, raw: bool = False) -> None:
+        """``task=pred``: one argmax/value per line.  ``task=pred_raw``:
+        the full output row (softmax probabilities) space-separated —
+        the submission-file input (reference ``CXXNetPredRaw``,
+        ``wrapper/cxxnet_wrapper.h:150``; kaggle_bowl make_submission
+        expects a trailing separator, kept for format parity)."""
         if self.itr_pred is None:
             raise ValueError("must specify a pred iterator to generate predictions")
         print("start predicting...")
@@ -272,10 +278,16 @@ class LearnTask:
             self.itr_pred.before_first()
             while self.itr_pred.next():
                 batch = self.itr_pred.value()
-                preds = self.net_trainer.predict(batch)
                 n = batch.batch_size - batch.num_batch_padd
-                for v in preds[:n]:
-                    fo.write(f"{v:g}\n")
+                if raw:
+                    rows = self.net_trainer.extract_feature(batch, "top[-1]")
+                    rows = rows.reshape(rows.shape[0], -1)
+                    for r in rows[:n]:
+                        fo.write(" ".join(f"{v:g}" for v in r) + " \n")
+                else:
+                    preds = self.net_trainer.predict(batch)
+                    for v in preds[:n]:
+                        fo.write(f"{v:g}\n")
         print(f"finished prediction, write into {self.name_pred}")
 
     def task_extract(self) -> None:

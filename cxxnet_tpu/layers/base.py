@@ -137,6 +137,10 @@ class Layer:
     type_name: str = ""
     # True for loss layers (self-loop in reference configs)
     is_loss: bool = False
+    # param tags kept float32 under mixed precision (norm scales/biases
+    # whose math runs in f32 — a bf16 round-trip would only lose bits);
+    # whole-layer exemptions live in FunctionalNet._f32_param_keys
+    f32_tags: frozenset = frozenset()
 
     def __init__(self) -> None:
         self.param = LayerParam()

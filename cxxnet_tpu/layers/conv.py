@@ -125,6 +125,31 @@ def _pool_geometry(h: int, w: int, kh: int, kw: int, s: int, py: int,
     )
 
 
+def _pad_for_pool(x, kh, kw, s, py, px, init_val):
+    """(padded x, geometry): the common front of every pooling path."""
+    geo = _pool_geometry(x.shape[1], x.shape[2], kh, kw, s, py, px)
+    (plh, prh), (plw, prw), _, _ = geo
+    xp = jnp.pad(
+        x,
+        ((0, 0), (plh, prh), (plw, prw), (0, 0)),
+        constant_values=x.dtype.type(init_val),
+    )
+    return xp, geo
+
+
+def _shifted_slices(xp, kh, kw, s, oh, ow):
+    """Yield ((dy, dx), window-element slice) over the k*k offsets: the
+    strided-slice tree shared by pooling forward and backward."""
+    for dy in range(kh):
+        for dx in range(kw):
+            yield (dy, dx), xp[
+                :,
+                dy : dy + (oh - 1) * s + 1 : s,
+                dx : dx + (ow - 1) * s + 1 : s,
+                :,
+            ]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
 def _maxpool_eq(x, kh: int, kw: int, s: int, py: int, px: int):
     """Ceil-shape max pooling whose backward is the reference's unpool.
@@ -146,24 +171,10 @@ def _maxpool_eq(x, kh: int, kw: int, s: int, py: int, px: int):
     slice), the same pad+add shape XLA already lowers well for the sum
     pool's backward.
     """
-    (plh, prh), (plw, prw), oh, ow = _pool_geometry(
-        x.shape[1], x.shape[2], kh, kw, s, py, px
-    )
-    xp = jnp.pad(
-        x,
-        ((0, 0), (plh, prh), (plw, prw), (0, 0)),
-        constant_values=x.dtype.type(-jnp.inf),
-    )
+    xp, (_, _, oh, ow) = _pad_for_pool(x, kh, kw, s, py, px, -jnp.inf)
     acc = None
-    for dy in range(kh):
-        for dx in range(kw):
-            sl = xp[
-                :,
-                dy : dy + (oh - 1) * s + 1 : s,
-                dx : dx + (ow - 1) * s + 1 : s,
-                :,
-            ]
-            acc = sl if acc is None else lax.max(acc, sl)
+    for _, sl in _shifted_slices(xp, kh, kw, s, oh, ow):
+        acc = sl if acc is None else lax.max(acc, sl)
     return acc
 
 
@@ -175,13 +186,8 @@ def _maxpool_eq_fwd(x, kh, kw, s, py, px):
 def _maxpool_eq_bwd(kh, kw, s, py, px, res, g):
     x, y = res
     h, w = x.shape[1], x.shape[2]
-    (plh, prh), (plw, prw), oh, ow = _pool_geometry(
-        h, w, kh, kw, s, py, px
-    )
-    xp = jnp.pad(
-        x,
-        ((0, 0), (plh, prh), (plw, prw), (0, 0)),
-        constant_values=x.dtype.type(-jnp.inf),
+    xp, ((plh, _), (plw, _), oh, ow) = _pad_for_pool(
+        x, kh, kw, s, py, px, -jnp.inf
     )
     hp, wp = xp.shape[1], xp.shape[2]
     zero = jnp.zeros((), g.dtype)
@@ -190,28 +196,21 @@ def _maxpool_eq_bwd(kh, kw, s, py, px, res, g):
     # pad-and-add form (2044 vs 2128 img/s GoogLeNet b128) — the pads
     # below fuse better than the 2k²+1-operand compare fusion
     total = None
-    for dy in range(kh):
-        for dx in range(kw):
-            xw = xp[
-                :,
-                dy : dy + (oh - 1) * s + 1 : s,
-                dx : dx + (ow - 1) * s + 1 : s,
-                :,
-            ]
-            contrib = jnp.where(xw == y, g, zero)
-            # transpose of the strided slice: interior-pad back onto the
-            # padded-input grid, then the contributions just add
-            exp = lax.pad(
-                contrib,
-                zero,
-                (
-                    (0, 0, 0),
-                    (dy, hp - (dy + (oh - 1) * s + 1), s - 1),
-                    (dx, wp - (dx + (ow - 1) * s + 1), s - 1),
-                    (0, 0, 0),
-                ),
-            )
-            total = exp if total is None else total + exp
+    for (dy, dx), xw in _shifted_slices(xp, kh, kw, s, oh, ow):
+        contrib = jnp.where(xw == y, g, zero)
+        # transpose of the strided slice: interior-pad back onto the
+        # padded-input grid, then the contributions just add
+        exp = lax.pad(
+            contrib,
+            zero,
+            (
+                (0, 0, 0),
+                (dy, hp - (dy + (oh - 1) * s + 1), s - 1),
+                (dx, wp - (dx + (ow - 1) * s + 1), s - 1),
+                (0, 0, 0),
+            ),
+        )
+        total = exp if total is None else total + exp
     dx_ = total[:, plh : plh + h, plw : plw + w, :]
     return (dx_.astype(x.dtype),)
 
@@ -255,26 +254,12 @@ class _PoolBase(Layer):
         """
         p = self.param
         kh, kw, s = p.kernel_height, p.kernel_width, p.stride
-        h, w = x.shape[1], x.shape[2]
-        (plh, prh) = _pool_pad(h, kh, s, p.pad_y)
-        (plw, prw) = _pool_pad(w, kw, s, p.pad_x)
-        oh = _ceil_pool_shape(h, kh, s, p.pad_y)
-        ow = _ceil_pool_shape(w, kw, s, p.pad_x)
-        xp = jnp.pad(
-            x,
-            ((0, 0), (plh, prh), (plw, prw), (0, 0)),
-            constant_values=x.dtype.type(init_val),
+        xp, (_, _, oh, ow) = _pad_for_pool(
+            x, kh, kw, s, p.pad_y, p.pad_x, init_val
         )
         acc = None
-        for dy in range(kh):
-            for dx in range(kw):
-                sl = xp[
-                    :,
-                    dy : dy + (oh - 1) * s + 1 : s,
-                    dx : dx + (ow - 1) * s + 1 : s,
-                    :,
-                ]
-                acc = sl if acc is None else reducer(acc, sl)
+        for _, sl in _shifted_slices(xp, kh, kw, s, oh, ow):
+            acc = sl if acc is None else reducer(acc, sl)
         return acc
 
     def _max_pool(self, x: jnp.ndarray) -> jnp.ndarray:

@@ -327,6 +327,7 @@ class PipeTransformerLayer(_PipelineStackLayer):
     """
 
     type_name = "pipe_transformer"
+    f32_tags = frozenset({"ln1_w", "ln1_b", "ln2_w", "ln2_b"})
 
     def __init__(self) -> None:
         super().__init__()
@@ -401,7 +402,10 @@ class PipeTransformerLayer(_PipelineStackLayer):
 
     def apply(self, params, inputs, *, train=False, rng=None, step=None):
         x = inputs[0]
-        stack = {k: v.astype(x.dtype) for k, v in params.items()}
+        stack = {
+            k: (v if k in self.f32_tags else v.astype(x.dtype))
+            for k, v in params.items()
+        }
         return [self._apply_stack(stack, x)]
 
 

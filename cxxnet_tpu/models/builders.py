@@ -451,9 +451,11 @@ def transformer_conf(
             "  init_sigma = 0.02\n"
         )
         prev = "blocks"
+        per_layer_blocks = range(0)
     else:
         prev = "0"
-    for i in range(nlayer) if pipeline_parallel < 1 else ():
+        per_layer_blocks = range(nlayer)
+    for i in per_layer_blocks:
         b = f"b{i}"
         s += (
             f"layer[{prev}->{b}_n1] = layer_norm:{b}_ln1\n"

@@ -73,6 +73,12 @@ class FunctionalNet:
             for i, lay in enumerate(self.layer_objs)
             if isinstance(lay, (BatchNormLayer, LayerNormLayer))
         }
+        # per-tag exemptions (e.g. pipe_transformer's stacked LN params)
+        self._f32_tag_map = {
+            self.param_key[i]: lay.f32_tags
+            for i, lay in enumerate(self.layer_objs)
+            if lay.f32_tags
+        }
 
     # ------------------------------------------------------------------
     def _configure_layers(self) -> None:
@@ -221,15 +227,7 @@ class FunctionalNet:
         g = self.graph
         cdt = self.compute_dtype
         if cdt != jnp.float32:
-            # mixed precision: layer math (MXU) in bf16, master params and
-            # loss in f32 — jax.grad through the cast yields f32 grads.
-            # Norm-layer params are excluded: BN does its math in f32, so
-            # rounding gamma/beta through bf16 would only lose precision.
-            params = {
-                key: (tags if key in self._f32_param_keys
-                      else {t: v.astype(cdt) for t, v in tags.items()})
-                for key, tags in params.items()
-            }
+            params = self._cast_params(params)
             data = data.astype(cdt)
             extras = [e.astype(cdt) for e in extras]
         out_idx = self.out_node_index()
@@ -311,6 +309,26 @@ class FunctionalNet:
         if return_aux:
             return nodes, total_loss, (new_aux if new_aux is not None else {})
         return nodes, total_loss
+
+    def _cast_params(self, params: Dict[str, dict]) -> Dict[str, dict]:
+        """Mixed precision: layer math (MXU) in the compute dtype, master
+        params and loss in f32 — jax.grad through the cast yields f32
+        grads.  Norm params are excluded (whole norm layers, plus any
+        tags a layer lists in ``f32_tags``, e.g. pipe_transformer's
+        stacked LN scales): their math runs in f32, so rounding
+        gamma/beta through bf16 would only lose precision."""
+        cdt = self.compute_dtype
+
+        def cast(key, tags):
+            if key in self._f32_param_keys:
+                return tags
+            keep = self._f32_tag_map.get(key, ())
+            return {
+                t: (v if t in keep else v.astype(cdt))
+                for t, v in tags.items()
+            }
+
+        return {key: cast(key, tags) for key, tags in params.items()}
 
     def _label_field(self, labels: jnp.ndarray, target: str) -> jnp.ndarray:
         g = self.graph

@@ -1,0 +1,168 @@
+"""Kaggle NDSB (plankton) workflow helpers — one tool, four subcommands.
+
+The full round-trip of the reference example
+(``/root/reference/example/kaggle_bowl/README.md``): resize the class
+folders, build shuffled .lst files, pack with ``tools/im2bin.py``, train
+``bowl.conf``, predict with ``pred.conf`` (``task = pred_raw`` writes
+softmax rows), then build the submission csv.  Replaces the reference's
+four python-2 scripts (gen_train.py / gen_test.py / gen_img_list.py /
+make_submission.py, rewritten — PIL instead of shelling out to
+ImageMagick, csv module throughout) and gen_tr_va.sh.
+
+    python bowl_tools.py resize  IN_DIR OUT_DIR [--size 48]
+    python bowl_tools.py genlist train|test sampleSubmission.csv DIR OUT.lst
+    python bowl_tools.py split   IN.lst TR.lst VA.lst [--n-train 20000]
+    python bowl_tools.py submission sampleSubmission.csv test.lst \
+        test.txt out.csv
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import random
+import sys
+
+
+def cmd_resize(args) -> None:
+    """Resize every image under IN_DIR (flat, or one folder per class)
+    to size x size (aspect ignored, reference parity) into OUT_DIR."""
+    from PIL import Image
+
+    todo = []
+    for root, _dirs, files in os.walk(args.input):
+        rel = os.path.relpath(root, args.input)
+        for f in files:
+            todo.append((os.path.join(root, f),
+                         os.path.join(args.output, rel, f)))
+    for src, dst in todo:
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        try:
+            Image.open(src).convert("RGB").resize(
+                (args.size, args.size)
+            ).save(dst)
+        except OSError as e:
+            print(f"skip {src}: {e}", file=sys.stderr)
+    print(f"resized {len(todo)} images to {args.size}x{args.size}")
+
+
+def _class_order(sample_csv: str) -> list:
+    with open(sample_csv, newline="") as f:
+        head = next(csv.reader(f))
+    return head[1:]  # first column is 'image'
+
+
+def cmd_genlist(args) -> None:
+    """Shuffled tab-separated ``index\\tlabel\\tpath`` list.
+
+    train: one folder per class under DIR, labels ordered by the
+    sampleSubmission header (the class-column order the submission
+    needs).  test: flat folder, label 0.
+    """
+    rng = random.Random(888)
+    rows = []
+    if args.task == "train":
+        for label, cls in enumerate(_class_order(args.sample)):
+            cdir = os.path.join(args.folder, cls)
+            for img in sorted(os.listdir(cdir)):
+                rows.append((label, os.path.join(cdir, img)))
+    else:
+        for img in sorted(os.listdir(args.folder)):
+            rows.append((0, os.path.join(args.folder, img)))
+    rng.shuffle(rows)
+    with open(args.out, "w", newline="") as f:
+        w = csv.writer(f, delimiter="\t", lineterminator="\n")
+        for i, (label, path) in enumerate(rows):
+            w.writerow((i, label, path))
+    print(f"wrote {len(rows)} entries to {args.out}")
+
+
+def cmd_split(args) -> None:
+    """Head/tail split of a .lst into train/validation (gen_tr_va.sh)."""
+    with open(args.input) as f:
+        lines = f.readlines()
+    with open(args.train, "w") as f:
+        f.writelines(lines[: args.n_train])
+    with open(args.val, "w") as f:
+        f.writelines(lines[args.n_train :])
+    print(
+        f"split {len(lines)} -> {min(args.n_train, len(lines))} train, "
+        f"{max(0, len(lines) - args.n_train)} val"
+    )
+
+
+def cmd_submission(args) -> None:
+    """Join test.lst image names with pred_raw softmax rows into the
+    submission csv (header + image,prob...,prob per row)."""
+    with open(args.sample, newline="") as f:
+        head = next(csv.reader(f))
+    names = []
+    with open(args.lst, newline="") as f:
+        for row in csv.reader(f, delimiter="\t"):
+            if row:
+                names.append(os.path.basename(row[-1]))
+    n = 0
+    with open(args.probs, newline="") as fi, open(
+        args.out, "w", newline=""
+    ) as fo:
+        w = csv.writer(fo, lineterminator="\n")
+        w.writerow(head)
+        for line in fi:
+            vals = line.split()
+            if not vals:
+                continue
+            if len(vals) != len(head) - 1:
+                raise ValueError(
+                    f"row {n}: {len(vals)} probabilities for "
+                    f"{len(head) - 1} classes"
+                )
+            if n >= len(names):
+                raise ValueError(
+                    f"{len(names)} test images but more prediction rows"
+                )
+            w.writerow([names[n]] + vals)
+            n += 1
+    if n != len(names):
+        raise ValueError(f"{len(names)} test images but {n} prediction rows")
+    print(f"wrote {n} rows to {args.out}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    r = sub.add_parser("resize")
+    r.add_argument("input")
+    r.add_argument("output")
+    r.add_argument("--size", type=int, default=48)
+    r.set_defaults(fn=cmd_resize)
+
+    g = sub.add_parser("genlist")
+    g.add_argument("task", choices=("train", "test"))
+    g.add_argument("sample")
+    g.add_argument("folder")
+    g.add_argument("out")
+    g.set_defaults(fn=cmd_genlist)
+
+    s = sub.add_parser("split")
+    s.add_argument("input")
+    s.add_argument("train")
+    s.add_argument("val")
+    s.add_argument("--n-train", type=int, default=20000)
+    s.set_defaults(fn=cmd_split)
+
+    m = sub.add_parser("submission")
+    m.add_argument("sample")
+    m.add_argument("lst")
+    m.add_argument("probs")
+    m.add_argument("out")
+    m.set_defaults(fn=cmd_submission)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

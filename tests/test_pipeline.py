@@ -202,3 +202,26 @@ def test_pipe_transformer_block_matches_reference_impl(rng):
     f = (np.asarray(jax.nn.gelu(jnp.asarray(h2 @ p["wff1"].T + p["bff1"])))
          @ p["wff2"].T + p["bff2"])
     np.testing.assert_allclose(np.asarray(y), x1 + f, rtol=1e-4, atol=1e-5)
+
+
+def test_pipe_transformer_ln_params_stay_f32_under_bf16():
+    """Under compute_dtype=bfloat16 the stacked LN scales/biases must
+    reach the block math in f32 (Layer.f32_tags exemption), matching the
+    standalone LayerNormLayer's mixed-precision policy."""
+    from cxxnet_tpu import config as C
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.models import transformer_conf
+
+    text = transformer_conf(
+        batch_size=8, seq_len=8, dim=16, nhead=2, nlayer=2, num_class=4,
+        dev="cpu", compute_dtype="bfloat16", pipeline_parallel=1,
+    )
+    tr = NetTrainer()
+    tr.set_params(C.parse_pairs(text))
+    tr.init_model()
+    cast = tr.net._cast_params(tr.params)
+    blocks = cast["l0_blocks"]
+    for tag in ("ln1_w", "ln1_b", "ln2_w", "ln2_b"):
+        assert blocks[tag].dtype == jnp.float32, tag
+    for tag in ("wqkv", "wproj", "wff1", "wff2"):
+        assert blocks[tag].dtype == jnp.bfloat16, tag

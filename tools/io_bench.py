@@ -1,0 +1,128 @@
+"""Input-pipeline throughput benchmark (host JPEG decode rate).
+
+Generates a synthetic JPEG imgbin (+ .lst), then drives the CLI
+``test_io = 1`` path — the reference's IO-isolation mode
+(``cxxnet_main.cpp`` ``test_io``) — through the full chain
+imgbin → native C++ decode pool → augment (crop + mirror) →
+batch → threadbuffer, sweeping ``decode_thread``.
+
+Prints one ``img/s`` line per thread count; results are recorded in
+``doc/io.md``.  The pipeline's job is to out-run the device step rate
+(SURVEY §7 hard part (c)): compare against bench.py's images/sec/chip.
+
+Usage: python tools/io_bench.py [n_images] [size] [threads,threads,...]
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def generate_imgbin(workdir: str, n: int, size: int) -> None:
+    """n synthetic photo-like JPEGs (smooth gradients + texture — noise
+    JPEGs would decode unrealistically slowly) + the matching .lst."""
+    from PIL import Image
+
+    from cxxnet_tpu.io.imgbin import BinPageWriter
+
+    rng = np.random.RandomState(0)
+    writer = BinPageWriter(os.path.join(workdir, "bench.bin"))
+    with open(os.path.join(workdir, "bench.lst"), "w") as lst:
+        yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
+        for i in range(n):
+            base = (
+                128
+                + 100 * np.sin(xx / (7 + i % 13) + i)
+                + 60 * np.cos(yy / (5 + i % 7))
+            )
+            img = np.stack(
+                [base, np.roll(base, i % size, 0), base.T], axis=-1
+            )
+            img += rng.randn(size, size, 3) * 8
+            pil = Image.fromarray(
+                np.clip(img, 0, 255).astype(np.uint8), "RGB"
+            )
+            buf = io.BytesIO()
+            pil.save(buf, "JPEG", quality=85)
+            writer.push(buf.getvalue())
+            lst.write(f"{i}\t{i % 10}\tsynth_{i}.jpg\n")
+    writer.close()
+
+
+def run_epoch(workdir: str, n: int, size: int, threads: int,
+              native: int = 1) -> float:
+    """One full pass of the train iterator chain; returns images/sec."""
+    from cxxnet_tpu import config as cfgmod
+    from cxxnet_tpu.io.data import create_iterator
+
+    crop = size - size // 8
+    conf = f"""
+data = train
+iter = imgbin
+  image_bin = {workdir}/bench.bin
+  image_list = {workdir}/bench.lst
+  native_decoder = {native}
+  decode_thread = {threads}
+  silent = 1
+  rand_crop = 1
+  rand_mirror = 1
+  input_shape = 3,{crop},{crop}
+  batch_size = 32
+  round_batch = 0
+  label_width = 1
+iter = threadbuffer
+iter = end
+"""
+    sec = cfgmod.split_sections(cfgmod.parse_pairs(conf)).find("data")[0]
+    it = create_iterator(sec.entries)
+    it.init()
+    # warm one epoch (library build, page cache)
+    it.before_first()
+    while it.next():
+        pass
+    it.before_first()
+    t0 = time.perf_counter()
+    got = 0
+    while it.next():
+        got += it.value().data.shape[0]
+    dt = time.perf_counter() - t0
+    if hasattr(it, "close"):
+        it.close()
+    return got / dt
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+    size = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    threads = (
+        [int(t) for t in sys.argv[3].split(",")]
+        if len(sys.argv) > 3
+        else [1, 2, 4, 8, 0]
+    )
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as workdir:
+        t0 = time.perf_counter()
+        generate_imgbin(workdir, n, size)
+        print(
+            f"# generated {n} JPEGs ({size}x{size}) in "
+            f"{time.perf_counter() - t0:.1f}s",
+            flush=True,
+        )
+        rate_py = run_epoch(workdir, n, size, 1, native=0)
+        print(f"python-decode fallback: {rate_py:8.1f} img/s", flush=True)
+        for t in threads:
+            rate = run_epoch(workdir, n, size, t)
+            label = "auto" if t == 0 else str(t)
+            print(f"decode_thread = {label:>4}: {rate:8.1f} img/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Write a REAL handwritten-digit dataset in MNIST idx format.
+
+The image has zero network egress, so the MNIST ubyte files the
+reference's example downloads (``/root/reference/example/MNIST/README.md``)
+cannot be fetched.  scikit-learn bundles the UCI ML handwritten digits
+set — 1797 real 8x8 handwritten digit scans — which serves as the
+real-data accuracy fixture: idx-encoded here, trained by the CLI via
+``example/MNIST/digits.conf`` (same MLP recipe as MNIST.conf) to the
+published error in README.md.
+
+Usage: python tools/make_digits_idx.py <outdir> [n_test]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def write_digits_idx(outdir: str, n_test: int = 297) -> None:
+    from sklearn.datasets import load_digits
+
+    from cxxnet_tpu.io.mnist import write_idx_images, write_idx_labels
+
+    d = load_digits()
+    # pixels are 0..16; idx stores uint8 and the reader scales by 1/256
+    imgs = np.clip(d.images * 16, 0, 255).astype(np.uint8)
+    labels = d.target.astype(np.uint8)
+    rng = np.random.RandomState(0)
+    perm = rng.permutation(len(labels))
+    imgs, labels = imgs[perm], labels[perm]
+    os.makedirs(outdir, exist_ok=True)
+    write_idx_images(
+        os.path.join(outdir, "train-images-idx3-ubyte"), imgs[n_test:]
+    )
+    write_idx_labels(
+        os.path.join(outdir, "train-labels-idx1-ubyte"), labels[n_test:]
+    )
+    write_idx_images(
+        os.path.join(outdir, "t10k-images-idx3-ubyte"), imgs[:n_test]
+    )
+    write_idx_labels(
+        os.path.join(outdir, "t10k-labels-idx1-ubyte"), labels[:n_test]
+    )
+    print(
+        f"wrote {len(labels) - n_test} train / {n_test} test real "
+        f"handwritten digits (8x8 idx) to {outdir}"
+    )
+
+
+if __name__ == "__main__":
+    write_digits_idx(
+        sys.argv[1] if len(sys.argv) > 1 else "example/MNIST/data",
+        int(sys.argv[2]) if len(sys.argv) > 2 else 297,
+    )
