@@ -218,8 +218,64 @@ def _maxpool_eq_bwd(kh, kw, s, py, px, res, g):
 _maxpool_eq.defvjp(_maxpool_eq_fwd, _maxpool_eq_bwd)
 
 
+_PALLAS_POOL_OK: dict = {}
+
+
+def _run_probe_untraced(fn) -> bool:
+    """Run a compile probe on a worker thread.
+
+    Probes fire while the net is being jit-traced (layer ``apply`` is
+    where the impl choice lives); JAX trace contexts are thread-local,
+    so a worker thread executes the probe eagerly — really compiling
+    and running the kernel — instead of tracing junk into the outer
+    program and failing spuriously (``block_until_ready`` on a tracer),
+    which would silently disable every Pallas kernel inside real nets.
+    """
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(1) as ex:
+        try:
+            ex.submit(fn).result(timeout=300)
+            return True
+        except Exception:  # pragma: no cover - backend-specific
+            return False
+
+
+def _pallas_pool_works(kh, kw, s, py, px, nchannel, dtype) -> bool:
+    """Compile probe so ``pool_impl=auto`` can never take down a run
+    (same discipline as the LRN kernel's probe): keyed on the full
+    static config + channel count + dtype, probing fwd AND bwd."""
+    key = (kh, kw, s, py, px, int(nchannel), jnp.dtype(dtype).name)
+    if key not in _PALLAS_POOL_OK:
+        from ..ops.maxpool import maxpool_fused
+
+        def probe():
+            v0 = jnp.ones((2, kh + s, kw + s, key[5]), dtype)
+            jax.grad(
+                lambda v: maxpool_fused(v, kh, kw, s, py, px)
+                .astype(jnp.float32).sum()
+            )(v0).block_until_ready()
+
+        _PALLAS_POOL_OK[key] = _run_probe_untraced(probe)
+    return _PALLAS_POOL_OK[key]
+
+
 class _PoolBase(Layer):
     """Shared ceil-shape pooling over NHWC (shifted-slice tree, see _pool)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.pool_impl = "auto"  # auto = XLA; pallas is explicit opt-in
+
+    def set_param(self, name, val):
+        if name == "pool_impl":
+            if val not in ("auto", "pallas", "xla"):
+                raise ValueError(
+                    f"pool_impl must be auto|pallas|xla, got {val!r}"
+                )
+            self.pool_impl = val
+        else:
+            super().set_param(name, val)
 
     def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
         self._check_arity(in_shapes, 1)
@@ -262,9 +318,47 @@ class _PoolBase(Layer):
             acc = sl if acc is None else reducer(acc, sl)
         return acc
 
-    def _max_pool(self, x: jnp.ndarray) -> jnp.ndarray:
-        """Max pooling with the unpool-equality backward (_maxpool_eq)."""
+    def _use_pallas(self, nchannel: int, dtype) -> bool:
+        """``pool_impl = pallas`` is explicit opt-in; ``auto`` never
+        chooses the kernel: it wins isolated microbenchmarks (2.39 vs
+        3.26 ms for the b128 inception pool, fwd+bwd) but embedding 9
+        pool kernels in the scanned train step regressed XLA compile
+        time pathologically on the v5e AOT runtime, and stride>1 needs
+        a strided slice Mosaic lowers as an unsupported gather
+        (doc/performance.md).  Opt-in still goes through the compile
+        probe on TPU so a bad geometry degrades to the XLA path with a
+        warning instead of taking down the run."""
+        if self.pool_impl != "pallas":
+            return False
+        if jax.default_backend() != "tpu":
+            return True  # interpret mode, works on any backend
         p = self.param
+        if _pallas_pool_works(p.kernel_height, p.kernel_width, p.stride,
+                              p.pad_y, p.pad_x, nchannel, dtype):
+            return True
+        import warnings
+
+        warnings.warn(
+            f"{self.type_name}: pool_impl=pallas requested but the kernel "
+            f"probe failed for k=({p.kernel_height},{p.kernel_width}) "
+            f"s={p.stride} C={nchannel}; using the XLA path"
+        )
+        return False
+
+    def _max_pool(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Max pooling with the unpool-equality backward: the XLA
+        expression (``_maxpool_eq``) by default, the fused Pallas
+        kernel (``ops/maxpool.py``) under ``pool_impl = pallas`` —
+        identical semantics, pair-tested."""
+        p = self.param
+        if self._use_pallas(x.shape[-1], x.dtype):
+            from ..ops.maxpool import maxpool_fused
+
+            interp = jax.default_backend() != "tpu"  # forced-on off-TPU
+            return maxpool_fused(
+                x, p.kernel_height, p.kernel_width, p.stride, p.pad_y,
+                p.pad_x, interp,
+            )
         return _maxpool_eq(
             x, p.kernel_height, p.kernel_width, p.stride, p.pad_y, p.pad_x
         )
@@ -359,14 +453,13 @@ def _pallas_lrn_works(nchannel: int, dtype) -> bool:
     """
     key = (int(nchannel), jnp.dtype(dtype).name)
     if key not in _PALLAS_LRN_OK:
-        try:
-            from ..ops.lrn import lrn
+        from ..ops.lrn import lrn
 
+        def probe():
             lrn(jnp.ones((8, key[0]), dtype), 5, 1e-4, 0.75, 1.0
                 ).block_until_ready()
-            _PALLAS_LRN_OK[key] = True
-        except Exception:  # pragma: no cover - backend-specific
-            _PALLAS_LRN_OK[key] = False
+
+        _PALLAS_LRN_OK[key] = _run_probe_untraced(probe)
     return _PALLAS_LRN_OK[key]
 
 
@@ -380,7 +473,7 @@ class LRNLayer(Layer):
         self.alpha = 0.001
         self.beta = 0.75
         self.knorm = 1.0
-        self.impl = "auto"  # auto: Pallas kernel on TPU, stock XLA elsewhere
+        self.impl = "auto"  # auto = XLA; pallas is explicit opt-in
 
     def set_param(self, name, val):
         if name == "local_size":
@@ -399,16 +492,27 @@ class LRNLayer(Layer):
             super().set_param(name, val)
 
     def _use_pallas(self, nchannel: int, dtype) -> bool:
-        if self.impl == "pallas":
+        """``lrn_impl = pallas`` is explicit opt-in.  ``auto`` stays on
+        the XLA path: embedding the kernel in the scanned GoogLeNet
+        train step regressed XLA compile from ~47s to >25min on the
+        v5e AOT runtime (same pathology as the pool kernel,
+        doc/performance.md), and the measured step-time difference
+        vs lrn_xla was ~0 — LRN is ~3.5ms of a 60ms step.  Opt-in
+        still goes through the compile probe on TPU so an unsupported
+        shape degrades to lrn_xla with a warning, not a crash."""
+        if self.impl != "pallas":
+            return False
+        if jax.default_backend() != "tpu":
+            return True  # interpret mode, works on any backend
+        if _pallas_lrn_works(nchannel, dtype):
             return True
-        if self.impl == "xla":
-            return False
-        try:
-            return jax.default_backend() == "tpu" and _pallas_lrn_works(
-                nchannel, dtype
-            )
-        except RuntimeError:
-            return False
+        import warnings
+
+        warnings.warn(
+            f"lrn: lrn_impl=pallas requested but the kernel probe failed "
+            f"for C={nchannel} {jnp.dtype(dtype).name}; using lrn_xla"
+        )
+        return False
 
     def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
         self._check_arity(in_shapes, 1)

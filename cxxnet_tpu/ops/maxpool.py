@@ -1,0 +1,147 @@
+"""Fused ceil-shape max pooling as Pallas kernels.
+
+Semantics parity: the reference pooling layer
+(``/root/reference/src/layer/pooling_layer-inl.hpp``) — ceil output
+shapes with partial edge windows, and the mshadow ``unpool`` backward
+(every input position equal to its window's max receives that window's
+gradient).  Identical math to the XLA expression in
+``layers/conv._maxpool_eq``; that path remains the golden model and the
+non-TPU fallback.
+
+Why a kernel: the XLA lowering of the k*k shifted-slice tree (forward)
+and the compare + interior-pad-expand chain (backward) materializes
+intermediates in HBM between fusions — measured ~17 ms/step across
+GoogLeNet b128's 13 pools even after the unpool-VJP rewrite
+(doc/performance.md).  Here each grid cell holds one batch row's whole
+spatial plane in VMEM and runs the entire tree register-resident: one
+HBM read of x (+ y, g for backward) and one write.
+
+Grid: ``(N,)`` — one image per cell; the largest GoogLeNet plane
+(112x112x64 bf16 + padded copy + output) is ~5 MB, well inside the
+~16 MB VMEM budget.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _geometry(h: int, w: int, kh: int, kw: int, s: int, py: int, px: int):
+    """Mirrors layers/conv._pool_geometry (kept import-cycle-free)."""
+    def ceil_shape(n, k, p):
+        if p == 0:
+            return min(n - k + s - 1, n - 1) // s + 1
+        out = (n + 2 * p - k + s - 1) // s + 1
+        if (out - 1) * s >= n + p:
+            out -= 1
+        return out
+
+    oh, ow = ceil_shape(h, kh, py), ceil_shape(w, kw, px)
+    prh = max(0, (oh - 1) * s + kh - h - py)
+    prw = max(0, (ow - 1) * s + kw - w - px)
+    return (py, prh), (px, prw), oh, ow
+
+
+def _pad_plane(xb, pads_h, pads_w, val):
+    return jnp.pad(
+        xb, ((0, 0), pads_h, pads_w, (0, 0)),
+        constant_values=xb.dtype.type(val),
+    )
+
+
+def _fwd_kernel(x_ref, o_ref, *, kh, kw, s, py, px):
+    xb = x_ref[:]
+    (plh, prh), (plw, prw), oh, ow = _geometry(
+        xb.shape[1], xb.shape[2], kh, kw, s, py, px
+    )
+    xp = _pad_plane(xb, (plh, prh), (plw, prw), -jnp.inf)
+    acc = None
+    for dy in range(kh):
+        for dx in range(kw):
+            sl = xp[:, dy:dy + (oh - 1) * s + 1:s,
+                    dx:dx + (ow - 1) * s + 1:s, :]
+            acc = sl if acc is None else lax.max(acc, sl)
+    o_ref[:] = acc
+
+
+def _bwd_kernel(x_ref, y_ref, g_ref, dx_ref, *, kh, kw, s, py, px):
+    xb = x_ref[:]
+    # equality compare in f32: Mosaic on v5e rejects bf16 cmpf, and the
+    # bf16->f32 cast is exact so the tie set is unchanged
+    y = y_ref[:].astype(jnp.float32)
+    g = g_ref[:]
+    h, w = xb.shape[1], xb.shape[2]
+    (plh, prh), (plw, prw), oh, ow = _geometry(h, w, kh, kw, s, py, px)
+    xp = _pad_plane(xb, (plh, prh), (plw, prw), -jnp.inf).astype(jnp.float32)
+    hp, wp = xp.shape[1], xp.shape[2]
+    zero = jnp.zeros((), g.dtype)
+    total = None
+    for dy in range(kh):
+        for dx in range(kw):
+            xw = xp[:, dy:dy + (oh - 1) * s + 1:s,
+                    dx:dx + (ow - 1) * s + 1:s, :]
+            contrib = jnp.where(xw == y, g, zero)
+            exp = lax.pad(
+                contrib, zero,
+                ((0, 0, 0),
+                 (dy, hp - (dy + (oh - 1) * s + 1), s - 1),
+                 (dx, wp - (dx + (ow - 1) * s + 1), s - 1),
+                 (0, 0, 0)),
+            )
+            total = exp if total is None else total + exp
+    dx_ref[:] = total[:, plh:plh + h, plw:plw + w, :]
+
+
+def _call(kernel, x_shape, out_shape, dtype, args, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = x_shape[0]
+
+    def spec(shape):
+        return pl.BlockSpec(
+            (1,) + tuple(shape[1:]),
+            lambda i: (i,) + (0,) * (len(shape) - 1),
+            memory_space=pltpu.VMEM,
+        )
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(out_shape, dtype),
+        grid=(n,),
+        in_specs=[spec(a.shape) for a in args],
+        out_specs=spec(out_shape),
+        interpret=interpret,
+    )(*args)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
+def maxpool_fused(x, kh: int, kw: int, s: int, py: int = 0, px: int = 0,
+                  interpret: bool = False):
+    """Pallas max pool over NHWC with the unpool-equality backward."""
+    _, _, oh, ow = _geometry(x.shape[1], x.shape[2], kh, kw, s, py, px)
+    kern = functools.partial(_fwd_kernel, kh=kh, kw=kw, s=s, py=py, px=px)
+    out_shape = (x.shape[0], oh, ow, x.shape[3])
+    return _call(kern, x.shape, out_shape, x.dtype, (x,), interpret)
+
+
+def _mp_fwd(x, kh, kw, s, py, px, interpret):
+    y = maxpool_fused(x, kh, kw, s, py, px, interpret)
+    return y, (x, y)
+
+
+def _mp_bwd(kh, kw, s, py, px, interpret, res, g):
+    x, y = res
+    kern = functools.partial(_bwd_kernel, kh=kh, kw=kw, s=s, py=py, px=px)
+    dx = _call(
+        kern, x.shape, x.shape, x.dtype, (x, y, g.astype(x.dtype)),
+        interpret,
+    )
+    return (dx,)
+
+
+maxpool_fused.defvjp(_mp_fwd, _mp_bwd)

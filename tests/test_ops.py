@@ -63,3 +63,53 @@ def test_lrn_layer_uses_xla_on_cpu(rng):
     lay.set_param("lrn_impl", "pallas")
     with pytest.raises(Exception):
         lay.set_param("lrn_impl", "bogus")
+
+
+# ---------------------------------------------------------------- maxpool
+from cxxnet_tpu.layers.conv import _maxpool_eq
+from cxxnet_tpu.ops.maxpool import maxpool_fused
+
+
+@pytest.mark.parametrize("hw,k,s,p", [
+    (12, 3, 2, 0), (8, 2, 2, 0), (9, 3, 3, 0), (8, 3, 1, 1), (7, 3, 2, 1),
+])
+def test_maxpool_pallas_matches_xla(rng, hw, k, s, p):
+    """Pallas kernel (interpret mode on CPU) == the XLA unpool-VJP
+    expression, forward and gradient, incl. tied maxima."""
+    x = rng.randn(3, hw, hw, 8).astype(np.float32)
+    x[:, : hw // 2] = np.maximum(x[:, : hw // 2], 0.0)  # force ties
+    xj = jnp.asarray(x)
+    want = _maxpool_eq(xj, k, k, s, p, p)
+    got = maxpool_fused(xj, k, k, s, p, p, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+    g = jnp.asarray(rng.randn(*want.shape).astype(np.float32))
+    gw = jax.grad(lambda v: (_maxpool_eq(v, k, k, s, p, p) * g).sum())(xj)
+    gg = jax.grad(
+        lambda v: (maxpool_fused(v, k, k, s, p, p, True) * g).sum()
+    )(xj)
+    np.testing.assert_allclose(np.asarray(gg), np.asarray(gw),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_maxpool_pallas_bf16(rng):
+    x = jnp.asarray(rng.randn(2, 8, 8, 16), jnp.bfloat16)
+    want = _maxpool_eq(x, 3, 3, 2, 0, 0)
+    got = maxpool_fused(x, 3, 3, 2, 0, 0, True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32)
+    )
+
+
+def test_pool_layer_uses_xla_on_cpu(rng):
+    from cxxnet_tpu.layers import create_layer
+
+    lay = create_layer("max_pooling")
+    lay.set_param("kernel_size", "2")
+    lay.set_param("stride", "2")
+    assert lay._use_pallas(8, jnp.float32) is False  # auto never picks pallas
+    lay.set_param("pool_impl", "pallas")
+    assert lay._use_pallas(8, jnp.float32) is True
+    with pytest.raises(ValueError):
+        lay.set_param("pool_impl", "bogus")
