@@ -43,6 +43,7 @@ class LearnTask:
         self.print_step = 100
         self.extract_node_name = ""
         self.output_format = 1
+        self.scan_steps = 1
         self.cfg: List[tuple] = []
 
     # ------------------------------------------------------------------
@@ -75,6 +76,8 @@ class LearnTask:
             self.extract_node_name = val
         elif name == "output_format":
             self.output_format = 1 if val == "txt" else 0
+        elif name == "scan_steps":
+            self.scan_steps = int(val)
         self.cfg.append((name, val))
 
     # ------------------------------------------------------------------
@@ -225,18 +228,65 @@ class LearnTask:
             self.net_trainer.start_round(self.start_counter)
             self.itr_train.before_first()
             timer.clear()
+            pending: List = []  # scan_steps>1: batches staged for ONE dispatch
+
+            def _flush_pending() -> None:
+                """Run staged batches as one device program (lax.scan over
+                the fused step) — amortizes per-dispatch host cost
+                exactly like bench.py (doc/performance.md)."""
+                nonlocal global_step
+                if not pending:
+                    return
+                tracer.step(global_step)
+                timer.start()
+                if len(pending) == 1:
+                    from .io.data import DataBatch as _DB
+
+                    self.net_trainer.update(
+                        _DB(data=pending[0][0], label=pending[0][1])
+                    )
+                else:
+                    import numpy as _np
+
+                    self.net_trainer.update_scan(
+                        _np.stack([d for d, _ in pending]),
+                        _np.stack([l for _, l in pending]),
+                    )
+                if not self.net_trainer.eval_train:
+                    # async dispatch: fence so the timer measures the
+                    # step, not the enqueue (eval_train's metric fetch
+                    # already synchronizes)
+                    self.net_trainer.sync()
+                timer.stop(n_steps=len(pending))
+                global_step += len(pending)
+                pending.clear()
+
+            scan_ok = (
+                self.scan_steps > 1
+                and self.net_trainer.update_period == 1
+                and not self.net_trainer._n_extras()
+            )
             while self.itr_train.next():
                 if self.test_io == 0:
-                    tracer.step(global_step)
-                    timer.start()
-                    self.net_trainer.update(self.itr_train.value())
-                    if not self.net_trainer.eval_train:
-                        # async dispatch: fence so the timer measures the
-                        # step, not the enqueue (eval_train's metric fetch
-                        # already synchronizes)
-                        self.net_trainer.sync()
-                    timer.stop()
-                    global_step += 1
+                    batch = self.itr_train.value()
+                    if scan_ok and not batch.num_batch_padd:
+                        import numpy as _np
+
+                        # copy: iterator buffers are reused by next()
+                        pending.append(
+                            (_np.array(batch.data), _np.array(batch.label))
+                        )
+                        if len(pending) >= self.scan_steps:
+                            _flush_pending()
+                    else:
+                        _flush_pending()  # keep update order
+                        tracer.step(global_step)
+                        timer.start()
+                        self.net_trainer.update(batch)
+                        if not self.net_trainer.eval_train:
+                            self.net_trainer.sync()
+                        timer.stop()
+                        global_step += 1
                 sample_counter += 1
                 if (self.print_step > 0 and sample_counter % self.print_step == 0
                         and not self.silent):
@@ -246,6 +296,7 @@ class LearnTask:
                         f"[{sample_counter:8d}] {elapsed} sec elapsed",
                         flush=True,
                     )
+            _flush_pending()  # tail chunk shorter than scan_steps
             if self.test_io == 0:
                 if not self.silent and timer.count:
                     print(

@@ -32,9 +32,13 @@ class StepTimer:
     def start(self) -> None:
         self._t0 = time.perf_counter()
 
-    def stop(self) -> None:
+    def stop(self, n_steps: int = 1) -> None:
+        """``n_steps > 1``: the timed span covered a multi-step device
+        program (update_scan); record the per-step average so the round
+        statistics stay per-step comparable."""
         if self._t0 is not None:
-            self._times.append(time.perf_counter() - self._t0)
+            dt = (time.perf_counter() - self._t0) / max(1, n_steps)
+            self._times.extend([dt] * max(1, n_steps))
             self._t0 = None
 
     def clear(self) -> None:

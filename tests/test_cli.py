@@ -351,3 +351,20 @@ def test_bowl_genlist_and_split(tmp_path):
     ])
     assert len(open(tmp_path / "tr.lst").readlines()) == 3
     assert len(open(tmp_path / "va.lst").readlines()) == 2
+
+
+def test_scan_steps_trains_identically(tmp_path):
+    """scan_steps=k (CLI staging k batches into ONE update_scan dispatch)
+    must produce the same eval trajectory as per-batch updates."""
+    conf = make_conf(tmp_path, num_round=3)
+    r1 = run_cli([conf, "eval_train=0"], str(tmp_path))
+    assert r1.returncode == 0, r1.stderr
+    lines1 = [l for l in r1.stderr.splitlines() if l.startswith("[")]
+
+    import shutil
+
+    shutil.rmtree(tmp_path / "models")
+    r2 = run_cli([conf, "eval_train=0", "scan_steps=4"], str(tmp_path))
+    assert r2.returncode == 0, r2.stderr
+    lines2 = [l for l in r2.stderr.splitlines() if l.startswith("[")]
+    assert lines1 == lines2, (lines1, lines2)
