@@ -1,0 +1,69 @@
+"""Python-API walkthrough: train the MNIST-recipe MLP without a .conf
+task driver — the analog of the reference's wrapper example
+(``/root/reference/example/MNIST/mnist.py``), updated for this
+framework's packaging and the zero-egress digits data
+(``./run.sh digits.conf`` generates ``data/`` first, or point the
+paths at real MNIST ubyte files).
+"""
+
+import numpy as np
+
+from cxxnet_tpu import DataIter, Net, train
+
+ITER_TMPL = """
+iter = mnist
+    path_img = "./data/{img}"
+    path_label = "./data/{lab}"
+    {extra}
+iter = end
+input_shape = 1,1,64
+batch_size = 50
+"""
+
+NET_CFG = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 100
+  init_sigma = 0.01
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.01
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,64
+batch_size = 50
+eta = 0.1
+momentum = 0.9
+metric = error
+dev = cpu
+"""
+
+
+def main() -> None:
+    data = DataIter(ITER_TMPL.format(
+        img="train-images-idx3-ubyte", lab="train-labels-idx1-ubyte",
+        extra="shuffle = 1",
+    ))
+    deval = DataIter(ITER_TMPL.format(
+        img="t10k-images-idx3-ubyte", lab="t10k-labels-idx1-ubyte",
+        extra="",
+    ))
+    net = train(NET_CFG, data, num_round=15, param={}, eval_data=deval)
+
+    # numpy-in / numpy-out prediction on the first eval batch
+    deval.before_first()
+    deval.next()
+    batch = deval.value()
+    pred = net.predict(np.asarray(batch.data))
+    err = float((pred != batch.label[:, 0]).mean())
+    print(f"first-batch error: {err:.3f}")
+
+    # weight access through the 2-D visitor view
+    w = net.get_weight("fc1", "wmat")
+    print(f"fc1 wmat: {w.shape}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Fetch-or-generate the digit data, then train from a conf.
+#   ./run.sh MNIST.conf        # needs the MNIST ubyte files (downloads)
+#   ./run.sh digits.conf       # zero-egress: real UCI digits, generated
+set -e
+cd "$(dirname "$0")"
+
+mkdir -p data models
+
+if [ "$1" = "digits.conf" ]; then
+    # real handwritten digits bundled with scikit-learn, idx-encoded
+    python ../../tools/make_digits_idx.py data
+else
+    for f in train-images-idx3-ubyte train-labels-idx1-ubyte \
+             t10k-images-idx3-ubyte t10k-labels-idx1-ubyte; do
+        if [ ! -f "data/$f" ]; then
+            wget -O - "https://ossci-datasets.s3.amazonaws.com/mnist/$f.gz" \
+                | gzip -d > "data/$f"
+        fi
+    done
+fi
+
+python -m cxxnet_tpu "${1:-MNIST.conf}" "${@:2}"

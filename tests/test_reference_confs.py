@@ -55,3 +55,36 @@ def test_reference_conf_builds_net(rel, nclass):
     tr.init_model()
     out = tr.net.node_shapes[tr.net.out_node_index()]
     assert out[-1] == nclass, f"{rel}: output {out}"
+
+
+REPO_EXAMPLES = [
+    ("MNIST/MNIST.conf", 10),
+    ("MNIST/MNIST_CONV.conf", 10),
+    ("MNIST/digits.conf", 10),
+    ("MNIST/dist.conf", 10),
+    ("ImageNet/alexnet.conf", 1000),
+    ("ImageNet/googlenet.conf", 1000),
+    ("ImageNet/vgg16.conf", 1000),
+    ("kaggle_bowl/bowl.conf", 121),
+]
+
+
+@pytest.mark.parametrize("rel,nclass", REPO_EXAMPLES)
+def test_repo_example_conf_builds_net(rel, nclass):
+    """This repo's shipped example confs stay buildable with correct
+    output class counts (the dist.conf case strips the distributed
+    launch keys — joining a job needs real peers)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "example", rel)
+    cfg = [
+        (k, v)
+        for k, v in C.split_sections(C.parse_file(path)).global_entries
+        if not k.startswith("dist_")
+    ]
+    tr = NetTrainer()
+    tr.set_params(cfg)
+    tr.set_param("dev", "cpu")
+    tr.set_param("batch_size", "4")
+    tr.init_model()
+    out = tr.net.node_shapes[tr.net.out_node_index()]
+    assert out[-1] == nclass, f"{rel}: output {out}"
