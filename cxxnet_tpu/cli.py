@@ -193,10 +193,28 @@ class LearnTask:
                     if self.itr_pred is not None:
                         raise ValueError("can only have one pred section")
                     self.itr_pred = create_iterator(sec.entries)
+        from .parallel.distributed import process_info
+
+        pid, nproc = process_info()
         for it in [self.itr_train, self.itr_pred, *self.itr_evals]:
             if it is not None:
                 for n, v in split.global_entries:
                     it.set_param(n, v)
+                if nproc > 1 and it is self.itr_train:
+                    # multi-process contract (trainer._pad_train_batch):
+                    # each process feeds batch_size/nproc LOCAL rows of
+                    # its own data shard; batch_size in the conf is
+                    # GLOBAL.  Shard + shrink the train iterator here so
+                    # dist confs run unchanged on any process count.
+                    gbs = self.net_trainer.batch_size
+                    if gbs % nproc != 0:
+                        raise ValueError(
+                            f"batch_size={gbs} must divide by the "
+                            f"process count ({nproc})"
+                        )
+                    it.set_param("batch_size", str(gbs // nproc))
+                    it.set_param("dist_num_worker", str(nproc))
+                    it.set_param("dist_worker_rank", str(pid))
                 it.init()
 
     # ------------------------------------------------------------------
@@ -261,10 +279,13 @@ class LearnTask:
                 global_step += len(pending)
                 pending.clear()
 
+            import jax as _jax
+
             scan_ok = (
                 self.scan_steps > 1
                 and self.net_trainer.update_period == 1
                 and not self.net_trainer._n_extras()
+                and _jax.process_count() == 1  # update_scan is 1-process
             )
             while self.itr_train.next():
                 if self.test_io == 0:

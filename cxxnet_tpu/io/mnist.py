@@ -53,6 +53,8 @@ class MNISTIterator(DataIter):
         self.path_img = ""
         self.path_label = ""
         self.seed = 0
+        self.dist_num_worker = 1
+        self.dist_worker_rank = 0
         self._loc = 0
         self._img: np.ndarray | None = None
         self._label: np.ndarray | None = None
@@ -75,6 +77,10 @@ class MNISTIterator(DataIter):
             self.path_label = val
         elif name == "seed_data":
             self.seed = int(val)
+        elif name == "dist_num_worker":
+            self.dist_num_worker = int(val)
+        elif name == "dist_worker_rank":
+            self.dist_worker_rank = int(val)
 
     def init(self):
         imgs = read_idx_images(self.path_img).astype(np.float32) / 256.0
@@ -86,6 +92,12 @@ class MNISTIterator(DataIter):
             rng = np.random.RandomState(42 + self.seed)
             perm = rng.permutation(len(labels))
             imgs, labels, inst = imgs[perm], labels[perm], inst[perm]
+        if self.dist_num_worker > 1:
+            # distributed data sharding: worker k reads rows k::n (the
+            # imgbin iterator's per-worker shard discipline, after the
+            # deterministic shuffle so shards are disjoint AND mixed)
+            sl = slice(self.dist_worker_rank, None, self.dist_num_worker)
+            imgs, labels, inst = imgs[sl], labels[sl], inst[sl]
         if self.input_flat:
             self._img = imgs.reshape(len(labels), -1)
         else:

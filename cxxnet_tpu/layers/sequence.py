@@ -10,10 +10,18 @@ layout, NHWC-style batch-major nodes).  Sequence nodes are ``(N, T, D)``
 
 * ``nhead`` — number of attention heads (D % nhead == 0)
 * ``causal`` — 1 for autoregressive masking
-* ``seq_parallel`` — 1 to run **ring attention** over the mesh's
-  ``model`` axis (sequence sharded, kv blocks rotating over ICI —
-  ``ops/attention.py``); requires T % model_axis == 0. Off the mesh (or
-  model axis 1) it falls back to plain attention.
+* ``seq_parallel`` — sequence/context parallelism over the mesh's
+  ``model`` axis (``ops/attention.py``; off the mesh, or with a model
+  axis of 1, both fall back to plain attention):
+  * ``1`` / ``ring`` — **ring attention**: sequence sharded, kv blocks
+    rotate over ICI with a streaming-softmax merge; needs
+    T % model_axis == 0.  Scales to any T (never materializes full-T
+    scores) and any head count.
+  * ``2`` / ``alltoall`` — **Ulysses all-to-all**: two all_to_alls swap
+    the sequence sharding for a head sharding, full-sequence attention
+    per head subset; needs T % model_axis == 0 AND
+    nhead % model_axis == 0.  Two activation collectives vs the ring's
+    n kv hops — usually cheaper when heads divide the axis.
 """
 
 from __future__ import annotations
@@ -48,13 +56,21 @@ class AttentionLayer(Layer):
         self.seq_parallel = 0
         self.mesh_plan = None  # bound by the trainer (bind_mesh)
 
+    _SP_MODES = {"0": 0, "1": 1, "2": 2, "off": 0, "ring": 1,
+                 "alltoall": 2, "a2a": 2}
+
     def set_param(self, name, val):
         if name == "nhead":
             self.nhead = int(val)
         elif name == "causal":
             self.causal = int(val)
         elif name == "seq_parallel":
-            self.seq_parallel = int(val)
+            if val not in self._SP_MODES:
+                raise ValueError(
+                    f"seq_parallel must be one of {sorted(self._SP_MODES)},"
+                    f" got {val!r}"
+                )
+            self.seq_parallel = self._SP_MODES[val]
         else:
             super().set_param(name, val)
 
@@ -80,6 +96,11 @@ class AttentionLayer(Layer):
                 raise ValueError(
                     f"attention: seq_parallel needs T={t} divisible by the "
                     f"model axis ({nm})"
+                )
+            if nm > 1 and self.seq_parallel == 2 and self.nhead % nm != 0:
+                raise ValueError(
+                    f"attention: seq_parallel=alltoall needs "
+                    f"nhead={self.nhead} divisible by the model axis ({nm})"
                 )
         return [tuple(shape)]
 
@@ -110,9 +131,16 @@ class AttentionLayer(Layer):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         plan = self.mesh_plan
         if self.seq_parallel and plan is not None and plan.n_model > 1:
-            o = ring_self_attention(
-                q, k, v, plan.mesh, "model", causal=bool(self.causal)
-            )
+            if self.seq_parallel == 2:
+                from ..ops.attention import a2a_self_attention
+
+                o = a2a_self_attention(
+                    q, k, v, plan.mesh, "model", causal=bool(self.causal)
+                )
+            else:
+                o = ring_self_attention(
+                    q, k, v, plan.mesh, "model", causal=bool(self.causal)
+                )
         else:
             o = mha(q, k, v, causal=bool(self.causal))
         o = o.reshape(n, t, d)

@@ -150,3 +150,62 @@ def ring_self_attention(
         mesh, (spec, spec, spec), spec,
     )
     return fn(x_q, x_k, x_v)
+
+
+def a2a_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Ulysses-style all-to-all sequence parallelism.
+
+    Call under ``shard_map`` with q/k/v time-sharded on ``axis_name``
+    ((B, T/n, H, Dh) blocks).  Two ``lax.all_to_all`` re-shardings swap
+    the sequence sharding for a head sharding: each device then runs
+    *full-sequence* attention over H/n heads, so the math inside is
+    exactly ``mha`` (no streaming softmax needed).  Communication is two
+    all-to-alls of the activations vs the ring's n ppermute hops of
+    k/v — better when heads divide the axis and T is large; the ring
+    wins when H < n or memory for the full T scores is tight.
+    """
+    n = lax.psum(1, axis_name)
+    del n  # static under shard_map; kept for symmetry/documentation
+
+    def swap(x, fwd: bool):
+        # fwd: (B, T/n, H, Dh) -> (B, T, H/n, Dh); tiled all_to_all
+        # splits split_axis n ways and concatenates along concat_axis
+        return lax.all_to_all(
+            x, axis_name,
+            split_axis=2 if fwd else 1,
+            concat_axis=1 if fwd else 2,
+            tiled=True,
+        )
+
+    o = mha(swap(q, True), swap(k, True), swap(v, True), causal=causal)
+    return swap(o, False)
+
+
+def a2a_self_attention(
+    x_q: jnp.ndarray,
+    x_k: jnp.ndarray,
+    x_v: jnp.ndarray,
+    mesh,
+    seq_axis: str = "model",
+    *,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """shard_map wrapper mirroring ``ring_self_attention`` — same global
+    (B,T,H,Dh) contract, all-to-all schedule inside."""
+    from jax.sharding import PartitionSpec as P
+
+    from ._compat import shard_map_nocheck
+
+    spec = P("data", seq_axis, None, None)
+    fn = shard_map_nocheck(
+        functools.partial(a2a_attention, axis_name=seq_axis, causal=causal),
+        mesh, (spec, spec, spec), spec,
+    )
+    return fn(x_q, x_k, x_v)

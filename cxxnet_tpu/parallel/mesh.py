@@ -149,13 +149,20 @@ def make_mesh(
             pool = jax.devices(plat)
         except RuntimeError:
             pool = jax.devices()
-        try:
-            devices = [pool[i] for i in ids]
-        except IndexError:
-            raise ValueError(
-                f"dev={dev!r} requests device ordinals {ids} but only "
-                f"{len(pool)} devices are available"
-            ) from None
+        if ":" not in dev.strip() and jax.process_count() > 1:
+            # multi-process job, bare platform word: the mesh spans ALL
+            # global devices (each process contributes its local chips —
+            # the multi-host semantic; explicit ordinals remain global
+            # indices for expert layouts)
+            devices = list(pool)
+        else:
+            try:
+                devices = [pool[i] for i in ids]
+            except IndexError:
+                raise ValueError(
+                    f"dev={dev!r} requests device ordinals {ids} but only "
+                    f"{len(pool)} devices are available"
+                ) from None
     devices = list(devices)
     n = len(devices)
     if model_parallel < 1 or n % model_parallel != 0:

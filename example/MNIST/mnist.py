@@ -2,13 +2,18 @@
 task driver — the analog of the reference's wrapper example
 (``/root/reference/example/MNIST/mnist.py``), updated for this
 framework's packaging and the zero-egress digits data
-(``./run.sh digits.conf`` generates ``data/`` first, or point the
-paths at real MNIST ubyte files).
+(``./run.sh digits.conf`` generates ``data/`` first).  For real 28x28
+MNIST ubyte files set ``MNIST_DIM=784`` (the pixel count flows into
+``input_shape``).
 """
+
+import os
 
 import numpy as np
 
-from cxxnet_tpu import DataIter, Net, train
+from cxxnet_tpu import DataIter, train
+
+DIM = int(os.environ.get("MNIST_DIM", "64"))  # 64 = 8x8 digits, 784 = MNIST
 
 ITER_TMPL = """
 iter = mnist
@@ -16,7 +21,7 @@ iter = mnist
     path_label = "./data/{lab}"
     {extra}
 iter = end
-input_shape = 1,1,64
+input_shape = 1,1,{dim}
 batch_size = 50
 """
 
@@ -32,7 +37,7 @@ layer[sg1->fc2] = fullc:fc2
 layer[+0] = softmax
 netconfig=end
 
-input_shape = 1,1,64
+input_shape = 1,1,{dim}
 batch_size = 50
 eta = 0.1
 momentum = 0.9
@@ -44,13 +49,14 @@ dev = cpu
 def main() -> None:
     data = DataIter(ITER_TMPL.format(
         img="train-images-idx3-ubyte", lab="train-labels-idx1-ubyte",
-        extra="shuffle = 1",
+        extra="shuffle = 1", dim=DIM,
     ))
     deval = DataIter(ITER_TMPL.format(
         img="t10k-images-idx3-ubyte", lab="t10k-labels-idx1-ubyte",
-        extra="",
+        extra="", dim=DIM,
     ))
-    net = train(NET_CFG, data, num_round=15, param={}, eval_data=deval)
+    net = train(NET_CFG.format(dim=DIM), data, num_round=15, param={},
+                eval_data=deval)
 
     # numpy-in / numpy-out prediction on the first eval batch
     deval.before_first()

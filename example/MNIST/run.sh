@@ -2,7 +2,7 @@
 # Fetch-or-generate the digit data, then train from a conf.
 #   ./run.sh MNIST.conf        # needs the MNIST ubyte files (downloads)
 #   ./run.sh digits.conf       # zero-egress: real UCI digits, generated
-set -e
+set -eo pipefail
 cd "$(dirname "$0")"
 
 mkdir -p data models
@@ -14,8 +14,11 @@ else
     for f in train-images-idx3-ubyte train-labels-idx1-ubyte \
              t10k-images-idx3-ubyte t10k-labels-idx1-ubyte; do
         if [ ! -f "data/$f" ]; then
+            # download to a temp name so an interrupted transfer never
+            # leaves a truncated file the -f guard would then skip
             wget -O - "https://ossci-datasets.s3.amazonaws.com/mnist/$f.gz" \
-                | gzip -d > "data/$f"
+                | gzip -d > "data/$f.tmp"
+            mv "data/$f.tmp" "data/$f"
         fi
     done
 fi

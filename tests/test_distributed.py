@@ -104,3 +104,68 @@ def test_two_process_training_weights_identical(tmp_path):
     np.testing.assert_allclose(w0, w1, rtol=0, atol=0)
     # and training actually moved the weights
     assert np.abs(w0).max() > 0
+
+
+@pytest.mark.slow
+def test_two_process_cli_dist_conf(tmp_path):
+    """The dist.conf launch procedure end-to-end: 2 CLI processes share
+    one conf with a GLOBAL batch_size; the driver shards the mnist
+    iterator (disjoint rows) and shrinks each process's local batch, and
+    both processes save identical checkpoints."""
+    from cxxnet_tpu.io.mnist import write_idx_images, write_idx_labels
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (128, 4, 4)).astype(np.uint8)
+    labels = (imgs.reshape(128, -1).mean(1) > 127).astype(np.uint8)
+    write_idx_images(str(tmp_path / "img.idx"), imgs)
+    write_idx_labels(str(tmp_path / "lab.idx"), labels)
+    port = _free_port()
+    conf = tmp_path / "dist.conf"
+    conf.write_text(f"""
+dist_num_proc = 2
+data = train
+iter = mnist
+  path_img = "{tmp_path}/img.idx"
+  path_label = "{tmp_path}/lab.idx"
+  shuffle = 1
+iter = end
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.1
+layer[fc1->out] = fullc:fc2
+  nhidden = 2
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 32
+dev = cpu
+num_round = 2
+eval_train = 0
+eta = 0.1
+metric = error
+silent = 1
+""")
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    }
+    procs = []
+    for r in range(2):
+        d = tmp_path / f"p{r}"
+        d.mkdir()
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "cxxnet_tpu", str(conf),
+             f"dist_coordinator=localhost:{port}", f"dist_proc_id={r}"],
+            env=env, cwd=str(d),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        ))
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, o.decode()
+    m0 = (tmp_path / "p0" / "models" / "0002.model").read_bytes()
+    m1 = (tmp_path / "p1" / "models" / "0002.model").read_bytes()
+    assert m0 == m1  # same weights on every process

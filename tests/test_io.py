@@ -329,3 +329,30 @@ def test_imgbin_partition_maker(tmp_path):
         for page in iter_bin_pages(bin_path):
             total += len(page)
     assert total == 6  # every image landed in some shard
+
+
+def test_mnist_iterator_dist_sharding(tmp_path):
+    """Worker k of n reads disjoint rows k::n (imgbin discipline); the
+    shards cover the dataset exactly once."""
+    from cxxnet_tpu.io.mnist import (MNISTIterator, write_idx_images,
+                                     write_idx_labels)
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (40, 4, 4)).astype(np.uint8)
+    labels = np.arange(40).astype(np.uint8) % 10
+    write_idx_images(str(tmp_path / "img.idx"), imgs)
+    write_idx_labels(str(tmp_path / "lab.idx"), labels)
+
+    seen = []
+    for rank in range(2):
+        it = MNISTIterator()
+        it.set_param("path_img", str(tmp_path / "img.idx"))
+        it.set_param("path_label", str(tmp_path / "lab.idx"))
+        it.set_param("batch_size", "10")
+        it.set_param("silent", "1")
+        it.set_param("dist_num_worker", "2")
+        it.set_param("dist_worker_rank", str(rank))
+        it.init()
+        while it.next():
+            seen.extend(it.value().label[:, 0].tolist())
+    assert sorted(seen) == sorted(labels.tolist())
