@@ -212,6 +212,14 @@ class LearnTask:
                             f"batch_size={gbs} must divide by the "
                             f"process count ({nproc})"
                         )
+                    if not it.supports_dist_shard():
+                        raise ValueError(
+                            "multi-process training needs a train "
+                            "iterator that honors dist_num_worker "
+                            "(mnist/imgbin/img/csv/synthetic); this "
+                            "chain would silently feed every process "
+                            "identical data"
+                        )
                     it.set_param("batch_size", str(gbs // nproc))
                     it.set_param("dist_num_worker", str(nproc))
                     it.set_param("dist_worker_rank", str(pid))

@@ -23,6 +23,9 @@ class AttachTxtIterator(DataIter):
         self._width = 0
         self._cur: Optional[DataBatch] = None
 
+    def supports_dist_shard(self) -> bool:
+        return self.base.supports_dist_shard()
+
     def set_param(self, name, val):
         self.base.set_param(name, val)
         if name in ("attach_file", "filename"):

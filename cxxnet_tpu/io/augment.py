@@ -28,6 +28,9 @@ _RAND_MAGIC = 111
 
 
 class AugmentIterator(InstIterator):
+    def supports_dist_shard(self) -> bool:
+        return self.base.supports_dist_shard()
+
     def __init__(self, base: InstIterator) -> None:
         self.base = base
         self.shape = (0, 0, 0)           # (C,H,W) net convention

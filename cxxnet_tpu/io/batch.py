@@ -39,6 +39,9 @@ class DataInst:
 class InstIterator:
     """Instance-level iterator protocol (``IIterator<DataInst>``)."""
 
+    def supports_dist_shard(self) -> bool:
+        return False
+
     def set_param(self, name: str, val: str) -> None:
         pass
 
@@ -67,6 +70,9 @@ class BatchAdaptIterator(DataIter):
         self._num_overflow = 0
         self._head = 1
         self._out: Optional[DataBatch] = None
+
+    def supports_dist_shard(self) -> bool:
+        return self.base.supports_dist_shard()
 
     def set_param(self, name, val):
         self.base.set_param(name, val)

@@ -13,8 +13,13 @@ from .batch import DataInst, InstIterator
 
 
 class CSVIterator(InstIterator):
+    def supports_dist_shard(self) -> bool:
+        return True
+
     def __init__(self) -> None:
         self.filename = ""
+        self.dist_num_worker = 1
+        self.dist_worker_rank = 0
         self.label_width = 1
         self.has_header = 0
         self.silent = 0
@@ -34,6 +39,10 @@ class CSVIterator(InstIterator):
         elif name == "input_shape":
             c, h, w = (int(t) for t in val.split(","))
             self.input_shape = (c, h, w)
+        elif name == "dist_num_worker":
+            self.dist_num_worker = int(val)
+        elif name == "dist_worker_rank":
+            self.dist_worker_rank = int(val)
 
     def init(self):
         nfeat = self.input_shape[0] * self.input_shape[1] * self.input_shape[2]
@@ -52,6 +61,12 @@ class CSVIterator(InstIterator):
                 f"CSVIterator: row has {rows.shape[1]} columns, expected "
                 f"{want} (label_width + input size)"
             )
+        if self.dist_num_worker > 1:
+            from .data import shard_rows
+
+            rows = rows[shard_rows(
+                len(rows), self.dist_worker_rank, self.dist_num_worker
+            )]
         self._rows = rows
         if not self.silent:
             print(f"CSVIterator: filename={self.filename}, {len(rows)} rows")

@@ -38,8 +38,35 @@ class DataBatch:
         return self.data.shape[0]
 
 
+def shard_rows(n_rows: int, rank: int, nworker: int):
+    """Equal-length row shard for distributed data parallelism.
+
+    Worker ``rank`` takes rows ``rank::nworker`` truncated to
+    ``n_rows // nworker``: shards are disjoint AND the same length, so
+    every process runs the same number of batches per round.  Unequal
+    shards (plain ``k::n`` slicing) deadlock the SPMD train loop — the
+    process with one extra batch issues a collective the others never
+    join.  Returns an index array.
+    """
+    import numpy as _np
+
+    per = n_rows // nworker
+    if per == 0:
+        raise ValueError(
+            f"cannot shard {n_rows} rows over {nworker} workers"
+        )
+    return _np.arange(rank, n_rows, nworker)[:per]
+
+
 class DataIter:
     """Iterator protocol (parity: ``IIterator``, data.h:19-39)."""
+
+    #: True for source iterators that honor ``dist_num_worker`` /
+    #: ``dist_worker_rank`` (wrappers delegate).  The CLI refuses to
+    #: run multi-process with a train iterator that would silently feed
+    #: every process identical data.
+    def supports_dist_shard(self) -> bool:
+        return False
 
     def set_param(self, name: str, val: str) -> None:  # noqa: D401
         pass

@@ -16,8 +16,13 @@ from .imgbin import parse_lst_line
 
 
 class ImageIterator(InstIterator):
+    def supports_dist_shard(self) -> bool:
+        return True
+
     def __init__(self) -> None:
         self.image_list = ""
+        self.dist_num_worker = 1
+        self.dist_worker_rank = 0
         self.image_root = ""
         self.silent = 0
         self._recs: List[Tuple[int, np.ndarray, str]] = []
@@ -31,12 +36,23 @@ class ImageIterator(InstIterator):
             self.image_root = val
         elif name == "silent":
             self.silent = int(val)
+        elif name == "dist_num_worker":
+            self.dist_num_worker = int(val)
+        elif name == "dist_worker_rank":
+            self.dist_worker_rank = int(val)
 
     def init(self):
         if not self.image_list:
             raise ValueError("ImageIterator: must set image_list")
         with open(self.image_list, "r", encoding="utf-8") as f:
             self._recs = [parse_lst_line(l) for l in f if l.strip()]
+        if self.dist_num_worker > 1:
+            from .data import shard_rows
+
+            keep = shard_rows(
+                len(self._recs), self.dist_worker_rank, self.dist_num_worker
+            )
+            self._recs = [self._recs[i] for i in keep]
         if not self.silent:
             print(f"ImageIterator: {len(self._recs)} images from {self.image_list}")
 

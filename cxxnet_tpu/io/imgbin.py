@@ -100,8 +100,21 @@ def decode_image(blob: bytes) -> np.ndarray:
     return np.asarray(img, np.float32)
 
 
+def _count_lst_rows(lst_path: str) -> int:
+    """Row count of a .lst label file (cheap: line count)."""
+    n = 0
+    with open(lst_path, "r", encoding="utf-8") as f:
+        for line in f:
+            if line.strip():
+                n += 1
+    return n
+
+
 class ImageBinIterator(InstIterator):
     """Instance iterator over one or more page shards + .lst label files."""
+
+    def supports_dist_shard(self) -> bool:
+        return True
 
     def __init__(self) -> None:
         self.image_bin: List[str] = []
@@ -124,6 +137,8 @@ class ImageBinIterator(InstIterator):
         self._native = None  # NativePageReader
         self._native_labels: List[Tuple[int, np.ndarray]] = []
         self._native_pos = 0
+        self._epoch_cap = 0
+        self._served = 0
 
     def set_param(self, name, val):
         if name in ("image_bin", "image_bin_x"):
@@ -155,12 +170,30 @@ class ImageBinIterator(InstIterator):
         if not self.image_bin:
             raise ValueError("imgbin: must set image_bin and image_list")
         shards = list(zip(self.image_bin, self.image_list))
+        self._epoch_cap = 0
         if self.dist_num_worker > 1:
-            shards = [
+            if len(shards) < self.dist_num_worker:
+                raise ValueError(
+                    f"imgbin: {len(shards)} shard file(s) cannot feed "
+                    f"{self.dist_num_worker} workers distinct data — "
+                    "repack with tools/imgbin_partition_maker.py "
+                    "(>= one shard per worker)"
+                )
+            mine = [
                 s
                 for i, s in enumerate(shards)
                 if i % self.dist_num_worker == self.dist_worker_rank
-            ] or shards  # fewer shards than workers: everyone reads all
+            ]
+            # equal-steps contract (io/data.shard_rows): every process
+            # must run the same batch count per round or the SPMD train
+            # loop deadlocks.  All .lst files are in the conf, so each
+            # worker can count every worker's rows and cap its own epoch
+            # at the global minimum.
+            per_worker = [0] * self.dist_num_worker
+            for i, (_, lst) in enumerate(shards):
+                per_worker[i % self.dist_num_worker] += _count_lst_rows(lst)
+            self._epoch_cap = min(per_worker)
+            shards = mine
         self._shards = shards
         if self.native_decoder and not self._raw:
             try:
@@ -192,6 +225,7 @@ class ImageBinIterator(InstIterator):
         return out
 
     def before_first(self):
+        self._served = 0
         if self._native is not None:
             self._native.reset()
             self._native_pos = 0
@@ -209,6 +243,14 @@ class ImageBinIterator(InstIterator):
             self._page_iter = None
 
     def next(self) -> bool:
+        if self._epoch_cap and self._served >= self._epoch_cap:
+            return False
+        if not self._next_inner():
+            return False
+        self._served += 1
+        return True
+
+    def _next_inner(self) -> bool:
         if self._native is not None:
             rec = self._native.next()
             if rec is None:

@@ -21,6 +21,9 @@ class MemBufferIterator(DataIter):
         self._filled = False
         self._pos = 0
 
+    def supports_dist_shard(self) -> bool:
+        return self.base.supports_dist_shard()
+
     def set_param(self, name, val):
         self.base.set_param(name, val)
         if name == "max_nbatch":

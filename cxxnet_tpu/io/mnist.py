@@ -44,6 +44,9 @@ def write_idx_labels(path: str, labels: np.ndarray) -> None:
 
 
 class MNISTIterator(DataIter):
+    def supports_dist_shard(self) -> bool:
+        return True
+
     def __init__(self) -> None:
         self.batch_size = 0
         self.input_flat = 1
@@ -93,10 +96,14 @@ class MNISTIterator(DataIter):
             perm = rng.permutation(len(labels))
             imgs, labels, inst = imgs[perm], labels[perm], inst[perm]
         if self.dist_num_worker > 1:
-            # distributed data sharding: worker k reads rows k::n (the
-            # imgbin iterator's per-worker shard discipline, after the
-            # deterministic shuffle so shards are disjoint AND mixed)
-            sl = slice(self.dist_worker_rank, None, self.dist_num_worker)
+            # distributed data sharding after the deterministic shuffle
+            # so shards are disjoint AND mixed; equal-truncated so every
+            # worker runs the same batch count (see data.shard_rows)
+            from .data import shard_rows
+
+            sl = shard_rows(
+                len(labels), self.dist_worker_rank, self.dist_num_worker
+            )
             imgs, labels, inst = imgs[sl], labels[sl], inst[sl]
         if self.input_flat:
             self._img = imgs.reshape(len(labels), -1)

@@ -37,6 +37,9 @@ class ThreadBufferIterator(DataIter):
         self._gen_lock = threading.Condition()
         self._stop = False
 
+    def supports_dist_shard(self) -> bool:
+        return self.base.supports_dist_shard()
+
     def set_param(self, name, val):
         self.base.set_param(name, val)
         if name == "buffer_size":

@@ -24,8 +24,13 @@ from .data import DataBatch, DataIter
 
 
 class SyntheticIterator(DataIter):
+    def supports_dist_shard(self) -> bool:
+        return True
+
     def __init__(self) -> None:
         self.nsample = 512
+        self.dist_num_worker = 1
+        self.dist_worker_rank = 0
         self.input_shape = (1, 1, 16)
         self.nclass = 10
         self.label_width = 1
@@ -52,6 +57,10 @@ class SyntheticIterator(DataIter):
             self.seed = int(val)
         elif name == "layout":
             self.layout = val
+        elif name == "dist_num_worker":
+            self.dist_num_worker = int(val)
+        elif name == "dist_worker_rank":
+            self.dist_worker_rank = int(val)
 
     def init(self):
         if self.batch_size <= 0:
@@ -67,6 +76,15 @@ class SyntheticIterator(DataIter):
         self._data = rng.randn(*shape).astype(np.float32)
         flat = self._data.reshape(self.nsample, -1)
         teacher = rng.randn(flat.shape[1], self.nclass).astype(np.float32)
+        if self.dist_num_worker > 1 and self.dist_worker_rank > 0:
+            # each worker draws DISTINCT samples (disjoint rng streams)
+            # labelled by the SAME teacher; rank 0 keeps the exact
+            # single-process stream so 1-vs-n runs stay comparable
+            rng_k = np.random.RandomState(
+                1234 + self.seed + 7919 * self.dist_worker_rank
+            )
+            self._data = rng_k.randn(*shape).astype(np.float32)
+            flat = self._data.reshape(self.nsample, -1)
         cls = (flat @ teacher).argmax(-1).astype(np.float32)
         lab = np.zeros((self.nsample, self.label_width), np.float32)
         lab[:, 0] = cls

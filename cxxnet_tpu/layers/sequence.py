@@ -45,6 +45,30 @@ def _layer_norm(x, w, b, eps: float):
     ).astype(x.dtype)
 
 
+_FLASH_OK: dict = {}
+
+
+def _flash_works(t: int, tk: int, dh: int, dtype, causal: bool) -> bool:
+    """Compile probe so ``attn_impl`` can never take down a run (the
+    pool/LRN probe discipline, layers/conv.py): keyed on the static
+    attention geometry, probing fwd AND bwd of the real (T, Dh)."""
+    key = (t, tk, dh, jnp.dtype(dtype).name, causal)
+    if key not in _FLASH_OK:
+        from .conv import _run_probe_untraced
+        from ..ops.flash import flash_mha
+
+        def probe():
+            q = jnp.ones((1, t, 1, dh), dtype)
+            k = jnp.ones((1, tk, 1, dh), dtype)
+            jax.grad(
+                lambda a: flash_mha(a, k, k, causal, 512, 512, False)
+                .astype(jnp.float32).sum()
+            )(q).block_until_ready()
+
+        _FLASH_OK[key] = _run_probe_untraced(probe)
+    return _FLASH_OK[key]
+
+
 @register
 class AttentionLayer(Layer):
     type_name = "attention"
@@ -54,6 +78,7 @@ class AttentionLayer(Layer):
         self.nhead = 1
         self.causal = 0
         self.seq_parallel = 0
+        self.attn_impl = "auto"
         self.mesh_plan = None  # bound by the trainer (bind_mesh)
 
     _SP_MODES = {"0": 0, "1": 1, "2": 2, "off": 0, "ring": 1,
@@ -64,6 +89,12 @@ class AttentionLayer(Layer):
             self.nhead = int(val)
         elif name == "causal":
             self.causal = int(val)
+        elif name == "attn_impl":
+            if val not in ("auto", "pallas", "xla"):
+                raise ValueError(
+                    f"attn_impl must be auto|pallas|xla, got {val!r}"
+                )
+            self.attn_impl = val
         elif name == "seq_parallel":
             if val not in self._SP_MODES:
                 raise ValueError(
@@ -73,6 +104,64 @@ class AttentionLayer(Layer):
             self.seq_parallel = self._SP_MODES[val]
         else:
             super().set_param(name, val)
+
+    # XLA mha materializes (B,H,T,T) scores in HBM; past this T the
+    # flash kernel's O(T) memory is the difference between running and
+    # OOM, and its fused VMEM pipeline wins on step time too.
+    _AUTO_FLASH_MIN_T = 1024
+
+    def _local_attn(self, causal_override=None):
+        """Per-device full-sequence attention fn ``(q,k,v,causal)->o``.
+
+        ``attn_impl = pallas`` is a hard opt-in (raises if the kernel
+        probe fails on this backend); ``auto`` switches to the flash
+        kernel for long sequences where the XLA path's full score
+        matrix is the memory ceiling; ``xla`` always takes the
+        reference path.  On CPU the identical kernel runs in interpret
+        mode (tests).
+        """
+        from ..ops.attention import mha
+
+        def xla_attn(q, k, v, causal=bool(self.causal)):
+            return mha(q, k, v, causal=causal)
+
+        if self.attn_impl == "xla":
+            return xla_attn
+
+        def flash_attn(q, k, v, causal=bool(self.causal)):
+            from ..ops.flash import flash_mha
+
+            interp = jax.default_backend() != "tpu"
+            return flash_mha(q, k, v, causal, 512, 512, interp)
+
+        def dispatch(q, k, v, causal=bool(self.causal)):
+            from ..ops.flash import _pick_block
+
+            t, tk, dh = q.shape[1], k.shape[1], q.shape[3]
+            on_tpu = jax.default_backend() == "tpu"
+            if self.attn_impl == "auto":
+                # auto never takes the interpret-mode emulation (a silent
+                # orders-of-magnitude slowdown off-TPU), and falls back
+                # to mha when an odd T would shrink blocks into scalar
+                # territory (block 1 kernels compile forever / run slow)
+                if (
+                    not on_tpu
+                    or t < self._AUTO_FLASH_MIN_T
+                    or _pick_block(t, 512) < 128
+                    or _pick_block(tk, 512) < 128
+                ):
+                    return xla_attn(q, k, v, causal)
+            if on_tpu and not _flash_works(t, tk, dh, q.dtype, causal):
+                if self.attn_impl == "pallas":
+                    raise RuntimeError(
+                        "attention: attn_impl=pallas requested but the "
+                        f"flash kernel probe failed for T={t}, Dh={dh}, "
+                        f"{q.dtype} on this backend"
+                    )
+                return xla_attn(q, k, v, causal)
+            return flash_attn(q, k, v, causal)
+
+        return dispatch
 
     def bind_mesh(self, plan) -> None:
         self.mesh_plan = plan
@@ -89,6 +178,17 @@ class AttentionLayer(Layer):
         if self.nhead <= 0 or d % self.nhead != 0:
             raise ValueError(
                 f"attention: nhead={self.nhead} must divide model dim {d}"
+            )
+        if self.seq_parallel == 1 and self.attn_impl == "pallas":
+            # the ring path has its own blockwise streaming softmax; the
+            # flash kernel only slots into full-sequence local attention
+            # (plain or post-all-to-all) — fail loudly rather than
+            # silently measuring the XLA ring under a pallas opt-in
+            raise ValueError(
+                "attention: attn_impl=pallas does not compose with "
+                "seq_parallel=ring (the ring schedule is its own "
+                "streaming kernel); use seq_parallel=alltoall or "
+                "attn_impl=auto"
             )
         if self.seq_parallel and self.mesh_plan is not None:
             nm = self.mesh_plan.n_model
@@ -135,14 +235,15 @@ class AttentionLayer(Layer):
                 from ..ops.attention import a2a_self_attention
 
                 o = a2a_self_attention(
-                    q, k, v, plan.mesh, "model", causal=bool(self.causal)
+                    q, k, v, plan.mesh, "model", causal=bool(self.causal),
+                    attn_fn=self._local_attn(),
                 )
             else:
                 o = ring_self_attention(
                     q, k, v, plan.mesh, "model", causal=bool(self.causal)
                 )
         else:
-            o = mha(q, k, v, causal=bool(self.causal))
+            o = self._local_attn()(q, k, v)
         o = o.reshape(n, t, d)
         return [
             o @ params["wproj"].astype(x.dtype).T

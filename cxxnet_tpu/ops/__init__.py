@@ -12,5 +12,6 @@ SURVEY §4.1).
 """
 
 from .attention import mha, ring_attention, ring_self_attention  # noqa: F401
+from .flash import flash_mha  # noqa: F401
 from .lrn import lrn, lrn_xla  # noqa: F401
 from .pipeline import gpipe, pipeline_apply  # noqa: F401

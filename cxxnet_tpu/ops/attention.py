@@ -159,6 +159,7 @@ def a2a_attention(
     *,
     axis_name: str,
     causal: bool = False,
+    attn_fn=None,
 ) -> jnp.ndarray:
     """Ulysses-style all-to-all sequence parallelism.
 
@@ -184,7 +185,8 @@ def a2a_attention(
             tiled=True,
         )
 
-    o = mha(swap(q, True), swap(k, True), swap(v, True), causal=causal)
+    local = attn_fn if attn_fn is not None else mha
+    o = local(swap(q, True), swap(k, True), swap(v, True), causal=causal)
     return swap(o, False)
 
 
@@ -196,16 +198,20 @@ def a2a_self_attention(
     seq_axis: str = "model",
     *,
     causal: bool = False,
+    attn_fn=None,
 ) -> jnp.ndarray:
     """shard_map wrapper mirroring ``ring_self_attention`` — same global
-    (B,T,H,Dh) contract, all-to-all schedule inside."""
+    (B,T,H,Dh) contract, all-to-all schedule inside.  ``attn_fn`` swaps
+    the per-device full-sequence attention (e.g. the Pallas flash kernel
+    under ``attn_impl = pallas``)."""
     from jax.sharding import PartitionSpec as P
 
     from ._compat import shard_map_nocheck
 
     spec = P("data", seq_axis, None, None)
     fn = shard_map_nocheck(
-        functools.partial(a2a_attention, axis_name=seq_axis, causal=causal),
+        functools.partial(a2a_attention, axis_name=seq_axis, causal=causal,
+                          attn_fn=attn_fn),
         mesh, (spec, spec, spec), spec,
     )
     return fn(x_q, x_k, x_v)

@@ -1,0 +1,140 @@
+"""Golden tests: Pallas flash attention vs the XLA ``mha`` reference.
+
+Interpret mode runs the identical kernel code on CPU (the PairTest
+discipline, SURVEY §4.1); the on-TPU compile is covered by the layer's
+probe machinery.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu.ops.attention import mha
+from cxxnet_tpu.ops.flash import _pick_block, flash_mha
+
+
+def _qkv(b=2, t=64, h=2, d=16, dtype=jnp.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(
+        rng.randn(b, t, h, d).astype(np.float32), dtype=dtype
+    )
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_forward_matches_mha(causal):
+    q, k, v = _qkv()
+    ref = mha(q, k, v, causal=causal)
+    out = flash_mha(q, k, v, causal, 32, 16, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_mha(causal):
+    q, k, v = _qkv(t=32, d=8)
+
+    def loss_ref(q, k, v):
+        return (mha(q, k, v, causal=causal) ** 2).sum()
+
+    def loss_fl(q, k, v):
+        return (flash_mha(q, k, v, causal, 16, 16, True) ** 2).sum()
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_fl, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gr, gf, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_flash_bf16_close_to_f32_reference():
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    ref = mha(q.astype(jnp.float32), k.astype(jnp.float32),
+              v.astype(jnp.float32))
+    out = flash_mha(q, k, v, False, 32, 32, True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=0.05, atol=0.05
+    )
+
+
+def test_flash_uneven_blocks_and_single_block():
+    # T smaller than the requested block, and T that only divides by a
+    # shrunken power-of-two block
+    q, k, v = _qkv(t=24, d=8)
+    ref = mha(q, k, v, causal=True)
+    out = flash_mha(q, k, v, True, 128, 128, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pick_block():
+    assert _pick_block(256, 128) == 128
+    assert _pick_block(24, 128) == 24  # whole T fits one block
+    assert _pick_block(48, 32) == 16
+    assert _pick_block(7, 128) == 7
+
+
+def test_flash_cross_attention_lengths():
+    # Tq != Tk (e.g. decoder cross-attention)
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(2, 16, 2, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 64, 2, 8).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, 64, 2, 8).astype(np.float32))
+    ref = mha(q, k, v)
+    out = flash_mha(q, k, v, False, 16, 32, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------- layer-level attn_impl wiring
+def test_attention_layer_attn_impl_pallas_matches_xla():
+    """attn_impl = pallas routes the layer through the flash kernel (in
+    interpret mode off-TPU) and must match the XLA path bit-for-bit in
+    f32 within tolerance."""
+    from cxxnet_tpu.layers import create_layer
+
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(2, 32, 16).astype(np.float32))
+    outs = {}
+    for impl in ("xla", "pallas"):
+        lay = create_layer("attention")
+        lay.set_param("nhead", "2")
+        lay.set_param("causal", "1")
+        lay.set_param("init_sigma", "0.1")
+        lay.set_param("attn_impl", impl)
+        lay.infer_shape([(2, 32, 16)])
+        params = lay.init_params(jax.random.PRNGKey(0), [(2, 32, 16)])
+        (outs[impl],) = lay.apply(params, [x])
+    np.testing.assert_allclose(
+        np.asarray(outs["pallas"]), np.asarray(outs["xla"]),
+        rtol=1e-5, atol=1e-5,
+    )
+    with pytest.raises(ValueError, match="attn_impl"):
+        create_layer("attention").set_param("attn_impl", "cuda")
+
+
+def test_a2a_with_flash_local_attention():
+    """Ulysses SP composed with the flash kernel as the per-device
+    full-sequence attention (attn_fn hook)."""
+    from cxxnet_tpu.ops.attention import a2a_self_attention
+    from cxxnet_tpu.parallel import make_mesh
+
+    rng = np.random.RandomState(11)
+    mk = lambda: jnp.asarray(rng.randn(2, 32, 4, 8).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    plan = make_mesh("cpu:0-7", model_parallel=4)
+    want = mha(q, k, v, causal=True)
+
+    def attn_fn(q_, k_, v_, causal=True):
+        return flash_mha(q_, k_, v_, causal, 16, 16, True)
+
+    got = a2a_self_attention(
+        q, k, v, plan.mesh, "model", causal=True, attn_fn=attn_fn
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )

@@ -356,3 +356,203 @@ def test_mnist_iterator_dist_sharding(tmp_path):
         while it.next():
             seen.extend(it.value().label[:, 0].tolist())
     assert sorted(seen) == sorted(labels.tolist())
+
+
+# ------------------------------------------------ distributed sharding
+def test_shard_rows_equal_and_disjoint():
+    from cxxnet_tpu.io.data import shard_rows
+
+    n, w = 63, 2
+    shards = [shard_rows(n, k, w) for k in range(w)]
+    assert all(len(s) == n // w for s in shards)  # equal => equal steps
+    flat = np.concatenate(shards)
+    assert len(set(flat.tolist())) == len(flat)  # disjoint
+    with pytest.raises(ValueError):
+        shard_rows(3, 0, 4)
+
+
+def test_mnist_dist_shards_run_equal_batch_counts(tmp_path):
+    from cxxnet_tpu.io.mnist import (MNISTIterator, write_idx_images,
+                                     write_idx_labels)
+
+    rng = np.random.RandomState(0)
+    n = 63  # odd: k::n slicing would give 32 vs 31 rows
+    write_idx_images(str(tmp_path / "img"), rng.randint(0, 255, (n, 4, 4)))
+    write_idx_labels(str(tmp_path / "lab"), rng.randint(0, 10, (n,)))
+    counts, seen = [], []
+    for rank in range(2):
+        it = MNISTIterator()
+        assert it.supports_dist_shard()
+        for k, v in (("path_img", str(tmp_path / "img")),
+                     ("path_label", str(tmp_path / "lab")),
+                     ("batch_size", "16"), ("silent", "1"),
+                     ("dist_num_worker", "2"),
+                     ("dist_worker_rank", str(rank))):
+            it.set_param(k, v)
+        it.init()
+        it.before_first()
+        c = 0
+        while it.next():
+            seen.extend(it.value().inst_index.tolist())
+            c += 1
+        counts.append(c)
+    assert counts[0] == counts[1]  # unequal => SPMD deadlock
+    assert len(set(seen)) == len(seen)  # disjoint shards
+
+
+def test_csv_dist_shard(tmp_path):
+    from cxxnet_tpu.config import parse_pairs, split_sections
+    from cxxnet_tpu.io.data import create_iterator
+
+    rows = np.hstack([
+        np.arange(21)[:, None] % 3,
+        np.random.RandomState(0).randn(21, 4),
+    ])
+    np.savetxt(tmp_path / "d.csv", rows, delimiter=",")
+    got = []
+    for rank in range(2):
+        text = f"""
+data = train
+iter = csv
+  filename = {tmp_path}/d.csv
+  input_shape = 1,1,4
+  batch_size = 5
+iter = end
+"""
+        sec = split_sections(parse_pairs(text)).find("data")[0]
+        it = create_iterator(sec.entries)
+        assert it.supports_dist_shard()
+        it.set_param("dist_num_worker", "2")
+        it.set_param("dist_worker_rank", str(rank))
+        it.init()
+        it.before_first()
+        n = 0
+        while it.next():
+            n += 1
+        got.append(n)
+    assert got[0] == got[1] == 2  # floor(21/2)=10 rows -> 2 batches each
+
+
+def test_synth_dist_ranks_distinct_data_same_task():
+    from cxxnet_tpu.io.synth import SyntheticIterator
+
+    outs = {}
+    for rank, nw in ((0, 1), (0, 2), (1, 2)):
+        it = SyntheticIterator()
+        it.set_param("batch_size", "8")
+        it.set_param("nsample", "32")
+        it.set_param("input_shape", "1,1,16")
+        if nw > 1:
+            it.set_param("dist_num_worker", str(nw))
+            it.set_param("dist_worker_rank", str(rank))
+        it.init()
+        outs[(rank, nw)] = np.array(it._data)
+    # rank 0 of a dist run sees the exact single-process stream
+    np.testing.assert_array_equal(outs[(0, 1)], outs[(0, 2)])
+    # other ranks draw different samples
+    assert not np.allclose(outs[(0, 2)], outs[(1, 2)])
+
+
+def test_cli_rejects_unshardable_train_iter_multiproc(monkeypatch, tmp_path):
+    """The CLI guard itself: a 2-process run whose train iterator cannot
+    shard must fail loudly instead of feeding both processes identical
+    data."""
+    from cxxnet_tpu import cli as climod
+    from cxxnet_tpu.io.synth import SyntheticIterator
+    from cxxnet_tpu.parallel import distributed
+
+    monkeypatch.setattr(distributed, "process_info", lambda: (0, 2))
+    monkeypatch.setattr(SyntheticIterator, "supports_dist_shard",
+                        lambda self: False)
+    conf = tmp_path / "t.conf"
+    conf.write_text("""
+dev = cpu
+batch_size = 8
+num_round = 1
+model_dir = {d}
+data = train
+iter = synthetic
+  nsample = 32
+iter = end
+netconfig = start
+layer[0->1] = fullc:fc
+  nhidden = 4
+layer[1->1] = softmax
+netconfig = end
+input_shape = 1,1,16
+eta = 0.1
+""".format(d=tmp_path))
+    from cxxnet_tpu import config as cfgmod
+
+    task = climod.LearnTask()
+    for name, val in cfgmod.parse_file(str(conf)):
+        task.set_param(name, val)
+    with pytest.raises(ValueError, match="dist_num_worker"):
+        task.init()
+
+
+def test_imgbin_rejects_fewer_shards_than_workers(tmp_path):
+    from cxxnet_tpu.io.imgbin import BinPageWriter, ImageBinIterator
+
+    w = BinPageWriter(str(tmp_path / "a.bin"))
+    w.push(b"xx")
+    w.close()
+    (tmp_path / "a.lst").write_text("0\t1\tx.jpg\n")
+    it = ImageBinIterator()
+    it.set_param("image_bin", str(tmp_path / "a.bin"))
+    it.set_param("image_list", str(tmp_path / "a.lst"))
+    it.set_param("dist_num_worker", "2")
+    it.set_param("dist_worker_rank", "0")
+    with pytest.raises(ValueError, match="shard file"):
+        it.init()
+
+
+def test_imgbin_epoch_cap_equalizes_steps(tmp_path):
+    """Unequal shard files: every worker's epoch is capped at the
+    smallest worker's row count (the equal-steps contract)."""
+    import io as _pyio
+
+    from PIL import Image
+
+    from cxxnet_tpu.io.imgbin import BinPageWriter, ImageBinIterator
+
+    def jpeg():
+        buf = _pyio.BytesIO()
+        Image.new("RGB", (4, 4)).save(buf, "JPEG")
+        return buf.getvalue()
+
+    for name, n in (("a", 3), ("b", 1)):  # worker0: 3 rows, worker1: 1
+        w = BinPageWriter(str(tmp_path / f"{name}.bin"))
+        lines = []
+        for i in range(n):
+            w.push(jpeg())
+            lines.append(f"{i}\t0\t{name}{i}.jpg")
+        w.close()
+        (tmp_path / f"{name}.lst").write_text("\n".join(lines) + "\n")
+    counts = []
+    for rank in range(2):
+        it = ImageBinIterator()
+        it.set_param("native_decoder", "0")
+        for name in ("a", "b"):
+            it.set_param("image_bin", str(tmp_path / f"{name}.bin"))
+            it.set_param("image_list", str(tmp_path / f"{name}.lst"))
+        it.set_param("dist_num_worker", "2")
+        it.set_param("dist_worker_rank", str(rank))
+        it.init()
+        it.before_first()
+        c = 0
+        while it.next():
+            c += 1
+        counts.append(c)
+    assert counts == [1, 1]
+
+
+def test_attention_ring_rejects_pallas_opt_in():
+    from cxxnet_tpu.layers import create_layer
+
+    lay = create_layer("attention")
+    lay.set_param("nhead", "2")
+    lay.set_param("seq_parallel", "ring")
+    lay.set_param("attn_impl", "pallas")
+    with pytest.raises(ValueError, match="ring"):
+        lay.infer_shape([(2, 16, 8)])
