@@ -193,6 +193,14 @@ class ImageBinIterator(InstIterator):
             for i, (_, lst) in enumerate(shards):
                 per_worker[i % self.dist_num_worker] += _count_lst_rows(lst)
             self._epoch_cap = min(per_worker)
+            if self._epoch_cap == 0:
+                # 0 would read as "no cap" in next() and revive the
+                # unequal-steps deadlock; an empty worker is a packing
+                # error either way
+                raise ValueError(
+                    f"imgbin: worker {per_worker.index(0)}'s shard files "
+                    "contain 0 rows — repack so every worker gets data"
+                )
             shards = mine
         self._shards = shards
         if self.native_decoder and not self._raw:

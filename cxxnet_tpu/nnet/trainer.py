@@ -68,6 +68,7 @@ class NetTrainer:
         self.dev = "tpu"
         self.model_parallel = 1
         self.update_on_server = 0
+        self.zero = 0
         self.mesh_plan: Optional[MeshPlan] = None
         self.aux = {}  # non-gradient layer state (BN running stats)
         self.metric = MetricSet()
@@ -96,6 +97,20 @@ class NetTrainer:
             # reference: SGD runs on the PS (nnet_ps_server.cpp); here the
             # optimizer state is ZeRO-1-sharded over the data axis instead
             self.update_on_server = int(val)
+        elif name in ("zero", "fsdp"):
+            # zero = 1: optimizer state sharded over the data axis
+            # (update_on_server's modern spelling); zero = 3 / fsdp = 1:
+            # params themselves sharded too (MeshPlan.fsdp_sharding).
+            # ZeRO-2 has no distinct GSPMD expression here: gradients
+            # are transient inside the fused step, so 2 would silently
+            # equal 1 — reject it rather than mislead.
+            z = 3 if (name == "fsdp" and int(val)) else int(val)
+            if z not in (0, 1, 3):
+                raise ValueError(
+                    f"{name}={val}: supported levels are 0, 1 "
+                    "(state sharding) and 3 (FSDP param sharding)"
+                )
+            self.zero = z
         if self.metric.try_add_from_config(name, val):
             self.train_metric.try_add_from_config(name, val)
         self.cfg.append((name, val))
@@ -178,13 +193,20 @@ class NetTrainer:
     def _param_sh(self):
         """Sharding pytrees for (params, ustates): tensor-parallel weight
         placement over the mesh's model axis (pure DP → all replicated);
-        with ``update_on_server=1`` the updater state is additionally
-        ZeRO-1-sharded over the data axis (see MeshPlan.state_sharding)."""
+        ``zero = 1`` (or the reference-named ``update_on_server = 1``)
+        additionally ZeRO-1-shards the updater state over the data axis;
+        ``zero = 3`` / ``fsdp = 1`` shards the params themselves
+        (MeshPlan.fsdp_sharding) — GSPMD inserts the per-layer
+        all-gathers and gradient reduce-scatters."""
         plan = self.mesh_plan
         spec = lambda v: plan.param_sharding(np.shape(v))  # noqa: E731
-        psh = jax.tree_util.tree_map(spec, self.params)
-        if self.update_on_server:
-            sspec = lambda v: plan.state_sharding(np.shape(v))  # noqa: E731
+        sspec = lambda v: plan.state_sharding(np.shape(v))  # noqa: E731
+        fspec = lambda v: plan.fsdp_sharding(np.shape(v))  # noqa: E731
+        if self.zero >= 3:
+            psh = jax.tree_util.tree_map(fspec, self.params)
+        else:
+            psh = jax.tree_util.tree_map(spec, self.params)
+        if self.update_on_server or self.zero >= 1:
             ush = jax.tree_util.tree_map(sspec, self.ustates)
         else:
             ush = jax.tree_util.tree_map(spec, self.ustates)
