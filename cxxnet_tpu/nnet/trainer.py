@@ -104,7 +104,12 @@ class NetTrainer:
             # ZeRO-2 has no distinct GSPMD expression here: gradients
             # are transient inside the fused step, so 2 would silently
             # equal 1 — reject it rather than mislead.
-            z = 3 if (name == "fsdp" and int(val)) else int(val)
+            if name == "fsdp":
+                if int(val) not in (0, 1):
+                    raise ValueError(f"fsdp={val}: must be 0 or 1")
+                z = 3 if int(val) else 0
+            else:
+                z = int(val)
             if z not in (0, 1, 3):
                 raise ValueError(
                     f"{name}={val}: supported levels are 0, 1 "

@@ -120,7 +120,77 @@ class AdamUpdater(Updater):
         return w, {"m1": m1, "m2": m2}
 
 
-_UPDATERS = {"sgd": SGDUpdater, "nag": NAGUpdater, "adam": AdamUpdater}
+class RMSPropUpdater(Updater):
+    """RMSProp (Tieleman & Hinton): ``E[g^2] <- rho E[g^2] + (1-rho) g^2;
+    w -= lr * g / (sqrt(E[g^2]) + eps)``.
+
+    New scope — the reference ships only sgd/nag/adam (SURVEY §2.3); this
+    follows the framework's own conventions: the lr schedule, per-tag
+    overrides, NaN-zeroing clip, and ``wd`` added to the gradient all
+    behave as in ``sgd``.
+    """
+
+    type_name = "rmsprop"
+
+    def __init__(self, tag: str) -> None:
+        super().__init__(tag)
+        self.rho = 0.95
+        self.eps = 1e-8
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "rho":
+            self.rho = float(val)
+        elif name == "eps":
+            self.eps = float(val)
+        else:
+            super().set_param(name, val)
+
+    def init_state(self, w):
+        return {"v": jnp.zeros_like(w)}
+
+    def apply(self, w, g, state, epoch):
+        p = self.param
+        lr = p.learning_rate(epoch).astype(w.dtype)
+        if p.clip_gradient != 0.0:
+            g = _nan_clip(g, p.clip_gradient)
+        g = g + p.wd * w
+        v = self.rho * state["v"] + (1.0 - self.rho) * g * g
+        return w - lr * g / (jnp.sqrt(v) + self.eps), {"v": v}
+
+
+class AdagradUpdater(Updater):
+    """Adagrad (Duchi et al.): ``G <- G + g^2; w -= lr g / (sqrt(G) + eps)``.
+
+    New scope (see RMSPropUpdater); same clip/wd/schedule conventions.
+    """
+
+    type_name = "adagrad"
+
+    def __init__(self, tag: str) -> None:
+        super().__init__(tag)
+        self.eps = 1e-8
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "eps":
+            self.eps = float(val)
+        else:
+            super().set_param(name, val)
+
+    def init_state(self, w):
+        return {"v": jnp.zeros_like(w)}
+
+    def apply(self, w, g, state, epoch):
+        p = self.param
+        lr = p.learning_rate(epoch).astype(w.dtype)
+        if p.clip_gradient != 0.0:
+            g = _nan_clip(g, p.clip_gradient)
+        g = g + p.wd * w
+        v = state["v"] + g * g
+        return w - lr * g / (jnp.sqrt(v) + self.eps), {"v": v}
+
+
+_UPDATERS = {"sgd": SGDUpdater, "nag": NAGUpdater, "adam": AdamUpdater,
+             "rmsprop": RMSPropUpdater, "adagrad": AdagradUpdater}
 
 
 def create_updater(type_name: str, tag: str) -> Updater:

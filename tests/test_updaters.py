@@ -141,3 +141,70 @@ def test_momentum_saturation_ramp():
 def test_unknown_updater():
     with pytest.raises(ValueError):
         create_updater("lbfgs", "wmat")
+
+
+def test_rmsprop_matches_reference_recurrence():
+    up = create_updater("rmsprop", "wmat")
+    up.set_param("lr", "0.01")
+    up.set_param("rho", "0.9")
+    up.set_param("wd", "0.001")
+    w = jnp.asarray([1.0, -2.0])
+    st = up.init_state(w)
+    g = jnp.asarray([0.5, -0.25])
+    v = np.zeros(2)
+    wr = np.array([1.0, -2.0])
+    for t in range(4):
+        w, st = up.apply(w, g, st, jnp.asarray(t))
+        gr = np.asarray(g) + 0.001 * wr
+        v = 0.9 * v + 0.1 * gr * gr
+        wr = wr - 0.01 * gr / (np.sqrt(v) + 1e-8)
+        np.testing.assert_allclose(np.asarray(w), wr, rtol=1e-6)
+
+
+def test_adagrad_matches_reference_recurrence():
+    up = create_updater("adagrad", "wmat")
+    up.set_param("lr", "0.1")
+    w = jnp.asarray([1.0, -2.0])
+    st = up.init_state(w)
+    g = jnp.asarray([0.5, -0.25])
+    v = np.zeros(2)
+    wr = np.array([1.0, -2.0])
+    for t in range(4):
+        w, st = up.apply(w, g, st, jnp.asarray(t))
+        v = v + np.asarray(g) ** 2
+        wr = wr - 0.1 * np.asarray(g) / (np.sqrt(v) + 1e-8)
+        np.testing.assert_allclose(np.asarray(w), wr, rtol=1e-6)
+
+
+def test_rmsprop_trains_end_to_end():
+    """updater=rmsprop through the config path overfits a tiny batch."""
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    cfg = [
+        ("dev", "cpu"),
+        ("batch_size", "16"),
+        ("input_shape", "1,1,8"),
+        ("updater", "rmsprop"),
+        ("eta", "0.02"),
+        ("netconfig", "start"),
+        ("layer[0->1]", "fullc:fc"),
+        ("nhidden", "4"),
+        ("layer[1->1]", "softmax"),
+        ("netconfig", "end"),
+    ]
+    tr = NetTrainer()
+    tr.set_params(cfg)
+    tr.init_model()
+    rng = np.random.RandomState(0)
+    data = rng.randn(16, 8).astype(np.float32)
+    labels = rng.randint(0, 4, (16, 1)).astype(np.float32)
+    first = last = None
+    from cxxnet_tpu.io.data import DataBatch
+
+    for _ in range(60):
+        tr.update_all(data, labels)
+        out = tr.predict(DataBatch(data=data, label=labels))
+        err = (out.ravel() != labels.ravel()).mean()
+        first = err if first is None else first
+        last = err
+    assert last <= 0.25 and last <= first
