@@ -17,6 +17,8 @@ import sys
 import time
 from typing import List, Optional
 
+import numpy as np
+
 from . import config as cfgmod
 from .io.data import DataIter, create_iterator
 from .nnet.trainer import NetTrainer
@@ -44,6 +46,9 @@ class LearnTask:
         self.extract_node_name = ""
         self.output_format = 1
         self.scan_steps = 1
+        self.gen_prompt = ""
+        self.gen_len = 256
+        self.gen_temp = 0.0
         self.cfg: List[tuple] = []
 
     # ------------------------------------------------------------------
@@ -78,6 +83,15 @@ class LearnTask:
             self.output_format = 1 if val == "txt" else 0
         elif name == "scan_steps":
             self.scan_steps = int(val)
+        elif name == "gen_prompt":
+            self.gen_prompt = val
+        elif name == "gen_prompt_file":
+            with open(val, "rb") as f:
+                self.gen_prompt = f.read().decode("utf-8", "replace")
+        elif name == "gen_len":
+            self.gen_len = int(val)
+        elif name == "gen_temp":
+            self.gen_temp = float(val)
         self.cfg.append((name, val))
 
     # ------------------------------------------------------------------
@@ -96,7 +110,7 @@ class LearnTask:
 
         maybe_init_distributed(self.cfg)
         if self.task not in ("train", "finetune", "pred", "pred_raw",
-                             "extract"):
+                             "extract", "generate"):
             raise ValueError(f"unknown task {self.task!r}")
         self.init()
         if not self.silent:
@@ -107,6 +121,8 @@ class LearnTask:
             self.task_predict(raw=self.task == "pred_raw")
         elif self.task == "extract":
             self.task_extract()
+        elif self.task == "generate":
+            self.task_generate()
         else:
             raise ValueError(f"unknown task {self.task!r}")
         return 0
@@ -180,11 +196,13 @@ class LearnTask:
     def _create_iterators(self) -> None:
         split = cfgmod.split_sections(self.cfg)
         for sec in split.sections:
-            if sec.kind == "data" and self.task not in ("pred", "pred_raw"):
+            if sec.kind == "data" and self.task not in ("pred", "pred_raw",
+                                                        "generate"):
                 if self.itr_train is not None:
                     raise ValueError("can only have one data section")
                 self.itr_train = create_iterator(sec.entries)
-            elif sec.kind == "eval" and self.task not in ("pred", "pred_raw"):
+            elif sec.kind == "eval" and self.task not in ("pred", "pred_raw",
+                                                          "generate"):
                 self.itr_evals.append(create_iterator(sec.entries))
                 self.eval_names.append(sec.tag)
             elif sec.kind == "pred":
@@ -367,8 +385,60 @@ class LearnTask:
                 else:
                     preds = self.net_trainer.predict(batch)
                     for v in preds[:n]:
-                        fo.write(f"{v:g}\n")
+                        if np.ndim(v):  # sequence models: (T,) ids/row
+                            fo.write(
+                                " ".join(f"{t:g}" for t in v) + "\n"
+                            )
+                        else:
+                            fo.write(f"{v:g}\n")
         print(f"finished prediction, write into {self.name_pred}")
+
+    def task_generate(self) -> None:
+        """``task=generate``: autoregressive byte sampling from a trained
+        language model (new scope — embedding + causal transformer +
+        per-position softmax; see doc/python.md).
+
+        The jitted forward has a static window T (the net's input
+        shape); the context occupies positions ``0..L-1`` and the next
+        byte is read from the probability row at ``L-1`` — under causal
+        masking the padding at positions >= L is never attended by
+        position L-1, so a single compiled program serves every step.
+        ``gen_temp = 0`` is greedy argmax; ``> 0`` samples from
+        ``p^(1/temp)``.
+        """
+        from .io.data import DataBatch
+
+        tr = self.net_trainer
+        t = tr.graph.input_shape[-1]
+        ctx = list(self.gen_prompt.encode("utf-8")) or [ord("\n")]
+        rng = np.random.RandomState(tr.seed)
+        out_bytes = []
+        for _ in range(self.gen_len):
+            window = ctx[-t:]
+            ln = len(window)
+            data = np.zeros((1, t), np.float32)
+            data[0, :ln] = window
+            probs = tr.extract_feature(
+                DataBatch(data=data, label=None), "top[-1]"
+            )[0, ln - 1]
+            if self.gen_temp > 0:
+                # log-space: p^(1/temp) underflows to all-zeros for low
+                # temperatures; subtracting the max first never does
+                lp = np.log(np.maximum(np.asarray(probs, np.float64),
+                                       1e-300)) / self.gen_temp
+                lp -= lp.max()
+                p = np.exp(lp)
+                p /= p.sum()
+                nxt = int(rng.choice(len(p), p=p))
+            else:
+                nxt = int(np.argmax(probs))
+            ctx.append(nxt)
+            out_bytes.append(nxt)
+        text = bytes(out_bytes).decode("utf-8", "replace")
+        with open(self.name_pred, "w", encoding="utf-8") as fo:
+            fo.write(text)
+        print(f"generated {self.gen_len} bytes -> {self.name_pred}")
+        print(text)
 
     def task_extract(self) -> None:
         if self.itr_pred is None:

@@ -103,6 +103,7 @@ def create_iterator(cfg: Sequence[ConfigEntry]) -> DataIter:
     from .prefetch import ThreadBufferIterator
     from .synth import SyntheticIterator
     from .attach_txt import AttachTxtIterator
+    from .text import TextIterator
 
     it: Optional[DataIter] = None
     for name, val in cfg:
@@ -127,6 +128,10 @@ def create_iterator(cfg: Sequence[ConfigEntry]) -> DataIter:
                 if it is not None:
                     raise ValueError("synthetic cannot chain over another iterator")
                 it = SyntheticIterator()
+            elif val == "text":
+                if it is not None:
+                    raise ValueError("text cannot chain over another iterator")
+                it = TextIterator()
             elif val == "threadbuffer":
                 if it is None:
                     raise ValueError("must specify input of threadbuffer")

@@ -1,0 +1,126 @@
+"""Byte-level text iterator for language modeling.
+
+New TPU-first scope — the reference has no sequence data path (SURVEY
+§5).  Follows the framework's iterator conventions (``set_param``
+config, batch-major ``DataBatch``, equal-truncated distributed
+sharding).
+
+``iter = text`` config keys:
+
+* ``filename`` — UTF-8/binary text file; tokens are raw bytes
+  (vocab 256, no tokenizer dependency)
+* ``seq_len`` — window length T; each instance is ``T`` input ids with
+  the next byte at every position as the label (``label_width = T``)
+* ``batch_size``
+* ``stride`` — window start spacing (default ``seq_len``:
+  non-overlapping; smaller values augment)
+* ``shuffle`` / ``seed_data`` — one-shot window shuffle
+* ``dist_num_worker`` / ``dist_worker_rank`` — equal-truncated window
+  sharding (see ``data.shard_rows``)
+
+Emits ``data (N, T)`` float32 ids and ``label (N, T)`` float32 next-ids
+— the ``embedding`` layer consumes the ids, the per-position ``softmax``
+loss consumes the labels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .data import DataBatch, DataIter
+
+
+class TextIterator(DataIter):
+    def supports_dist_shard(self) -> bool:
+        return True
+
+    def __init__(self) -> None:
+        self.filename = ""
+        self.seq_len = 0
+        self.batch_size = 0
+        self.stride = 0
+        self.shuffle = 0
+        self.seed = 0
+        self.silent = 0
+        self.dist_num_worker = 1
+        self.dist_worker_rank = 0
+        self._raw: np.ndarray | None = None
+        self._starts: np.ndarray | None = None
+        self._loc = 0
+
+    def set_param(self, name, val):
+        if name == "filename":
+            self.filename = val
+        elif name == "seq_len":
+            self.seq_len = int(val)
+        elif name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "stride":
+            self.stride = int(val)
+        elif name == "shuffle":
+            self.shuffle = int(val)
+        elif name == "seed_data":
+            self.seed = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "dist_num_worker":
+            self.dist_num_worker = int(val)
+        elif name == "dist_worker_rank":
+            self.dist_worker_rank = int(val)
+
+    def init(self):
+        if self.seq_len <= 0 or self.batch_size <= 0:
+            raise ValueError("text: set seq_len and batch_size")
+        with open(self.filename, "rb") as f:
+            raw = np.frombuffer(f.read(), np.uint8)
+        t = self.seq_len
+        stride = self.stride or t
+        starts = np.arange(0, len(raw) - t, stride, dtype=np.int64)
+        if len(starts) == 0:
+            raise ValueError(
+                f"text: {self.filename} has {len(raw)} bytes, need more "
+                f"than seq_len={t}"
+            )
+        if self.shuffle:
+            rng = np.random.RandomState(42 + self.seed)
+            starts = starts[rng.permutation(len(starts))]
+        if self.dist_num_worker > 1:
+            from .data import shard_rows
+
+            starts = starts[
+                shard_rows(
+                    len(starts), self.dist_worker_rank, self.dist_num_worker
+                )
+            ]
+        # windows materialize per batch in value() — an up-front
+        # (num_windows, T+1) array costs 4*(seq_len/stride) times the
+        # corpus in RAM (stride < seq_len is the documented augmentation
+        # mode), only the byte buffer + start offsets are kept
+        self._raw = raw
+        self._starts = starts
+        if not self.silent:
+            print(
+                f"TextIterator: {self.filename}: {len(raw)} bytes -> "
+                f"{len(starts)} windows of T={t}"
+            )
+
+    def before_first(self):
+        self._loc = 0
+
+    def next(self) -> bool:
+        assert self._raw is not None, "init() not called"
+        if self._loc + self.batch_size <= len(self._starts):
+            self._loc += self.batch_size
+            return True
+        return False
+
+    def value(self) -> DataBatch:
+        lo, hi = self._loc - self.batch_size, self._loc
+        t = self.seq_len
+        idx = self._starts[lo:hi, None] + np.arange(t + 1)[None, :]
+        win = self._raw[idx].astype(np.float32)
+        return DataBatch(
+            data=win[:, :-1],
+            label=win[:, 1:],
+            inst_index=np.arange(lo, hi, dtype=np.uint32),
+        )

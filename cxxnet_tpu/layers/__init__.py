@@ -13,5 +13,13 @@ from .base import (  # noqa: F401
     layer_types,
     register,
 )
-from . import conv, elemwise, linear, loss, sequence, structure  # noqa: F401
+from . import (  # noqa: F401
+    conv,
+    elemwise,
+    embed,
+    linear,
+    loss,
+    sequence,
+    structure,
+)
 from .pairtest import PairTestLayer  # noqa: F401

@@ -1,0 +1,108 @@
+"""Token embedding layer for sequence models.
+
+New TPU-first scope — the reference is a CNN framework with no discrete
+inputs (SURVEY §5); this follows the framework's own conventions
+(config-driven params, per-tag hyperparameter scoping).
+
+``embedding`` config keys:
+
+* ``nvocab`` — vocabulary size (required)
+* ``nhidden`` — embedding dimension (required)
+* ``pos = none|learned|sin`` — positional encoding added to the token
+  embedding: a trained ``(T, D)`` table (tag ``pos``, so ``pos:lr``
+  scoping works) or fixed sinusoidal (Vaswani et al. 2017)
+
+Input is a flat ``(N, T)`` node of token ids (the text iterator emits
+ids as float32 — exact for any realistic vocab); output is the
+``(N, T, D)`` sequence node the attention stack consumes.  The layer
+sets ``integer_input`` so the net skips the bf16 compute-dtype cast on
+the raw ids (bf16 would corrupt ids above 256).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .base import Layer, Params, Shape, register
+
+
+def sin_pos_table(t: int, d: int) -> jnp.ndarray:
+    """Sinusoidal positional encodings, (T, D) f32."""
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    half = (d + 1) // 2
+    freq = jnp.exp(
+        -math.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    ang = pos * freq[None, :]
+    table = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+    return table[:, :d]
+
+
+@register
+class EmbeddingLayer(Layer):
+    type_name = "embedding"
+
+    #: the net must NOT cast this layer's input to the compute dtype —
+    #: token ids above 256 are not exactly representable in bf16
+    integer_input = True
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.nvocab = 0
+        self.pos = "none"
+
+    def set_param(self, name, val):
+        if name == "nvocab":
+            self.nvocab = int(val)
+        elif name == "pos":
+            if val not in ("none", "learned", "sin"):
+                raise ValueError(
+                    f"embedding: pos must be none|learned|sin, got {val!r}"
+                )
+            self.pos = val
+        else:
+            super().set_param(name, val)
+
+    def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
+        self._check_arity(in_shapes, 1)
+        (shape,) = in_shapes
+        if len(shape) != 2:
+            raise ValueError(
+                "embedding: input must be a flat (N, T) id node "
+                f"(input_shape = 1,1,T), got {shape}"
+            )
+        if self.nvocab <= 0 or self.param.num_hidden <= 0:
+            raise ValueError("embedding: set nvocab and nhidden")
+        n, t = shape
+        return [(n, t, self.param.num_hidden)]
+
+    def init_params(self, key, in_shapes) -> Params:
+        d = self.param.num_hidden
+        t = in_shapes[0][1]
+        k1, k2 = jax.random.split(key)
+        sigma = self.param.init_sigma
+        p = {
+            "wmat": jax.random.normal(k1, (self.nvocab, d), jnp.float32)
+            * sigma
+        }
+        if self.pos == "learned":
+            p["pos"] = jax.random.normal(k2, (t, d), jnp.float32) * sigma
+        return p
+
+    def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        x = inputs[0]
+        ids = jnp.clip(
+            jnp.round(x).astype(jnp.int32), 0, self.nvocab - 1
+        )
+        table = params["wmat"]
+        out = jnp.take(table, ids, axis=0)
+        t = out.shape[1]
+        if self.pos == "learned":
+            out = out + params["pos"].astype(out.dtype)[None, :t]
+        elif self.pos == "sin":
+            out = out + sin_pos_table(t, out.shape[-1]).astype(out.dtype)
+        return [out]

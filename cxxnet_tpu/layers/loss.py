@@ -32,10 +32,13 @@ class SoftmaxLayer(LossLayer):
         return jax.nn.softmax(x, axis=-1)
 
     def loss(self, x, labels):
-        # labels: (N,) or (N,1) integer class ids
-        lab = labels.reshape(labels.shape[0]).astype(jnp.int32)
+        # labels: integer class ids over x's leading dims — (N,)/(N,1)
+        # for classifiers, (N, T) for per-position sequence losses
+        # (language models), or (T,) for a single row under the
+        # loss_masked vmap
+        lab = labels.reshape(x.shape[:-1]).astype(jnp.int32)
         logp = jax.nn.log_softmax(x, axis=-1)
-        return -jnp.sum(jnp.take_along_axis(logp, lab[:, None], axis=-1))
+        return -jnp.sum(jnp.take_along_axis(logp, lab[..., None], axis=-1))
 
 
 @register

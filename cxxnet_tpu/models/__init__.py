@@ -23,6 +23,7 @@ from .builders import (  # noqa: F401
     mnist_conv_conf,
     mnist_mlp_conf,
     transformer_conf,
+    transformer_lm_conf,
     vgg16_conf,
 )
 
@@ -34,4 +35,5 @@ MODEL_BUILDERS = {
     "vgg16": vgg16_conf,
     "kaggle_bowl": kaggle_bowl_conf,
     "transformer": transformer_conf,
+    "transformer_lm": transformer_lm_conf,
 }

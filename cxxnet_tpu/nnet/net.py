@@ -228,7 +228,11 @@ class FunctionalNet:
         cdt = self.compute_dtype
         if cdt != jnp.float32:
             params = self._cast_params(params)
-            data = data.astype(cdt)
+            if not (self.layer_objs
+                    and getattr(self.layer_objs[0], "integer_input", False)):
+                # embedding nets keep raw token ids in f32 (exact to
+                # 2^24); bf16 would corrupt ids above 256
+                data = data.astype(cdt)
             extras = [e.astype(cdt) for e in extras]
         out_idx = self.out_node_index()
         # collect per-layer state updates when the caller threads aux in

@@ -716,10 +716,15 @@ class NetTrainer:
         return ret
 
     def predict(self, batch: DataBatch) -> np.ndarray:
-        """Per-instance prediction: argmax, or raw value for 1-col output."""
+        """Per-instance prediction: argmax, or raw value for 1-col output.
+
+        Sequence models (``(N, T, V)`` out node) predict per position —
+        the result is the ``(N, T)`` argmax id matrix."""
         out = self._run_sharded(
             self._eval_fn(), np.asarray(batch.data), tuple(batch.extra_data)
         )
+        if out.ndim == 3:
+            return out.argmax(axis=-1).astype(np.float32)
         out2d = out.reshape(out.shape[0], -1)
         if out2d.shape[1] == 1:
             return out2d[:, 0]

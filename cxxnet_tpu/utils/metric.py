@@ -159,6 +159,14 @@ class MetricSet:
         """labels: (N, label_width); label_ranges: field → column span."""
         if labels.ndim == 1:
             labels = labels[:, None]
+        if pred.ndim == 3:
+            # per-position sequence predictions (N, T, V) — language
+            # models: score each position as an instance, label column
+            # t is the target for position t
+            n, t, v = pred.shape
+            pred = pred.reshape(n * t, v)
+            labels = labels[:, :t].reshape(n * t, 1)
+            label_ranges = {f: (0, 1) for f in label_ranges}
         for mt, field in zip(self.metrics, self.fields):
             if field not in label_ranges:
                 raise ValueError(f"Metric: unknown target = {field}")

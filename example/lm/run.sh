@@ -1,0 +1,14 @@
+#!/bin/sh
+# end-to-end demo on a public-domain-style synthetic corpus
+set -e
+python - << 'PY'
+text = ("the quick brown fox jumps over the lazy dog. " * 300).encode()
+open("corpus.txt", "wb").write(text)
+from cxxnet_tpu.models import transformer_lm_conf
+open("lm.conf", "w").write(transformer_lm_conf(
+    seq_len=32, dim=64, nhead=2, nlayer=2,
+    text_file="corpus.txt", batch_size=16, num_round=12))
+PY
+python -m cxxnet_tpu lm.conf task=train
+python -m cxxnet_tpu lm.conf task=generate model_in=./models/0012.model \
+    gen_prompt="the quick " gen_len=90

@@ -1,0 +1,198 @@
+"""Language-model pipeline: text iterator, embedding, per-position
+softmax, end-to-end training + generation (all new TPU-first scope —
+the reference has no sequence models, SURVEY §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu import config as cfgmod
+from cxxnet_tpu.io.data import DataBatch, create_iterator
+from cxxnet_tpu.io.text import TextIterator
+from cxxnet_tpu.layers import create_layer
+from cxxnet_tpu.models import transformer_lm_conf
+from cxxnet_tpu.nnet.trainer import NetTrainer
+
+
+@pytest.fixture()
+def corpus(tmp_path):
+    p = tmp_path / "c.txt"
+    p.write_bytes(("the quick brown fox jumps over the lazy dog. " * 300)
+                  .encode())
+    return str(p)
+
+
+def _text_iter(corpus, **kw):
+    it = TextIterator()
+    it.set_param("filename", corpus)
+    it.set_param("silent", "1")
+    for k, v in kw.items():
+        it.set_param(k, str(v))
+    it.init()
+    return it
+
+
+def test_text_iterator_next_byte_shift(corpus):
+    it = _text_iter(corpus, seq_len=8, batch_size=4)
+    it.before_first()
+    assert it.next()
+    b = it.value()
+    assert b.data.shape == (4, 8) and b.label.shape == (4, 8)
+    # label is the input shifted by one byte
+    np.testing.assert_array_equal(b.data[:, 1:], b.label[:, :-1])
+    raw = open(corpus, "rb").read()
+    np.testing.assert_array_equal(b.data[0], np.frombuffer(raw[:8], np.uint8))
+    assert b.label[0, -1] == raw[8]
+
+
+def test_text_iterator_dist_shard(corpus):
+    it = _text_iter(corpus, seq_len=16, batch_size=2)
+    full = sum(1 for _ in iter(lambda: it.next(), False))
+    counts = []
+    for rank in range(2):
+        ws = _text_iter(corpus, seq_len=16, batch_size=2,
+                        dist_num_worker=2, dist_worker_rank=rank)
+        assert ws.supports_dist_shard()
+        counts.append(sum(1 for _ in iter(lambda: ws.next(), False)))
+    assert counts[0] == counts[1]
+    assert counts[0] <= (full + 1) // 2
+
+
+def test_embedding_layer_lookup_and_positions():
+    lay = create_layer("embedding")
+    lay.set_param("nvocab", "7")
+    lay.set_param("nhidden", "4")
+    lay.set_param("init_sigma", "1.0")
+    lay.infer_shape([(2, 3)])
+    params = lay.init_params(jax.random.PRNGKey(0), [(2, 3)])
+    ids = jnp.asarray([[0, 3, 6], [1, 1, 2]], jnp.float32)
+    (out,) = lay.apply(params, [ids])
+    assert out.shape == (2, 3, 4)
+    np.testing.assert_allclose(np.asarray(out[0, 1]),
+                               np.asarray(params["wmat"][3]))
+    np.testing.assert_allclose(np.asarray(out[1, 0]),
+                               np.asarray(out[1, 1]))
+
+    # learned positions break the tie between equal tokens
+    lay2 = create_layer("embedding")
+    lay2.set_param("nvocab", "7")
+    lay2.set_param("nhidden", "4")
+    lay2.set_param("pos", "learned")
+    lay2.infer_shape([(2, 3)])
+    p2 = lay2.init_params(jax.random.PRNGKey(1), [(2, 3)])
+    assert "pos" in p2
+    (out2,) = lay2.apply(p2, [ids])
+    assert not np.allclose(np.asarray(out2[1, 0]), np.asarray(out2[1, 1]))
+
+    # sinusoidal: fixed, no extra params
+    lay3 = create_layer("embedding")
+    lay3.set_param("nvocab", "7")
+    lay3.set_param("nhidden", "4")
+    lay3.set_param("pos", "sin")
+    lay3.infer_shape([(2, 3)])
+    p3 = lay3.init_params(jax.random.PRNGKey(2), [(2, 3)])
+    assert set(p3) == {"wmat"}
+    with pytest.raises(ValueError, match="pos"):
+        create_layer("embedding").set_param("pos", "rotary")
+
+
+def test_embedding_gradient_hits_used_rows_only():
+    lay = create_layer("embedding")
+    lay.set_param("nvocab", "5")
+    lay.set_param("nhidden", "3")
+    lay.set_param("init_sigma", "0.5")
+    lay.infer_shape([(1, 2)])
+    params = lay.init_params(jax.random.PRNGKey(0), [(1, 2)])
+    ids = jnp.asarray([[1, 3]], jnp.float32)
+
+    g = jax.grad(
+        lambda p: lay.apply(p, [ids])[0].sum()
+    )(params)["wmat"]
+    g = np.asarray(g)
+    assert np.all(g[[1, 3]] == 1.0)
+    assert np.all(g[[0, 2, 4]] == 0.0)
+
+
+def test_softmax_loss_per_position_matches_manual():
+    from cxxnet_tpu.layers.loss import SoftmaxLayer
+
+    lay = SoftmaxLayer()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 3, 5).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 5, (2, 3)).astype(np.float32))
+    got = float(lay.loss(x, y))
+    logp = np.asarray(jax.nn.log_softmax(x, axis=-1))
+    want = -sum(
+        logp[n, t, int(np.asarray(y)[n, t])]
+        for n in range(2) for t in range(3)
+    )
+    assert abs(got - want) < 1e-4
+    # 2-D classifier case unchanged
+    x2 = jnp.asarray(rng.randn(4, 5).astype(np.float32))
+    y2 = jnp.asarray(rng.randint(0, 5, (4, 1)).astype(np.float32))
+    got2 = float(lay.loss(x2, y2))
+    logp2 = np.asarray(jax.nn.log_softmax(x2, axis=-1))
+    want2 = -sum(logp2[i, int(np.asarray(y2)[i, 0])] for i in range(4))
+    assert abs(got2 - want2) < 1e-4
+
+
+def test_metric_flattens_sequence_predictions():
+    from cxxnet_tpu.utils.metric import MetricSet
+
+    ms = MetricSet()
+    ms.add_metric("error")
+    pred = np.zeros((2, 3, 4), np.float32)
+    pred[0, :, 1] = 1.0  # predicts class 1 at all positions of row 0
+    pred[1, :, 2] = 1.0
+    label = np.asarray([[1, 1, 0], [2, 2, 2]], np.float32)
+    ms.add_eval(pred, label, {"label": (0, 3)})
+    assert abs(ms.metrics[0].get() - 1.0 / 6.0) < 1e-6
+
+
+def _lm_trainer(corpus, **kw):
+    conf = transformer_lm_conf(
+        seq_len=32, dim=64, nhead=2, nlayer=2, text_file=corpus,
+        batch_size=16, dev="cpu", compute_dtype="float32", **kw,
+    )
+    pairs = cfgmod.parse_pairs(conf)
+    it = create_iterator(
+        cfgmod.split_sections(pairs).find("data")[0].entries
+    )
+    it.set_param("batch_size", "16")
+    it.set_param("silent", "1")
+    it.init()
+    tr = NetTrainer()
+    tr.set_params(pairs)
+    tr.init_model()
+    return tr, it
+
+
+@pytest.mark.slow
+def test_lm_trains_and_generates(corpus):
+    tr, it = _lm_trainer(corpus)
+    for _ in range(12):
+        it.before_first()
+        while it.next():
+            tr.update(it.value())
+    it.before_first()
+    it.next()
+    b = it.value()
+    out = np.asarray(tr.predict(b))
+    assert out.shape == b.label.shape
+    acc = (out == b.label).mean()
+    assert acc > 0.8, f"LM failed to overfit: next-byte acc {acc:.2f}"
+
+    # greedy generation continues the periodic corpus
+    t = tr.graph.input_shape[-1]
+    ctx = list(b"the quick brown fox ")
+    for _ in range(30):
+        window = ctx[-t:]
+        data = np.zeros((1, t), np.float32)
+        data[0, : len(window)] = window
+        probs = tr.extract_feature(
+            DataBatch(data=data, label=None), "top[-1]"
+        )[0, len(window) - 1]
+        ctx.append(int(np.argmax(probs)))
+    text = bytes(ctx[20:]).decode("utf-8", "replace")
+    assert "jumps over" in text, f"unexpected continuation: {text!r}"

@@ -23,8 +23,10 @@ def _global_cfg(conf_text: str):
 def test_model_shapes(name):
     """Parse + init at tiny batch; checks graph wiring and shape rules."""
     builder = MODEL_BUILDERS[name]
-    text = builder(batch_size=4, dev="cpu") if name.startswith("mnist") or \
-        name == "kaggle_bowl" else builder(batch_size=4, dev="cpu", nsample=8)
+    if name.startswith("mnist") or name in ("kaggle_bowl", "transformer_lm"):
+        text = builder(batch_size=4, dev="cpu")
+    else:
+        text = builder(batch_size=4, dev="cpu", nsample=8)
     tr = NetTrainer()
     tr.set_params(_global_cfg(text))
     tr.init_model()
@@ -34,7 +36,7 @@ def test_model_shapes(name):
     out = shapes[tr.net.out_node_index()]
     expect = {"mnist_mlp": 10, "mnist_conv": 10, "alexnet": 1000,
               "googlenet": 1000, "vgg16": 1000, "kaggle_bowl": 121,
-              "transformer": 10}[name]
+              "transformer": 10, "transformer_lm": 256}[name]
     assert out[-1] == expect
 
 
