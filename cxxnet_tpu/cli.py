@@ -47,6 +47,7 @@ class LearnTask:
         self.output_format = 1
         self.scan_steps = 1
         self.gen_prompt = ""
+        self.gen_prompt_file = ""
         self.gen_len = 256
         self.gen_temp = 0.0
         self.cfg: List[tuple] = []
@@ -86,8 +87,7 @@ class LearnTask:
         elif name == "gen_prompt":
             self.gen_prompt = val
         elif name == "gen_prompt_file":
-            with open(val, "rb") as f:
-                self.gen_prompt = f.read().decode("utf-8", "replace")
+            self.gen_prompt_file = val  # read lazily in task_generate
         elif name == "gen_len":
             self.gen_len = int(val)
         elif name == "gen_temp":
@@ -410,7 +410,11 @@ class LearnTask:
 
         tr = self.net_trainer
         t = tr.graph.input_shape[-1]
-        ctx = list(self.gen_prompt.encode("utf-8")) or [ord("\n")]
+        prompt = self.gen_prompt
+        if self.gen_prompt_file:
+            with open(self.gen_prompt_file, "rb") as f:
+                prompt = f.read().decode("utf-8", "replace")
+        ctx = list(prompt.encode("utf-8")) or [ord("\n")]
         rng = np.random.RandomState(tr.seed)
         out_bytes = []
         for _ in range(self.gen_len):

@@ -44,9 +44,11 @@ class TextIterator(DataIter):
         self.silent = 0
         self.dist_num_worker = 1
         self.dist_worker_rank = 0
+        self.round_batch = 1
         self._raw: np.ndarray | None = None
         self._starts: np.ndarray | None = None
         self._loc = 0
+        self._padd = 0
 
     def set_param(self, name, val):
         if name == "filename":
@@ -67,6 +69,8 @@ class TextIterator(DataIter):
             self.dist_num_worker = int(val)
         elif name == "dist_worker_rank":
             self.dist_worker_rank = int(val)
+        elif name == "round_batch":
+            self.round_batch = int(val)
 
     def init(self):
         if self.seq_len <= 0 or self.batch_size <= 0:
@@ -106,21 +110,35 @@ class TextIterator(DataIter):
 
     def before_first(self):
         self._loc = 0
+        self._padd = 0
 
     def next(self) -> bool:
         assert self._raw is not None, "init() not called"
-        if self._loc + self.batch_size <= len(self._starts):
+        n = len(self._starts)
+        if self._loc + self.batch_size <= n:
             self._loc += self.batch_size
+            self._padd = 0
+            return True
+        if self.round_batch and self._loc < n:
+            # final partial batch: wrap to fill, flag the padding so
+            # eval trims and the train path masks it
+            # (iter_batch_proc-inl.hpp:84-99 round_batch semantics)
+            self._padd = self._loc + self.batch_size - n
+            self._loc = n
             return True
         return False
 
     def value(self) -> DataBatch:
-        lo, hi = self._loc - self.batch_size, self._loc
+        lo, hi = self._loc - self.batch_size + self._padd, self._loc
         t = self.seq_len
-        idx = self._starts[lo:hi, None] + np.arange(t + 1)[None, :]
+        take = self._starts[lo:hi]
+        if self._padd:
+            take = np.concatenate([take, self._starts[: self._padd]])
+        idx = take[:, None] + np.arange(t + 1)[None, :]
         win = self._raw[idx].astype(np.float32)
         return DataBatch(
             data=win[:, :-1],
             label=win[:, 1:],
-            inst_index=np.arange(lo, hi, dtype=np.uint32),
+            inst_index=np.arange(lo, lo + self.batch_size, dtype=np.uint32),
+            num_batch_padd=self._padd,
         )

@@ -228,8 +228,7 @@ class FunctionalNet:
         cdt = self.compute_dtype
         if cdt != jnp.float32:
             params = self._cast_params(params)
-            if not (self.layer_objs
-                    and getattr(self.layer_objs[0], "integer_input", False)):
+            if not self._node0_wants_ints():
                 # embedding nets keep raw token ids in f32 (exact to
                 # 2^24); bf16 would corrupt ids above 256
                 data = data.astype(cdt)
@@ -313,6 +312,20 @@ class FunctionalNet:
         if return_aux:
             return nodes, total_loss, (new_aux if new_aux is not None else {})
         return nodes, total_loss
+
+    def _node0_wants_ints(self) -> bool:
+        """True when any consumer of the data node (node 0) declares
+        ``integer_input`` (the embedding layer) — keyed to the graph,
+        not to declaration order.  If a net mixes an embedding with
+        other node-0 consumers, data stays f32 for all of them
+        (conservative: correct ids; the other branches simply compute
+        their first layer in f32)."""
+        for i, spec in enumerate(self.graph.layers):
+            if 0 in spec.nindex_in and getattr(
+                self.layer_objs[i], "integer_input", False
+            ):
+                return True
+        return False
 
     def _cast_params(self, params: Dict[str, dict]) -> Dict[str, dict]:
         """Mixed precision: layer math (MXU) in the compute dtype, master

@@ -249,6 +249,22 @@ class NetTrainer:
         # metrics consume the out node on host: always hand back f32
         return loss, (nodes[net.out_node_index()].astype(jnp.float32), new_aux)
 
+    def _jit(self, fn, in_shardings, out_shardings, donate_argnums=()):
+        """jit with shardings only when the mesh is non-trivial.
+
+        On a single-device mesh the NamedSharding annotations are pure
+        constraint noise — measured on the v5e (transformer LM b8
+        T=2048): sharding-annotated scan steps ran ~30x slower than the
+        same program without annotations (layout constraints defeat
+        XLA's scan buffer aliasing/fusion), so 1-device jits drop them.
+        """
+        plan = self.mesh_plan
+        if plan is not None and plan.n_devices > 1:
+            return jax.jit(fn, in_shardings=in_shardings,
+                           out_shardings=out_shardings,
+                           donate_argnums=donate_argnums)
+        return jax.jit(fn, donate_argnums=donate_argnums)
+
     def _fused_step_fn(self):
         """fwd + bwd + updater math as ONE donated SPMD program.
 
@@ -277,10 +293,10 @@ class NetTrainer:
                 new_p, new_s = apply_updates(updaters, params, ustates, grads, epoch)
                 return new_p, new_s, new_aux, loss, out
 
-            self._jit_cache["fused"] = jax.jit(
+            self._jit_cache["fused"] = self._jit(
                 step,
-                in_shardings=(psh, ush, rep, dsh, dsh, dsh, rep, rep, ex),
-                out_shardings=(psh, ush, rep, rep, dsh),
+                (psh, ush, rep, dsh, dsh, dsh, rep, rep, ex),
+                (psh, ush, rep, rep, dsh),
                 donate_argnums=(0, 1, 2),
             )
         return self._jit_cache["fused"]
@@ -342,10 +358,10 @@ class NetTrainer:
             data_sh = (sdsh, sdsh) if per_step_data else (dsh, dsh)
 
             ys_sh = (rep, sdsh) if with_out else rep
-            self._jit_cache[key] = jax.jit(
+            self._jit_cache[key] = self._jit(
                 step,
-                in_shardings=(psh, ush, rep) + data_sh + (rep, rep),
-                out_shardings=(psh, ush, rep, rep, rep, ys_sh),
+                (psh, ush, rep) + data_sh + (rep, rep),
+                (psh, ush, rep, rep, rep, ys_sh),
                 donate_argnums=(0, 1, 2),
             )
         return self._jit_cache[key]
@@ -429,10 +445,10 @@ class NetTrainer:
 
             rep, dsh, ex = self._sh()
             psh, _ = self._param_sh()
-            self._jit_cache["grad"] = jax.jit(
+            self._jit_cache["grad"] = self._jit(
                 jax.value_and_grad(loss_fn, has_aux=True),
-                in_shardings=(psh, rep, dsh, dsh, dsh, rep, rep, ex),
-                out_shardings=((rep, rep), psh),
+                (psh, rep, dsh, dsh, dsh, rep, rep, ex),
+                ((rep, rep), psh),
             )
         return self._jit_cache["grad"]
 
@@ -452,10 +468,10 @@ class NetTrainer:
 
             rep, dsh, ex = self._sh()
             psh, _ = self._param_sh()
-            self._jit_cache["fwd_train"] = jax.jit(
+            self._jit_cache["fwd_train"] = self._jit(
                 f,
-                in_shardings=(psh, rep, dsh, dsh, dsh, rep, rep, ex),
-                out_shardings=(rep, dsh, rep, psh),
+                (psh, rep, dsh, dsh, dsh, rep, rep, ex),
+                (rep, dsh, rep, psh),
             )
         return self._jit_cache["fwd_train"]
 
@@ -472,8 +488,8 @@ class NetTrainer:
 
             rep, dsh, ex = self._sh()
             psh, _ = self._param_sh()
-            self._jit_cache["eval"] = jax.jit(
-                f, in_shardings=(psh, rep, dsh, ex), out_shardings=dsh
+            self._jit_cache["eval"] = self._jit(
+                f, (psh, rep, dsh, ex), dsh
             )
         return self._jit_cache["eval"]
 
@@ -490,8 +506,8 @@ class NetTrainer:
 
             rep, dsh, ex = self._sh()
             psh, _ = self._param_sh()
-            self._jit_cache[key] = jax.jit(
-                f, in_shardings=(psh, rep, dsh, ex), out_shardings=dsh
+            self._jit_cache[key] = self._jit(
+                f, (psh, rep, dsh, ex), dsh
             )
         return self._jit_cache[key]
 
@@ -505,10 +521,10 @@ class NetTrainer:
 
             rep = self._sh()[0]
             psh, ush = self._param_sh()
-            self._jit_cache["apply"] = jax.jit(
+            self._jit_cache["apply"] = self._jit(
                 f,
-                in_shardings=(psh, ush, psh, rep),
-                out_shardings=(psh, ush),
+                (psh, ush, psh, rep),
+                (psh, ush),
             )
         return self._jit_cache["apply"]
 

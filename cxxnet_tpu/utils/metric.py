@@ -159,19 +159,28 @@ class MetricSet:
         """labels: (N, label_width); label_ranges: field → column span."""
         if labels.ndim == 1:
             labels = labels[:, None]
-        if pred.ndim == 3:
-            # per-position sequence predictions (N, T, V) — language
-            # models: score each position as an instance, label column
-            # t is the target for position t
-            n, t, v = pred.shape
-            pred = pred.reshape(n * t, v)
-            labels = labels[:, :t].reshape(n * t, 1)
-            label_ranges = {f: (0, 1) for f in label_ranges}
         for mt, field in zip(self.metrics, self.fields):
             if field not in label_ranges:
                 raise ValueError(f"Metric: unknown target = {field}")
             a, b = label_ranges[field]
-            mt.add_eval(pred, labels[:, a:b])
+            if pred.ndim == 3:
+                # per-position sequence predictions (N, T, V) — language
+                # models: score each position as an instance; the
+                # metric's field must span exactly the T positions
+                # (label_vec[a,a+T) = field)
+                n, t, v = pred.shape
+                if b - a != t:
+                    raise ValueError(
+                        f"Metric[{field}]: sequence predictions with T={t}"
+                        f" positions need a label field of width {t}, got"
+                        f" columns [{a},{b})"
+                    )
+                mt.add_eval(
+                    pred.reshape(n * t, v),
+                    labels[:, a:b].reshape(n * t, 1),
+                )
+            else:
+                mt.add_eval(pred, labels[:, a:b])
 
     def print(self, evname: str) -> str:
         out = []

@@ -196,3 +196,76 @@ def test_lm_trains_and_generates(corpus):
         ctx.append(int(np.argmax(probs)))
     text = bytes(ctx[20:]).decode("utf-8", "replace")
     assert "jumps over" in text, f"unexpected continuation: {text!r}"
+
+
+def test_text_iterator_round_batch_pads_final(corpus):
+    it = _text_iter(corpus, seq_len=16, batch_size=64)
+    n_windows = len(it._starts)
+    it.before_first()
+    total = 0
+    last = None
+    while it.next():
+        last = it.value()
+        total += last.batch_size - last.num_batch_padd
+    assert total == n_windows  # every window served exactly once
+    if n_windows % 64:
+        assert last.num_batch_padd == 64 - n_windows % 64
+        assert last.data.shape == (64, 16)
+    # round_batch = 0 drops the partial batch (mnist-style)
+    it2 = _text_iter(corpus, seq_len=16, batch_size=64, round_batch=0)
+    it2.before_first()
+    total2 = 0
+    while it2.next():
+        total2 += it2.value().batch_size
+    assert total2 == (n_windows // 64) * 64
+
+
+def test_metric_rejects_mismatched_sequence_field():
+    from cxxnet_tpu.utils.metric import MetricSet
+
+    ms = MetricSet()
+    ms.add_metric("error", field="aux")
+    pred = np.zeros((2, 3, 4), np.float32)
+    label = np.zeros((2, 4), np.float32)
+    with pytest.raises(ValueError, match="width 3"):
+        ms.add_eval(pred, label, {"aux": (3, 4)})
+
+
+def test_gen_prompt_file_read_lazily(tmp_path):
+    """A conf naming a missing gen_prompt_file must not break parsing —
+    the file is only read by task=generate."""
+    from cxxnet_tpu.cli import LearnTask
+
+    task = LearnTask()
+    task.set_param("gen_prompt_file", str(tmp_path / "nope.txt"))
+    assert task.gen_prompt_file.endswith("nope.txt")  # stored, not read
+
+
+def test_integer_input_keyed_to_graph_not_position():
+    """bf16 nets keep raw ids in f32 whenever ANY consumer of node 0 is
+    an embedding, regardless of declaration order."""
+    from cxxnet_tpu.nnet.graph import NetGraph
+    from cxxnet_tpu.nnet.net import FunctionalNet
+
+    cfg = [
+        ("batch_size", "2"),
+        ("input_shape", "1,1,4"),
+        ("compute_dtype", "bfloat16"),
+        ("netconfig", "start"),
+        # a non-embedding layer declared FIRST, also reading node 0
+        ("layer[0->aux]", "fullc:aux"),
+        ("nhidden", "3"),
+        ("layer[0->emb]", "embedding:embed"),
+        ("nvocab", "300"),
+        ("nhidden", "3"),
+        ("layer[emb->pool]", "seq_pool"),
+        ("layer[aux,pool->sum]", "eltwise_sum"),
+        ("layer[sum->fc]", "fullc:fc"),
+        ("nhidden", "2"),
+        ("layer[fc->fc]", "softmax"),
+        ("netconfig", "end"),
+    ]
+    g = NetGraph()
+    g.configure(cfg)
+    net = FunctionalNet(g)
+    assert net._node0_wants_ints()
