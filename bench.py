@@ -128,6 +128,40 @@ iter = end
               f"+ augment + h2d", file=sys.stderr, flush=True)
 
 
+def bench_lm(batch: int, seq_len: int, scan_k: int) -> None:
+    """``--lm`` mode: transformer-LM training throughput (stderr only —
+    the stdout JSON stays the BASELINE GoogLeNet metric).  d512 h8 L4
+    bf16, flash attention, device-side multi-step scan."""
+    import jax
+
+    from cxxnet_tpu import config as cfgmod
+    from cxxnet_tpu.models import transformer_lm_conf
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    conf = transformer_lm_conf(
+        seq_len=seq_len, dim=512, nhead=8, nlayer=4, batch_size=batch,
+        dev="tpu", compute_dtype="bfloat16",
+    )
+    tr = NetTrainer()
+    tr.set_params(cfgmod.parse_pairs(conf))
+    tr.eval_train = 0
+    tr.init_model()
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, 255, (scan_k, batch, seq_len)).astype(np.float32)
+    labels = rng.randint(0, 255, (scan_k, batch, seq_len)).astype(np.float32)
+    tr.update_scan(data, labels)
+    jax.block_until_ready(tr.params)
+    t0 = time.perf_counter()
+    tr.update_scan(data, labels)
+    jax.block_until_ready(tr.params)
+    dt = (time.perf_counter() - t0) / scan_k
+    print(
+        f"# bench[lm]: T={seq_len} b{batch} d512 L4: {dt*1e3:.1f} ms/step "
+        f"= {batch*seq_len/dt/1e3:.0f}k tokens/s/chip",
+        file=sys.stderr, flush=True,
+    )
+
+
 def main() -> None:
     import jax
 
@@ -136,13 +170,18 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
-    args = [a for a in sys.argv[1:] if a != "--io"]
+    args = [a for a in sys.argv[1:] if a not in ("--io", "--lm")]
     io_mode = "--io" in sys.argv[1:]
+    lm_mode = "--lm" in sys.argv[1:]
     batch = int(args[0]) if len(args) > 0 else 128
     scan_k = int(args[1]) if len(args) > 1 else 50
     n_scans = int(args[2]) if len(args) > 2 else 3
     if io_mode:
         bench_io(batch, min(scan_k, 10))
+        return
+    if lm_mode:
+        bench_lm(batch=batch if batch != 128 else 8, seq_len=2048,
+                 scan_k=min(scan_k, 20))
         return
 
     from __graft_entry__ import _build_googlenet

@@ -189,8 +189,105 @@ class AdagradUpdater(Updater):
         return w - lr * g / (jnp.sqrt(v) + self.eps), {"v": v}
 
 
+class LARSUpdater(Updater):
+    """LARS (You et al. 2017): momentum SGD with a layer-wise trust
+    ratio ``trust_coeff * ||w|| / (||g + wd*w|| + eps)`` scaling the
+    learning rate (the wd-folded form doc/updater.md documents).
+
+    New scope for large-batch data-parallel training (the natural
+    companion of ``update_period`` gradient accumulation and big
+    meshes); same clip/wd/schedule conventions as ``sgd``.
+    """
+
+    type_name = "lars"
+
+    def __init__(self, tag: str) -> None:
+        super().__init__(tag)
+        self.trust_coeff = 0.001
+        self.eps = 1e-9
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "trust_coeff":
+            self.trust_coeff = float(val)
+        elif name == "eps":
+            self.eps = float(val)
+        else:
+            super().set_param(name, val)
+
+    def init_state(self, w):
+        return {"m": jnp.zeros_like(w)}
+
+    def apply(self, w, g, state, epoch):
+        p = self.param
+        lr = p.learning_rate(epoch).astype(w.dtype)
+        mom = p.momentum_at(epoch).astype(w.dtype)
+        if p.clip_gradient != 0.0:
+            g = _nan_clip(g, p.clip_gradient)
+        g = g + p.wd * w
+        wn = jnp.sqrt(jnp.sum(w.astype(jnp.float32) ** 2))
+        gn = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        trust = jnp.where(
+            (wn > 0) & (gn > 0),
+            self.trust_coeff * wn / (gn + self.eps),
+            1.0,
+        ).astype(w.dtype)
+        m = mom * state["m"] - lr * trust * g
+        return w + m, {"m": m}
+
+
+class LAMBUpdater(Updater):
+    """LAMB (You et al. 2019): Adam statistics with a per-layer trust
+    ratio — the large-batch optimizer for transformer stacks.
+
+    Conventional ``beta1/beta2`` (0.9 / 0.999 defaults — NOT the
+    reference-adam decay spelling); ``wd`` is decoupled (AdamW-style,
+    added to the normalized update, not the gradient).
+    """
+
+    type_name = "lamb"
+
+    def __init__(self, tag: str) -> None:
+        super().__init__(tag)
+        self.beta1 = 0.9
+        self.beta2 = 0.999
+        self.eps = 1e-6
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "beta1":
+            self.beta1 = float(val)
+        elif name == "beta2":
+            self.beta2 = float(val)
+        elif name == "eps":
+            self.eps = float(val)
+        else:
+            super().set_param(name, val)
+
+    def init_state(self, w):
+        return {"m1": jnp.zeros_like(w), "m2": jnp.zeros_like(w)}
+
+    def apply(self, w, g, state, epoch):
+        p = self.param
+        lr = p.learning_rate(epoch).astype(jnp.float32)
+        if p.clip_gradient != 0.0:
+            g = _nan_clip(g, p.clip_gradient)
+        gf = g.astype(jnp.float32)
+        t = jnp.asarray(epoch, jnp.float32) + 1.0
+        m1 = self.beta1 * state["m1"] + (1.0 - self.beta1) * gf
+        m2 = self.beta2 * state["m2"] + (1.0 - self.beta2) * gf * gf
+        u = (m1 / (1.0 - self.beta1 ** t)) / (
+            jnp.sqrt(m2 / (1.0 - self.beta2 ** t)) + self.eps
+        )
+        u = u + p.wd * w.astype(jnp.float32)
+        wn = jnp.sqrt(jnp.sum(w.astype(jnp.float32) ** 2))
+        un = jnp.sqrt(jnp.sum(u ** 2))
+        trust = jnp.where((wn > 0) & (un > 0), wn / un, 1.0)
+        w = w - (lr * trust * u).astype(w.dtype)
+        return w, {"m1": m1, "m2": m2}
+
+
 _UPDATERS = {"sgd": SGDUpdater, "nag": NAGUpdater, "adam": AdamUpdater,
-             "rmsprop": RMSPropUpdater, "adagrad": AdagradUpdater}
+             "rmsprop": RMSPropUpdater, "adagrad": AdagradUpdater,
+             "lars": LARSUpdater, "lamb": LAMBUpdater}
 
 
 def create_updater(type_name: str, tag: str) -> Updater:

@@ -269,3 +269,49 @@ def test_integer_input_keyed_to_graph_not_position():
     g.configure(cfg)
     net = FunctionalNet(g)
     assert net._node0_wants_ints()
+
+
+@pytest.mark.slow
+def test_lm_seq_parallel_fsdp_matches_single(corpus):
+    """The LM composed with Ulysses SP over the model axis AND ZeRO-3
+    param sharding over the data axis trains the same weights as a
+    single device — the full new-scope stack in one net."""
+    results = {}
+    for mode in ("single", "sharded"):
+        conf = transformer_lm_conf(
+            seq_len=32, dim=32, nhead=2, nlayer=1, text_file=corpus,
+            batch_size=16, dev="cpu" if mode == "single" else "cpu:0-7",
+            compute_dtype="float32",
+            seq_parallel=0 if mode == "single" else 2,
+        )
+        pairs = cfgmod.parse_pairs(conf)
+        it = create_iterator(
+            cfgmod.split_sections(pairs).find("data")[0].entries
+        )
+        it.set_param("batch_size", "16")
+        it.set_param("silent", "1")
+        it.init()
+        tr = NetTrainer()
+        tr.set_params(pairs)
+        if mode == "sharded":
+            tr.set_param("model_parallel", "2")
+            tr.set_param("zero", "3")
+        tr.init_model()
+        it.before_first()
+        steps = 0
+        while it.next() and steps < 6:
+            tr.update(it.value())
+            steps += 1
+        results[mode] = {
+            k: {t: np.asarray(v) for t, v in tags.items()}
+            for k, tags in tr.params.items()
+        }
+        if mode == "sharded":
+            assert tr.mesh_plan.n_model == 2 and tr.mesh_plan.n_data == 4
+    for key in results["single"]:
+        for tag in results["single"][key]:
+            np.testing.assert_allclose(
+                results["sharded"][key][tag], results["single"][key][tag],
+                rtol=2e-4, atol=2e-5,
+                err_msg=f"{key}/{tag} diverged under SP+FSDP",
+            )

@@ -208,3 +208,79 @@ def test_rmsprop_trains_end_to_end():
         first = err if first is None else first
         last = err
     assert last <= 0.25 and last <= first
+
+
+def test_lars_matches_reference_recurrence():
+    up = create_updater("lars", "wmat")
+    up.set_param("lr", "0.1")
+    up.set_param("momentum", "0.9")
+    up.set_param("wd", "0.01")
+    up.set_param("trust_coeff", "0.02")
+    w = jnp.asarray([1.0, -2.0])
+    st = up.init_state(w)
+    g = jnp.asarray([0.5, -0.25])
+    m = np.zeros(2)
+    wr = np.array([1.0, -2.0])
+    for t in range(4):
+        w, st = up.apply(w, g, st, jnp.asarray(t))
+        gr = np.asarray(g) + 0.01 * wr
+        wn = np.linalg.norm(wr)
+        gn = np.linalg.norm(gr)
+        trust = 0.02 * wn / (gn + 1e-9)
+        m = 0.9 * m - 0.1 * trust * gr
+        wr = wr + m
+        np.testing.assert_allclose(np.asarray(w), wr, rtol=1e-5)
+
+
+def test_lamb_matches_reference_recurrence():
+    up = create_updater("lamb", "wmat")
+    up.set_param("lr", "0.01")
+    up.set_param("wd", "0.1")
+    w = jnp.asarray([1.0, -2.0])
+    st = up.init_state(w)
+    g = jnp.asarray([0.5, -0.25])
+    m1 = np.zeros(2)
+    m2 = np.zeros(2)
+    wr = np.array([1.0, -2.0])
+    for t in range(4):
+        w, st = up.apply(w, g, st, jnp.asarray(t))
+        m1 = 0.9 * m1 + 0.1 * np.asarray(g)
+        m2 = 0.999 * m2 + 0.001 * np.asarray(g) ** 2
+        u = (m1 / (1 - 0.9 ** (t + 1))) / (
+            np.sqrt(m2 / (1 - 0.999 ** (t + 1))) + 1e-6
+        )
+        u = u + 0.1 * wr
+        trust = np.linalg.norm(wr) / np.linalg.norm(u)
+        wr = wr - 0.01 * trust * u
+        np.testing.assert_allclose(np.asarray(w), wr, rtol=1e-5)
+
+
+def test_lamb_trains_end_to_end():
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    cfg = [
+        ("dev", "cpu"),
+        ("batch_size", "16"),
+        ("input_shape", "1,1,8"),
+        ("updater", "lamb"),
+        ("eta", "0.05"),
+        ("wd", "0.0"),
+        ("netconfig", "start"),
+        ("layer[0->1]", "fullc:fc"),
+        ("nhidden", "4"),
+        ("layer[1->1]", "softmax"),
+        ("netconfig", "end"),
+    ]
+    tr = NetTrainer()
+    tr.set_params(cfg)
+    tr.init_model()
+    rng = np.random.RandomState(0)
+    data = rng.randn(16, 8).astype(np.float32)
+    labels = rng.randint(0, 4, (16, 1)).astype(np.float32)
+    last = None
+    for _ in range(80):
+        tr.update_all(data, labels)
+        out = tr.predict(DataBatch(data=data, label=labels))
+        last = (np.asarray(out).ravel() != labels.ravel()).mean()
+    assert last <= 0.25
