@@ -173,14 +173,15 @@ def main() -> None:
     args = [a for a in sys.argv[1:] if a not in ("--io", "--lm")]
     io_mode = "--io" in sys.argv[1:]
     lm_mode = "--lm" in sys.argv[1:]
-    batch = int(args[0]) if len(args) > 0 else 128
+    batch_given = len(args) > 0
+    batch = int(args[0]) if batch_given else 128
     scan_k = int(args[1]) if len(args) > 1 else 50
     n_scans = int(args[2]) if len(args) > 2 else 3
     if io_mode:
         bench_io(batch, min(scan_k, 10))
         return
     if lm_mode:
-        bench_lm(batch=batch if batch != 128 else 8, seq_len=2048,
+        bench_lm(batch=batch if batch_given else 8, seq_len=2048,
                  scan_k=min(scan_k, 20))
         return
 

@@ -69,6 +69,7 @@ class NetTrainer:
         self.model_parallel = 1
         self.update_on_server = 0
         self.zero = 0
+        self.save_ustate = 0
         self.mesh_plan: Optional[MeshPlan] = None
         self.aux = {}  # non-gradient layer state (BN running stats)
         self.metric = MetricSet()
@@ -97,6 +98,12 @@ class NetTrainer:
             # reference: SGD runs on the PS (nnet_ps_server.cpp); here the
             # optimizer state is ZeRO-1-sharded over the data axis instead
             self.update_on_server = int(val)
+        elif name == "save_ustate":
+            # opt-in exact resume: checkpoint updater state (momentum /
+            # adam moments) too.  Default 0 keeps reference parity —
+            # "Updater state is NOT checkpointed; resume restarts
+            # momentum from zero" (SURVEY §5 checkpoint notes)
+            self.save_ustate = int(val)
         elif name in ("zero", "fsdp"):
             # zero = 1: optimizer state sharded over the data axis
             # (update_on_server's modern spelling); zero = 3 / fsdp = 1:
@@ -815,13 +822,19 @@ class NetTrainer:
         npz = np.load(_io.BytesIO(blob))
         params: Dict[str, dict] = {}
         aux: Dict[str, dict] = {}
+        ust: Dict[str, dict] = {}
         for k in npz.files:
             key, tag = k.rsplit("/", 1)
-            if key.startswith("aux:"):
+            if key.startswith("ust:"):
+                tagname, slot = tag.split("@", 1)
+                ust.setdefault(key[4:], {}).setdefault(tagname, {})[
+                    slot
+                ] = npz[k]
+            elif key.startswith("aux:"):
                 aux.setdefault(key[4:], {})[tag] = npz[k]
             else:
                 params.setdefault(key, {})[tag] = npz[k]
-        return header, params, aux
+        return header, params, aux, ust
 
     def save_model(self, path: str) -> None:
         header = {
@@ -837,6 +850,11 @@ class NetTrainer:
         for key, tags in self.aux.items():
             for tag, w in tags.items():
                 flat[f"aux:{key}/{tag}"] = fetch_array(w)
+        if self.save_ustate:
+            for key, tags in self.ustates.items():
+                for tag, slots in tags.items():
+                    for slot, w in slots.items():
+                        flat[f"ust:{key}/{tag}@{slot}"] = fetch_array(w)
         np.savez(buf, **flat)
         with open(path, "wb") as f:
             f.write(MODEL_MAGIC)
@@ -845,7 +863,7 @@ class NetTrainer:
             f.write(buf.getvalue())
 
     def load_model(self, path: str) -> None:
-        header, raw, raw_aux = self._read_model_file(path)
+        header, raw, raw_aux, raw_ust = self._read_model_file(path)
         graph = NetGraph.structure_from_json(json.dumps(header["structure"]))
         self._build_net(graph)
         self._build_mesh()
@@ -863,12 +881,28 @@ class NetTrainer:
                 self.aux[key] = {t: jnp.asarray(w) for t, w in tags.items()}
         self.net.infer_shapes(self.batch_size)
         self._build_updaters()
+        # exact resume (save_ustate=1 checkpoints): restore momentum /
+        # adam moments where shapes match the rebuilt updaters
+        for key, tags in raw_ust.items():
+            if key not in self.ustates:
+                continue
+            for tag, slots in tags.items():
+                cur = self.ustates[key].get(tag)
+                if cur is None:
+                    continue
+                if set(slots) == set(cur) and all(
+                    slots[sl].shape == np.asarray(cur[sl]).shape
+                    for sl in slots
+                ):
+                    self.ustates[key][tag] = {
+                        sl: jnp.asarray(w) for sl, w in slots.items()
+                    }
 
     def copy_model_from(self, path: str) -> None:
         """Finetune: fresh init, then copy name-matched layers' weights
         (nnet_impl-inl.hpp:101-134); epoch restarts at 0."""
         self.init_model()
-        header, old_params, _old_aux = self._read_model_file(path)
+        header, old_params, _old_aux, _old_ust = self._read_model_file(path)
         old = NetGraph.structure_from_json(json.dumps(header["structure"]))
         old_keys = {}
         for i, spec in enumerate(old.layers):

@@ -487,3 +487,62 @@ def test_update_scan_requires_update_period_1():
     x, y = toy_data(16)
     with pytest.raises(ValueError, match="update_period"):
         tr.update_scan(x, y, n_steps=2)
+
+
+def test_save_ustate_exact_resume(tmp_path):
+    """save_ustate=1 checkpoints momentum; load restores it bit-exact,
+    so a resumed run continues identically. Default keeps the reference
+    quirk (momentum NOT saved, restarts from zero)."""
+    import numpy as np
+
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    cfg = [
+        ("dev", "cpu"), ("batch_size", "8"), ("input_shape", "1,1,6"),
+        ("eta", "0.1"), ("momentum", "0.9"),
+        ("netconfig", "start"),
+        ("layer[0->1]", "fullc:fc"), ("nhidden", "4"),
+        ("layer[1->1]", "softmax"),
+        ("netconfig", "end"),
+    ]
+    rng = np.random.RandomState(0)
+    data = rng.randn(6, 8, 6).astype(np.float32)
+    labels = rng.randint(0, 4, (6, 8, 1)).astype(np.float32)
+
+    def train(tr, lo, hi):
+        for i in range(lo, hi):
+            tr.update_all(data[i], labels[i])
+
+    # continuous run = ground truth
+    t_full = NetTrainer(); t_full.set_params(cfg); t_full.init_model()
+    train(t_full, 0, 6)
+
+    # save at step 3 WITH ustate, resume, finish
+    t_a = NetTrainer(); t_a.set_params(cfg)
+    t_a.set_param("save_ustate", "1")
+    t_a.init_model()
+    train(t_a, 0, 3)
+    ck = str(tmp_path / "m.model")
+    t_a.save_model(ck)
+    t_b = NetTrainer(); t_b.set_params(cfg)
+    t_b.load_model(ck)
+    st = t_b.ustates["l0_fc"]["wmat"]
+    assert float(np.abs(np.asarray(st["m"])).max()) > 0  # momentum restored
+    train(t_b, 3, 6)
+    for tag in t_full.params["l0_fc"]:
+        np.testing.assert_allclose(
+            np.asarray(t_b.params["l0_fc"][tag]),
+            np.asarray(t_full.params["l0_fc"][tag]),
+            rtol=1e-5, atol=1e-6,
+            err_msg=f"exact resume diverged on {tag}",
+        )
+
+    # default: momentum NOT saved (reference parity)
+    t_c = NetTrainer(); t_c.set_params(cfg); t_c.init_model()
+    train(t_c, 0, 3)
+    ck2 = str(tmp_path / "m2.model")
+    t_c.save_model(ck2)
+    t_d = NetTrainer(); t_d.set_params(cfg)
+    t_d.load_model(ck2)
+    st = t_d.ustates["l0_fc"]["wmat"]
+    assert float(np.abs(np.asarray(st["m"])).max()) == 0
