@@ -20,7 +20,9 @@ Semantics preserved:
   ``epoch_counter`` (number of applied updates — the updaters' schedule
   clock) advances once per ``update_period`` micro-batches.
 * checkpoint = net structure + epoch counter + weights; updater state is
-  NOT saved (reference behavior — momentum restarts on resume).
+  NOT saved by default (reference behavior — momentum restarts on
+  resume); ``save_ustate = 1`` opts into exact resume (momentum/adam
+  moments + the training RNG key ride along in the blob).
 * ``CopyModelFrom`` copies name-matched layers only, resets the epoch.
 * prediction output is argmax (multi-column) or the raw scalar.
 """
@@ -811,7 +813,10 @@ class NetTrainer:
     # checkpointing: magic | json header | npz params
     @staticmethod
     def _read_model_file(path: str):
-        """Parse a checkpoint → (header dict, {param_key: {tag: ndarray}})."""
+        """Parse a checkpoint → (header, params, aux, ustates) where
+        params/aux are ``{key: {tag: ndarray}}`` and ustates (present
+        only for ``save_ustate=1`` checkpoints) is
+        ``{key: {tag: {slot: ndarray}}}``."""
         with open(path, "rb") as f:
             magic = f.read(8)
             if magic != MODEL_MAGIC:
@@ -841,6 +846,12 @@ class NetTrainer:
             "structure": json.loads(self.graph.structure_to_json()),
             "epoch_counter": self.epoch_counter,
         }
+        if self.save_ustate and self._rng_key is not None:
+            # exact resume includes the training rng stream (dropout /
+            # insanity noise), not just optimizer state
+            header["rng_key"] = np.asarray(
+                jax.random.key_data(self._rng_key)
+            ).tolist()
         hjson = json.dumps(header).encode("utf-8")
         buf = _io.BytesIO()
         flat = {}
@@ -870,7 +881,13 @@ class NetTrainer:
         self._bind_mesh_to_layers()
         self.epoch_counter = int(header["epoch_counter"])
         self.sample_counter = 0
-        self._rng_key = jax.random.PRNGKey(self.seed + 1)
+        self._grad_accum = None  # drop any half-window from before load
+        if "rng_key" in header:
+            self._rng_key = jax.random.wrap_key_data(
+                jnp.asarray(header["rng_key"], jnp.uint32)
+            )
+        else:
+            self._rng_key = jax.random.PRNGKey(self.seed + 1)
         self.params = {
             key: {tag: jnp.asarray(w) for tag, w in tags.items()}
             for key, tags in raw.items()
@@ -891,8 +908,7 @@ class NetTrainer:
                 if cur is None:
                     continue
                 if set(slots) == set(cur) and all(
-                    slots[sl].shape == np.asarray(cur[sl]).shape
-                    for sl in slots
+                    slots[sl].shape == cur[sl].shape for sl in slots
                 ):
                     self.ustates[key][tag] = {
                         sl: jnp.asarray(w) for sl, w in slots.items()

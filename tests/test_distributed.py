@@ -106,6 +106,32 @@ def test_two_process_training_weights_identical(tmp_path):
     assert np.abs(w0).max() > 0
 
 
+def _run_cli_dist(tmp_path, conf, port, nproc=2, ndev=2, timeout=300):
+    """Launch nproc CLI processes on one conf (the dist.conf procedure)
+    and return their per-rank working dirs after asserting success."""
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={ndev}",
+    }
+    procs, dirs = [], []
+    for r in range(nproc):
+        d = tmp_path / f"p{r}"
+        d.mkdir()
+        dirs.append(d)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "cxxnet_tpu", str(conf),
+             f"dist_coordinator=localhost:{port}", f"dist_proc_id={r}"],
+            env=env, cwd=str(d),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        ))
+    outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, o.decode()
+    return dirs
+
+
 @pytest.mark.slow
 def test_two_process_cli_dist_conf(tmp_path):
     """The dist.conf launch procedure end-to-end: 2 CLI processes share
@@ -147,25 +173,64 @@ eta = 0.1
 metric = error
 silent = 1
 """)
-    env = {
-        **os.environ,
-        "PYTHONPATH": REPO,
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-    }
-    procs = []
-    for r in range(2):
-        d = tmp_path / f"p{r}"
-        d.mkdir()
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "cxxnet_tpu", str(conf),
-             f"dist_coordinator=localhost:{port}", f"dist_proc_id={r}"],
-            env=env, cwd=str(d),
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        ))
-    outs = [p.communicate(timeout=300)[0] for p in procs]
-    for p, o in zip(procs, outs):
-        assert p.returncode == 0, o.decode()
+    _run_cli_dist(tmp_path, conf, port)
     m0 = (tmp_path / "p0" / "models" / "0002.model").read_bytes()
     m1 = (tmp_path / "p1" / "models" / "0002.model").read_bytes()
     assert m0 == m1  # same weights on every process
+
+
+@pytest.mark.slow
+def test_two_process_cli_lm_dist_conf(tmp_path):
+    """example/lm/dist.conf's procedure: 2 CLI processes train the byte
+    LM with the text iterator sharding windows by rank, FSDP (zero=3)
+    param sharding, and per-position labels — identical checkpoints on
+    both processes."""
+    (tmp_path / "corpus.txt").write_bytes(
+        ("the quick brown fox jumps over the lazy dog. " * 80).encode()
+    )
+    port = _free_port()
+    conf = tmp_path / "lm_dist.conf"
+    conf.write_text(f"""
+dist_num_proc = 2
+zero = 3
+data = train
+iter = text
+  filename = "{tmp_path}/corpus.txt"
+  seq_len = 16
+  shuffle = 1
+iter = end
+netconfig = start
+layer[0->emb] = embedding:embed
+  nvocab = 256
+  nhidden = 32
+  pos = learned
+  init_sigma = 0.02
+layer[emb->a] = attention:attn
+  nhead = 2
+  causal = 1
+  init_sigma = 0.02
+layer[emb,a->r] = eltwise_sum
+layer[r->nf] = layer_norm:ln_f
+layer[nf->logits] = fullc:lm_head
+  nhidden = 256
+  init_sigma = 0.02
+layer[logits->logits] = softmax
+  grad_scale = 0.0625
+netconfig = end
+input_shape = 1,1,16
+label_width = 16
+label_vec[0,16) = label
+batch_size = 16
+dev = cpu
+num_round = 2
+updater = adam
+eta = 0.003
+wd = 0.0
+eval_train = 0
+metric = error
+silent = 1
+""")
+    _run_cli_dist(tmp_path, conf, port)
+    m0 = (tmp_path / "p0" / "models" / "0002.model").read_bytes()
+    m1 = (tmp_path / "p1" / "models" / "0002.model").read_bytes()
+    assert m0 == m1

@@ -315,3 +315,44 @@ def test_lm_seq_parallel_fsdp_matches_single(corpus):
                 rtol=2e-4, atol=2e-5,
                 err_msg=f"{key}/{tag} diverged under SP+FSDP",
             )
+
+
+def test_lm_gradient_accumulation_matches_big_batch(corpus):
+    """update_period=2 with per-position sequence labels equals one
+    double-size batch (the accumulation path must handle (N,T) labels)."""
+    conf = transformer_lm_conf(
+        seq_len=16, dim=32, nhead=2, nlayer=1, text_file=corpus,
+        batch_size=8, dev="cpu", compute_dtype="float32",
+    )
+    pairs = cfgmod.parse_pairs(conf)
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, 255, (16, 16)).astype(np.float32)
+    labels = rng.randint(0, 255, (16, 16)).astype(np.float32)
+
+    # accumulated: two micro-batches of 8 per update
+    t_acc = NetTrainer()
+    t_acc.set_params(pairs)
+    t_acc.set_param("update_period", "2")
+    t_acc.init_model()
+    t_acc.update_all(data[:8], labels[:8])
+    t_acc.update_all(data[8:], labels[8:])
+
+    # one batch of 16 with halved per-token scale (grad_scale already
+    # divides by batch*update_period — the semantics under test)
+    conf2 = transformer_lm_conf(
+        seq_len=16, dim=32, nhead=2, nlayer=1, text_file=corpus,
+        batch_size=16, dev="cpu", compute_dtype="float32",
+    )
+    t_big = NetTrainer()
+    t_big.set_params(cfgmod.parse_pairs(conf2))
+    t_big.init_model()
+    t_big.update_all(data, labels)
+
+    for key in t_big.params:
+        for tag in t_big.params[key]:
+            np.testing.assert_allclose(
+                np.asarray(t_acc.params[key][tag]),
+                np.asarray(t_big.params[key][tag]),
+                rtol=2e-4, atol=2e-5,
+                err_msg=f"{key}/{tag}: accumulation != big batch",
+            )

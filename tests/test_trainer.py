@@ -493,15 +493,14 @@ def test_save_ustate_exact_resume(tmp_path):
     """save_ustate=1 checkpoints momentum; load restores it bit-exact,
     so a resumed run continues identically. Default keeps the reference
     quirk (momentum NOT saved, restarts from zero)."""
-    import numpy as np
-
-    from cxxnet_tpu.nnet.trainer import NetTrainer
-
+    # dropout included: exact resume must continue the SAME rng stream
+    # (the checkpoint carries the key), not just optimizer state
     cfg = [
         ("dev", "cpu"), ("batch_size", "8"), ("input_shape", "1,1,6"),
         ("eta", "0.1"), ("momentum", "0.9"),
         ("netconfig", "start"),
         ("layer[0->1]", "fullc:fc"), ("nhidden", "4"),
+        ("layer[1->1]", "dropout"), ("threshold", "0.3"),
         ("layer[1->1]", "softmax"),
         ("netconfig", "end"),
     ]
