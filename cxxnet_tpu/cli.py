@@ -110,7 +110,7 @@ class LearnTask:
 
         maybe_init_distributed(self.cfg)
         if self.task not in ("train", "finetune", "pred", "pred_raw",
-                             "extract", "generate"):
+                             "extract", "generate", "summary"):
             raise ValueError(f"unknown task {self.task!r}")
         self.init()
         if not self.silent:
@@ -123,6 +123,8 @@ class LearnTask:
             self.task_extract()
         elif self.task == "generate":
             self.task_generate()
+        elif self.task == "summary":
+            self.task_summary()
         else:
             raise ValueError(f"unknown task {self.task!r}")
         return 0
@@ -145,7 +147,7 @@ class LearnTask:
             )
         self.continue_training = 0
         if self.name_model_in == "NULL":
-            if self.task != "train":
+            if self.task not in ("train", "summary"):
                 raise ValueError("must specify model_in if not training")
             self.net_trainer = self._create_trainer()
             self.net_trainer.init_model()
@@ -197,12 +199,14 @@ class LearnTask:
         split = cfgmod.split_sections(self.cfg)
         for sec in split.sections:
             if sec.kind == "data" and self.task not in ("pred", "pred_raw",
-                                                        "generate"):
+                                                        "generate",
+                                                        "summary"):
                 if self.itr_train is not None:
                     raise ValueError("can only have one data section")
                 self.itr_train = create_iterator(sec.entries)
             elif sec.kind == "eval" and self.task not in ("pred", "pred_raw",
-                                                          "generate"):
+                                                          "generate",
+                                                          "summary"):
                 self.itr_evals.append(create_iterator(sec.entries))
                 self.eval_names.append(sec.tag)
             elif sec.kind == "pred":
@@ -392,6 +396,38 @@ class LearnTask:
                         else:
                             fo.write(f"{v:g}\n")
         print(f"finished prediction, write into {self.name_pred}")
+
+    def task_summary(self) -> None:
+        """``task=summary``: per-layer table — type, name, output node
+        shapes, parameter counts — plus totals.  Works on a bare conf
+        (no data files needed; batch column shows the conf batch)."""
+        import jax
+
+        tr = self.net_trainer
+        g = tr.graph
+        shapes = tr.net.node_shapes
+        total = 0
+        print(f"{'#':>3} {'layer':22s} {'type':18s} {'out shape':20s} "
+              f"{'params':>12}")
+        for i, spec in enumerate(g.layers):
+            key = tr.net.param_key[i]
+            n_par = 0
+            if spec.type_name != "shared" and key in tr.params:
+                n_par = int(sum(
+                    np.prod(np.shape(w))
+                    for w in jax.tree_util.tree_leaves(tr.params[key])
+                ))
+                total += n_par
+            out = shapes[spec.nindex_out[0]] if spec.nindex_out else ()
+            name = spec.name or ""
+            print(f"{i:>3} {name:22s} {spec.type_name:18s} "
+                  f"{str(tuple(out)):20s} {n_par:>12,}")
+        print(f"{'':66s}{'-' * 12}")
+        print(f"total parameters: {total:,} "
+              f"({total * 4 / 1e6:.1f} MB f32)")
+        if tr.mesh_plan is not None:
+            print(f"mesh: data={tr.mesh_plan.n_data} "
+                  f"model={tr.mesh_plan.n_model} zero={tr.zero}")
 
     def task_generate(self) -> None:
         """``task=generate``: autoregressive byte sampling from a trained

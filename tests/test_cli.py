@@ -368,3 +368,18 @@ def test_scan_steps_trains_identically(tmp_path):
     assert r2.returncode == 0, r2.stderr
     lines2 = [l for l in r2.stderr.splitlines() if l.startswith("[")]
     assert lines1 == lines2, (lines1, lines2)
+
+
+def test_task_summary(tmp_path, capsys):
+    """task=summary prints the per-layer table and totals from a bare
+    conf (no data files, no model_in)."""
+    from cxxnet_tpu import cli as climod
+    from cxxnet_tpu.models import mnist_mlp_conf
+
+    conf = tmp_path / "m.conf"
+    conf.write_text(mnist_mlp_conf(batch_size=4, dev="cpu"))
+    rc = climod.main([str(conf), "task=summary", "silent=1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "total parameters:" in out
+    assert "fullc" in out and "softmax" in out

@@ -126,7 +126,12 @@ def _run_cli_dist(tmp_path, conf, port, nproc=2, ndev=2, timeout=300):
             env=env, cwd=str(d),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         ))
-    outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:
+        for p in procs:  # bound the damage when a rank hangs/fails
+            if p.poll() is None:
+                p.kill()
     for p, o in zip(procs, outs):
         assert p.returncode == 0, o.decode()
     return dirs
