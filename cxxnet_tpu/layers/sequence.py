@@ -48,22 +48,34 @@ def _layer_norm(x, w, b, eps: float):
 _FLASH_OK: dict = {}
 
 
-def _flash_works(t: int, tk: int, dh: int, dtype, causal: bool) -> bool:
+def _flash_works(t: int, tk: int, dh: int, dtype, causal: bool,
+                 ring: bool = False) -> bool:
     """Compile probe so ``attn_impl`` can never take down a run (the
     pool/LRN probe discipline, layers/conv.py): keyed on the static
-    attention geometry, probing fwd AND bwd of the real (T, Dh)."""
-    key = (t, tk, dh, jnp.dtype(dtype).name, causal)
+    attention geometry, probing fwd AND bwd of the real (T, Dh).
+    ``ring=True`` probes the dynamic-offset lse kernel the flash ring
+    uses (per-shard shapes)."""
+    key = (t, tk, dh, jnp.dtype(dtype).name, causal, ring)
     if key not in _FLASH_OK:
         from .conv import _run_probe_untraced
-        from ..ops.flash import flash_mha
+        from ..ops.flash import flash_mha, flash_mha_lse
 
         def probe():
             q = jnp.ones((1, t, 1, dh), dtype)
             k = jnp.ones((1, tk, 1, dh), dtype)
-            jax.grad(
-                lambda a: flash_mha(a, k, k, causal, 512, 512, False)
-                .astype(jnp.float32).sum()
-            )(q).block_until_ready()
+            if ring:
+                def f(a):
+                    o, lse = flash_mha_lse(
+                        a, k, k, jnp.int32(0), jnp.int32(0), causal,
+                        512, 512, False,
+                    )
+                    return o.astype(jnp.float32).sum() + lse.sum() * 1e-3
+            else:
+                def f(a):
+                    return flash_mha(
+                        a, k, k, causal, 512, 512, False
+                    ).astype(jnp.float32).sum()
+            jax.grad(f)(q).block_until_ready()
 
         _FLASH_OK[key] = _run_probe_untraced(probe)
     return _FLASH_OK[key]
@@ -179,17 +191,6 @@ class AttentionLayer(Layer):
             raise ValueError(
                 f"attention: nhead={self.nhead} must divide model dim {d}"
             )
-        if self.seq_parallel == 1 and self.attn_impl == "pallas":
-            # the ring path has its own blockwise streaming softmax; the
-            # flash kernel only slots into full-sequence local attention
-            # (plain or post-all-to-all) — fail loudly rather than
-            # silently measuring the XLA ring under a pallas opt-in
-            raise ValueError(
-                "attention: attn_impl=pallas does not compose with "
-                "seq_parallel=ring (the ring schedule is its own "
-                "streaming kernel); use seq_parallel=alltoall or "
-                "attn_impl=auto"
-            )
         if self.seq_parallel and self.mesh_plan is not None:
             nm = self.mesh_plan.n_model
             if nm > 1 and t % nm != 0:
@@ -237,6 +238,37 @@ class AttentionLayer(Layer):
                 o = a2a_self_attention(
                     q, k, v, plan.mesh, "model", causal=bool(self.causal),
                     attn_fn=self._local_attn(),
+                )
+            elif self.attn_impl == "pallas":
+                # flash ring: per-hop (o, lse) pairs from the fused
+                # kernel, merged in log space (ops/attention).  Same
+                # opt-in discipline as the local pallas path: tiny
+                # per-shard blocks and probe failures raise clearly
+                # instead of surfacing as Mosaic errors mid-training.
+                from ..ops.flash import _pick_block
+                from ..ops.attention import ring_self_attention_flash
+
+                ts = t // plan.n_model  # per-shard sequence length
+                dh = d // h
+                if jax.default_backend() == "tpu":
+                    if _pick_block(ts, 512) < 128:
+                        raise ValueError(
+                            f"attention: seq_parallel=ring "
+                            f"attn_impl=pallas needs per-shard T={ts} "
+                            f"with a block >= 128; use attn_impl=xla "
+                            f"for short shards"
+                        )
+                    if not _flash_works(
+                        ts, ts, dh, q.dtype, bool(self.causal), ring=True
+                    ):
+                        raise RuntimeError(
+                            "attention: attn_impl=pallas requested but "
+                            f"the flash ring kernel probe failed for "
+                            f"T={ts}, Dh={dh}, {q.dtype} on this backend"
+                        )
+                o = ring_self_attention_flash(
+                    q, k, v, plan.mesh, "model", causal=bool(self.causal),
+                    interpret=jax.default_backend() != "tpu",
                 )
             else:
                 o = ring_self_attention(

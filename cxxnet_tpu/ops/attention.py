@@ -215,3 +215,86 @@ def a2a_self_attention(
         mesh, (spec, spec, spec), spec,
     )
     return fn(x_q, x_k, x_v)
+
+
+def ring_attention_flash(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Ring attention whose per-hop block math runs the fused flash
+    kernel (``ops/flash.flash_mha_lse``) instead of XLA einsums.
+
+    Same schedule as :func:`ring_attention` — kv blocks rotate around
+    the ``axis_name`` ring — but each hop computes its ``(o, lse)``
+    pair entirely in VMEM and partial results merge in log space:
+    ``lse' = logaddexp``, outputs reweighted by ``exp(lse - lse')``.
+    The causal mask uses dynamic global offsets (this device's query
+    block start vs the hop's key block start); a hop that is entirely
+    in the future yields ``lse ~ -1e30`` and washes out of the merge.
+    """
+    from .flash import flash_mha_lse
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(o, lse, kb, vb, hop_i):
+        """o carried f32 across hops (the repo's accumulate-in-f32
+        discipline); cast once at the final return."""
+        src = (idx - hop_i) % n
+        o_h, lse_h = flash_mha_lse(
+            q, kb, vb, idx * tq, src * tk, causal, 512, 512, interpret
+        )
+        lse_new = jnp.logaddexp(lse, lse_h)
+        w_old = jnp.exp(lse - lse_new)[:, :, :, None]
+        w_new = jnp.exp(lse_h - lse_new)[:, :, :, None]
+        o2 = o * w_old + o_h.astype(jnp.float32) * w_new
+        return o2, lse_new
+
+    o0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    lse0 = jnp.full((b, tq, h), NEG_INF, jnp.float32)
+    o, lse = hop(o0, lse0, k, v, 0)
+
+    def step(carry, hop_i):
+        o, lse, kb, vb = carry
+        kb, vb = lax.ppermute((kb, vb), axis_name, perm)
+        o, lse = hop(o, lse, kb, vb, hop_i)
+        return (o, lse, kb, vb), None
+
+    if n > 1:
+        (o, lse, _, _), _ = lax.scan(
+            step, (o, lse, k, v), jnp.arange(1, n)
+        )
+    return o.astype(v.dtype)
+
+
+def ring_self_attention_flash(
+    x_q: jnp.ndarray,
+    x_k: jnp.ndarray,
+    x_v: jnp.ndarray,
+    mesh,
+    seq_axis: str = "model",
+    *,
+    causal: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """shard_map wrapper mirroring ``ring_self_attention`` with the
+    flash per-hop kernel."""
+    from jax.sharding import PartitionSpec as P
+
+    from ._compat import shard_map_nocheck
+
+    spec = P("data", seq_axis, None, None)
+    fn = shard_map_nocheck(
+        functools.partial(ring_attention_flash, axis_name=seq_axis,
+                          causal=causal, interpret=interpret),
+        mesh, (spec, spec, spec), spec,
+    )
+    return fn(x_q, x_k, x_v)

@@ -53,8 +53,8 @@ def _mask(tq: int, tk: int, q_off, k_off):
     return qi >= ki
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
-                *, bq, bk, causal, scale):
+def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc, m, l, *, bq, bk, causal, dyn, scale):
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
@@ -68,8 +68,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
         l[:] = jnp.zeros_like(l)
 
     # causal: the block is live iff its first key position can be seen
-    # by the block's last query position
-    live = (iq * bq + bq - 1 >= ik * bk) if causal else True
+    # by its last query position (the ~2x FLOP saving).  With dynamic
+    # offsets the predicate reads the SMEM scalars — pl.when accepts
+    # traced conditions, so a fully-future ring hop skips all compute.
+    if not causal:
+        live = True
+    elif dyn:
+        live = (qo_ref[0, 0] + iq * bq + bq - 1
+                >= ko_ref[0, 0] + ik * bk)
+    else:
+        live = iq * bq + bq - 1 >= ik * bk
 
     @pl.when(live)
     def _block():
@@ -80,7 +88,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            s = jnp.where(_mask(bq, bk, iq * bq, ik * bk), s, NEG_INF)
+            s = jnp.where(
+                _mask(bq, bk, qo_ref[0, 0] + iq * bq,
+                      ko_ref[0, 0] + ik * bk),
+                s, NEG_INF,
+            )
         m_prev = m[:, :1]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         corr = jnp.exp(m_prev - m_new)
@@ -100,8 +112,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
         lse_ref[0] = m[:, :1] + jnp.log(lf)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, acc,
-               *, bq, bk, causal, scale):
+def _dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               dl_ref, dq_ref, acc, *, bq, bk, causal, dyn, scale):
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
@@ -112,7 +124,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, acc,
     def _init():
         acc[:] = jnp.zeros_like(acc)
 
-    live = (iq * bq + bq - 1 >= ik * bk) if causal else True
+    if not causal:
+        live = True
+    elif dyn:
+        live = (qo_ref[0, 0] + iq * bq + bq - 1
+                >= ko_ref[0, 0] + ik * bk)
+    else:
+        live = iq * bq + bq - 1 >= ik * bk
 
     @pl.when(live)
     def _block():
@@ -124,7 +142,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, acc,
         ) * scale
         p = jnp.exp(s - lse_ref[0])
         if causal:
-            p = jnp.where(_mask(bq, bk, iq * bq, ik * bk), p, 0.0)
+            p = jnp.where(
+                _mask(bq, bk, qo_ref[0, 0] + iq * bq,
+                      ko_ref[0, 0] + ik * bk),
+                p, 0.0,
+            )
         dob = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
             dob, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
@@ -141,8 +163,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, acc,
         dq_ref[0] = acc[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-                dk_ref, dv_ref, kacc, vacc, *, bq, bk, causal, scale):
+def _dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                dl_ref, dk_ref, dv_ref, kacc, vacc,
+                *, bq, bk, causal, dyn, scale):
     from jax.experimental import pallas as pl
 
     ik = pl.program_id(1)
@@ -154,7 +177,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         kacc[:] = jnp.zeros_like(kacc)
         vacc[:] = jnp.zeros_like(vacc)
 
-    live = (iq * bq + bq - 1 >= ik * bk) if causal else True
+    if not causal:
+        live = True
+    elif dyn:
+        live = (qo_ref[0, 0] + iq * bq + bq - 1
+                >= ko_ref[0, 0] + ik * bk)
+    else:
+        live = iq * bq + bq - 1 >= ik * bk
 
     @pl.when(live)
     def _block():
@@ -166,7 +195,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         ) * scale
         p = jnp.exp(s - lse_ref[0])
         if causal:
-            p = jnp.where(_mask(bq, bk, iq * bq, ik * bk), p, 0.0)
+            p = jnp.where(
+                _mask(bq, bk, qo_ref[0, 0] + iq * bq,
+                      ko_ref[0, 0] + ik * bk),
+                p, 0.0,
+            )
         dob = do_ref[0].astype(jnp.float32)
         vacc[:] += jax.lax.dot_general(
             p, dob, (((0,), (0,)), ((), ())),
@@ -195,19 +228,40 @@ def _pick_block(t: int, want: int) -> int:
     return max(b, 1)
 
 
-def _flash_fwd_raw(q, k, v, causal, bq, bk, interpret):
-    """(BH, T, D) folded layout -> (out, lse).  lse is (BH, T, 1) f32 —
-    the lane-1 layout keeps T in sublanes so the kernel writes it
-    without a relayout."""
+def _offs(q_off, k_off):
+    """Normalize offsets to the (1,1) int32 SMEM operands the kernels
+    read; None → zeros (the plain static path)."""
+    z = jnp.zeros((1, 1), jnp.int32)
+    qo = z if q_off is None else jnp.asarray(q_off, jnp.int32).reshape(1, 1)
+    ko = z if k_off is None else jnp.asarray(k_off, jnp.int32).reshape(1, 1)
+    return qo, ko
+
+
+def _smem_spec():
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _flash_fwd_raw(q, k, v, causal, bq, bk, interpret,
+                   q_off=None, k_off=None):
+    """(BH, T, D) folded layout -> (out, lse).  lse is (BH, T, 1) f32 —
+    the lane-1 layout keeps T in sublanes so the kernel writes it
+    without a relayout.  ``q_off``/``k_off`` are dynamic global
+    position offsets for the causal mask (ring hops); None keeps the
+    static-offset fast path (block-level causal skip)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    dyn = q_off is not None or k_off is not None
+    qo, ko = _offs(q_off, k_off)
     bh, t, d = q.shape
     tk = k.shape[1]
     nq, nk = t // bq, tk // bk
     scale = 1.0 / math.sqrt(d)
     kern = functools.partial(
-        _fwd_kernel, bq=bq, bk=bk, causal=causal, scale=scale
+        _fwd_kernel, bq=bq, bk=bk, causal=causal, dyn=dyn, scale=scale
     )
     qspec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM)
@@ -216,7 +270,7 @@ def _flash_fwd_raw(q, k, v, causal, bq, bk, interpret):
     return pl.pallas_call(
         kern,
         grid=(bh, nq, nk),
-        in_specs=[qspec, kspec, kspec],
+        in_specs=[_smem_spec(), _smem_spec(), qspec, kspec, kspec],
         out_specs=[
             qspec,
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
@@ -235,13 +289,16 @@ def _flash_fwd_raw(q, k, v, causal, bq, bk, interpret):
             **_dims(("parallel", "parallel", "arbitrary"))
         ),
         interpret=interpret,
-    )(q, k, v)
+    )(qo, ko, q, k, v)
 
 
-def _flash_bwd_raw(q, k, v, do, lse, delta, causal, bq, bk, interpret):
+def _flash_bwd_raw(q, k, v, do, lse, delta, causal, bq, bk, interpret,
+                   q_off=None, k_off=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    dyn = q_off is not None or k_off is not None
+    qo, ko = _offs(q_off, k_off)
     bh, t, d = q.shape
     tk = k.shape[1]
     nq, nk = t // bq, tk // bk
@@ -255,9 +312,10 @@ def _flash_bwd_raw(q, k, v, do, lse, delta, causal, bq, bk, interpret):
                          memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, bq=bq, bk=bk, causal=causal,
-                          scale=scale),
+                          dyn=dyn, scale=scale),
         grid=(bh, nq, nk),
-        in_specs=[qspec, kspec, kspec, qspec, rspec, rspec],
+        in_specs=[_smem_spec(), _smem_spec(),
+                  qspec, kspec, kspec, qspec, rspec, rspec],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
@@ -265,7 +323,7 @@ def _flash_bwd_raw(q, k, v, do, lse, delta, causal, bq, bk, interpret):
             **_dims(("parallel", "parallel", "arbitrary"))
         ),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(qo, ko, q, k, v, do, lse, delta)
 
     # k/v grid: kv block is the resident operand, q sweeps innermost
     qspec2 = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
@@ -276,9 +334,10 @@ def _flash_bwd_raw(q, k, v, do, lse, delta, causal, bq, bk, interpret):
                           memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, bq=bq, bk=bk, causal=causal,
-                          scale=scale),
+                          dyn=dyn, scale=scale),
         grid=(bh, nk, nq),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, rspec2, rspec2],
+        in_specs=[_smem_spec(), _smem_spec(),
+                  qspec2, kspec2, kspec2, qspec2, rspec2, rspec2],
         out_specs=[kspec2, kspec2],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
@@ -292,7 +351,7 @@ def _flash_bwd_raw(q, k, v, do, lse, delta, causal, bq, bk, interpret):
             **_dims(("parallel", "parallel", "arbitrary"))
         ),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(qo, ko, q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
@@ -346,3 +405,76 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 
 
 flash_mha.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def flash_mha_lse(q, k, v, q_off, k_off, causal: bool = True,
+                  block_q: int = 512, block_k: int = 512,
+                  interpret: bool = False):
+    """Flash attention returning ``(out, lse)`` with dynamic position
+    offsets — the ring-attention building block.
+
+    ``lse`` is the per-row log-sum-exp ``(B, T, H)`` of the (masked)
+    scores; ring hops merge partial results as
+    ``lse' = logaddexp(lse_a, lse_b)``, ``o' = (o_a e^{lse_a-lse'} +
+    o_b e^{lse_b-lse'})``.  ``q_off``/``k_off`` are traced scalars: the
+    global positions of this call's first query/key row, consumed by
+    the causal mask (a hop whose keys all sit after the queries yields
+    lse ~ -1e30 and washes out of the merge).
+
+    The VJP accepts cotangents for BOTH outputs: ``dL/dlse`` folds into
+    the backward kernels as ``ds = p * (dp - (delta - dlse))`` — the
+    same two kernels serve both flash entry points.
+    """
+    out, lse, _ = _flash_lse_fwd_impl(
+        q, k, v, q_off, k_off, causal, block_q, block_k, interpret
+    )
+    return out, lse
+
+
+def _flash_lse_fwd_impl(q, k, v, q_off, k_off, causal, block_q, block_k,
+                        interpret):
+    b, t, h, d = q.shape
+    bq = _pick_block(t, block_q)
+    bk = _pick_block(k.shape[1], block_k)
+    out, lse = _flash_fwd_raw(
+        _fold(q), _fold(k), _fold(v), causal, bq, bk, interpret,
+        q_off=q_off, k_off=k_off,
+    )
+    # lse (BH, T, 1) -> (B, T, H)
+    lse_o = lse[:, :, 0].reshape(b, h, t).transpose(0, 2, 1)
+    return _unfold(out, b, h), lse_o, (out, lse)
+
+
+def _flash_lse_fwd(q, k, v, q_off, k_off, causal, block_q, block_k,
+                   interpret):
+    out_u, lse_o, (out_f, lse_f) = _flash_lse_fwd_impl(
+        q, k, v, q_off, k_off, causal, block_q, block_k, interpret
+    )
+    return (out_u, lse_o), (q, k, v, q_off, k_off, out_f, lse_f)
+
+
+def _flash_lse_bwd(causal, block_q, block_k, interpret, res, cts):
+    g, g_lse = cts
+    q, k, v, q_off, k_off, out_f, lse_f = res
+    b, t, h, d = q.shape
+    bq = _pick_block(t, block_q)
+    bk = _pick_block(k.shape[1], block_k)
+    gf = _fold(g)
+    # dL/dlse_i adds p_ij * dlse_i to ds_ij; the kernels compute
+    # ds = p * (dp - dl) so dl = delta - dlse absorbs it
+    dlse = jnp.zeros((b * h, t, 1), jnp.float32) if g_lse is None else (
+        g_lse.transpose(0, 2, 1).reshape(b * h, t, 1).astype(jnp.float32)
+    )
+    delta = (gf.astype(jnp.float32) * out_f.astype(jnp.float32)).sum(
+        -1, keepdims=True
+    )
+    dq, dk, dv = _flash_bwd_raw(
+        _fold(q), _fold(k), _fold(v), gf, lse_f, delta - dlse,
+        causal, bq, bk, interpret, q_off=q_off, k_off=k_off,
+    )
+    return (_unfold(dq, b, h), _unfold(dk, b, h), _unfold(dv, b, h),
+            None, None)
+
+
+flash_mha_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)

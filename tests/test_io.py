@@ -547,12 +547,3 @@ def test_imgbin_epoch_cap_equalizes_steps(tmp_path):
     assert counts == [1, 1]
 
 
-def test_attention_ring_rejects_pallas_opt_in():
-    from cxxnet_tpu.layers import create_layer
-
-    lay = create_layer("attention")
-    lay.set_param("nhead", "2")
-    lay.set_param("seq_parallel", "ring")
-    lay.set_param("attn_impl", "pallas")
-    with pytest.raises(ValueError, match="ring"):
-        lay.infer_shape([(2, 16, 8)])
