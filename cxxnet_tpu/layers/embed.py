@@ -54,6 +54,8 @@ class EmbeddingLayer(Layer):
         super().__init__()
         self.nvocab = 0
         self.pos = "none"
+        self.decode = 0
+        self.decode_window = 0
 
     def set_param(self, name, val):
         if name == "nvocab":
@@ -64,6 +66,13 @@ class EmbeddingLayer(Layer):
                     f"embedding: pos must be none|learned|sin, got {val!r}"
                 )
             self.pos = val
+        elif name == "decode":
+            # incremental decoding: positions are absolute (the loop's
+            # ``step``), and the learned table spans decode_window so
+            # its shape matches the training checkpoint's (T, D)
+            self.decode = int(val)
+        elif name == "decode_window":
+            self.decode_window = int(val)
         else:
             super().set_param(name, val)
 
@@ -80,9 +89,19 @@ class EmbeddingLayer(Layer):
         n, t = shape
         return [(n, t, self.param.num_hidden)]
 
+    def _table_len(self, t: int) -> int:
+        if self.decode:
+            if self.decode_window <= 0:
+                raise ValueError(
+                    "embedding: decode=1 needs decode_window (the "
+                    "training T, so the pos table matches the checkpoint)"
+                )
+            return self.decode_window
+        return t
+
     def init_params(self, key, in_shapes) -> Params:
         d = self.param.num_hidden
-        t = in_shapes[0][1]
+        t = self._table_len(in_shapes[0][1])
         k1, k2 = jax.random.split(key)
         sigma = self.param.init_sigma
         p = {
@@ -94,15 +113,30 @@ class EmbeddingLayer(Layer):
         return p
 
     def apply(self, params, inputs, *, train=False, rng=None, step=None):
+        from jax import lax
+
         x = inputs[0]
         ids = jnp.clip(
             jnp.round(x).astype(jnp.int32), 0, self.nvocab - 1
         )
         table = params["wmat"]
         out = jnp.take(table, ids, axis=0)
-        t = out.shape[1]
+        t, d = out.shape[1], out.shape[2]
+        if self.decode:
+            # absolute positions step..step+t-1 (the decode loop's clock)
+            start = jnp.asarray(0 if step is None else step, jnp.int32)
+            if self.pos == "learned":
+                sl = lax.dynamic_slice(
+                    params["pos"].astype(out.dtype), (start, 0), (t, d)
+                )
+                out = out + sl[None]
+            elif self.pos == "sin":
+                full = sin_pos_table(self._table_len(t), d)
+                sl = lax.dynamic_slice(full, (start, 0), (t, d))
+                out = out + sl.astype(out.dtype)[None]
+            return [out]
         if self.pos == "learned":
             out = out + params["pos"].astype(out.dtype)[None, :t]
         elif self.pos == "sin":
-            out = out + sin_pos_table(t, out.shape[-1]).astype(out.dtype)
+            out = out + sin_pos_table(t, d).astype(out.dtype)
         return [out]

@@ -91,6 +91,8 @@ class AttentionLayer(Layer):
         self.causal = 0
         self.seq_parallel = 0
         self.attn_impl = "auto"
+        self.decode = 0
+        self.decode_window = 0
         self.mesh_plan = None  # bound by the trainer (bind_mesh)
 
     _SP_MODES = {"0": 0, "1": 1, "2": 2, "off": 0, "ring": 1,
@@ -114,6 +116,13 @@ class AttentionLayer(Layer):
                     f" got {val!r}"
                 )
             self.seq_parallel = self._SP_MODES[val]
+        elif name == "decode":
+            # KV-cache incremental decoding (generation): keys/values
+            # accumulate in aux state; the loop's ``step`` is the
+            # absolute position of this call's first token
+            self.decode = int(val)
+        elif name == "decode_window":
+            self.decode_window = int(val)
         else:
             super().set_param(name, val)
 
@@ -177,6 +186,68 @@ class AttentionLayer(Layer):
 
     def bind_mesh(self, plan) -> None:
         self.mesh_plan = plan
+
+    def init_aux(self, in_shapes):
+        """KV cache state for ``decode = 1``: keys/values for all past
+        positions, written at the loop's ``step`` offset."""
+        if not self.decode:
+            return {}
+        if self.seq_parallel:
+            raise ValueError(
+                "attention: decode=1 (single-token KV caching) does not "
+                "compose with seq_parallel"
+            )
+        if self.decode_window <= 0:
+            raise ValueError(
+                "attention: decode=1 needs decode_window (max positions "
+                "the cache holds — the training T)"
+            )
+        n, t, d = in_shapes[0]
+        h, dh = self.nhead, d // self.nhead
+        w = self.decode_window
+        return {
+            "kcache": jnp.zeros((n, w, h, dh), jnp.float32),
+            "vcache": jnp.zeros((n, w, h, dh), jnp.float32),
+        }
+
+    def apply_stateful(self, params, aux, inputs, *, train=False, rng=None,
+                       step=None):
+        """Incremental attention: write this call's k/v into the cache
+        at positions ``step..step+t-1`` and attend q against everything
+        up to its own position (the causal rule against the cache)."""
+        from jax import lax
+
+        x = inputs[0]
+        n, t, d = x.shape
+        h, dh = self.nhead, d // self.nhead
+        qkv = x @ params["wmat"].astype(x.dtype).T + params["bias"].astype(
+            x.dtype
+        )
+        qkv = qkv.reshape(n, t, 3, h, dh)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        start = jnp.asarray(0 if step is None else step, jnp.int32)
+        kc = lax.dynamic_update_slice(
+            aux["kcache"], k.astype(jnp.float32), (0, start, 0, 0)
+        )
+        vc = lax.dynamic_update_slice(
+            aux["vcache"], v.astype(jnp.float32), (0, start, 0, 0)
+        )
+        w = kc.shape[1]
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), kc,
+            preferred_element_type=jnp.float32,
+        ) * (1.0 / (dh ** 0.5))
+        q_pos = start + lax.broadcasted_iota(jnp.int32, (t, w), 0)
+        k_pos = lax.broadcasted_iota(jnp.int32, (t, w), 1)
+        s = jnp.where((k_pos <= q_pos)[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vc, preferred_element_type=jnp.float32
+        ).astype(x.dtype).reshape(n, t, d)
+        out = o @ params["wproj"].astype(x.dtype).T + params["bproj"].astype(
+            x.dtype
+        )
+        return [out], {"kcache": kc, "vcache": vc}
 
     def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
         self._check_arity(in_shapes, 1)

@@ -513,6 +513,7 @@ def transformer_conf(
     compute_dtype: str = "bfloat16",
     pipeline_parallel: int = 0,
     n_microbatch: int = 4,
+    attn_impl: str = "auto",
 ) -> str:
     """Pre-norm transformer encoder classifier over dense sequences.
 
@@ -566,7 +567,7 @@ def transformer_conf(
         per_layer_blocks = range(nlayer)
     if len(per_layer_blocks):
         blocks, prev = _transformer_blocks(
-            prev, nlayer, nhead, dim, causal, seq_parallel
+            prev, nlayer, nhead, dim, causal, seq_parallel, attn_impl
         )
         s += blocks
     s += (

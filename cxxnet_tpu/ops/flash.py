@@ -53,6 +53,19 @@ def _mask(tq: int, tk: int, q_off, k_off):
     return qi >= ki
 
 
+def _live(qo_ref, ko_ref, iq, ik, bq, bk, causal, dyn):
+    """Causal block-liveness: can this (iq, ik) block contribute at all?
+    Static offsets fold at trace time (the plain flash path); dynamic
+    offsets read the SMEM scalars — ``pl.when`` accepts traced
+    predicates, so a fully-future ring hop skips all compute."""
+    if not causal:
+        return True
+    if dyn:
+        return (qo_ref[0, 0] + iq * bq + bq - 1
+                >= ko_ref[0, 0] + ik * bk)
+    return iq * bq + bq - 1 >= ik * bk
+
+
 def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc, m, l, *, bq, bk, causal, dyn, scale):
     from jax.experimental import pallas as pl
@@ -67,17 +80,7 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m[:] = jnp.full_like(m, NEG_INF)
         l[:] = jnp.zeros_like(l)
 
-    # causal: the block is live iff its first key position can be seen
-    # by its last query position (the ~2x FLOP saving).  With dynamic
-    # offsets the predicate reads the SMEM scalars — pl.when accepts
-    # traced conditions, so a fully-future ring hop skips all compute.
-    if not causal:
-        live = True
-    elif dyn:
-        live = (qo_ref[0, 0] + iq * bq + bq - 1
-                >= ko_ref[0, 0] + ik * bk)
-    else:
-        live = iq * bq + bq - 1 >= ik * bk
+    live = _live(qo_ref, ko_ref, iq, ik, bq, bk, causal, dyn)
 
     @pl.when(live)
     def _block():
@@ -124,13 +127,7 @@ def _dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def _init():
         acc[:] = jnp.zeros_like(acc)
 
-    if not causal:
-        live = True
-    elif dyn:
-        live = (qo_ref[0, 0] + iq * bq + bq - 1
-                >= ko_ref[0, 0] + ik * bk)
-    else:
-        live = iq * bq + bq - 1 >= ik * bk
+    live = _live(qo_ref, ko_ref, iq, ik, bq, bk, causal, dyn)
 
     @pl.when(live)
     def _block():
@@ -177,13 +174,7 @@ def _dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         kacc[:] = jnp.zeros_like(kacc)
         vacc[:] = jnp.zeros_like(vacc)
 
-    if not causal:
-        live = True
-    elif dyn:
-        live = (qo_ref[0, 0] + iq * bq + bq - 1
-                >= ko_ref[0, 0] + ik * bk)
-    else:
-        live = iq * bq + bq - 1 >= ik * bk
+    live = _live(qo_ref, ko_ref, iq, ik, bq, bk, causal, dyn)
 
     @pl.when(live)
     def _block():

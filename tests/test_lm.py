@@ -356,3 +356,99 @@ def test_lm_gradient_accumulation_matches_big_batch(corpus):
                 rtol=2e-4, atol=2e-5,
                 err_msg=f"{key}/{tag}: accumulation != big batch",
             )
+
+
+def test_attention_decode_matches_full_causal():
+    """Token-by-token KV-cache attention equals full causal attention."""
+    from cxxnet_tpu.layers import create_layer
+
+    rng = np.random.RandomState(3)
+    T, D = 8, 16
+    x = jnp.asarray(rng.randn(2, T, D).astype(np.float32))
+
+    full = create_layer("attention")
+    for k, v in (("nhead", "2"), ("causal", "1"), ("init_sigma", "0.1")):
+        full.set_param(k, v)
+    full.infer_shape([(2, T, D)])
+    params = full.init_params(jax.random.PRNGKey(0), [(2, T, D)])
+    (want,) = full.apply(params, [x])
+
+    dec = create_layer("attention")
+    for k, v in (("nhead", "2"), ("causal", "1"), ("init_sigma", "0.1"),
+                 ("decode", "1"), ("decode_window", str(T))):
+        dec.set_param(k, v)
+    dec.infer_shape([(2, 1, D)])
+    aux = dec.init_aux([(2, 1, D)])
+    outs = []
+    for t in range(T):
+        (o,), aux = dec.apply_stateful(
+            params, aux, [x[:, t:t + 1]], step=jnp.asarray(t, jnp.int32)
+        )
+        outs.append(o)
+    got = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_attention_decode_guards():
+    from cxxnet_tpu.layers import create_layer
+
+    lay = create_layer("attention")
+    lay.set_param("nhead", "2")
+    lay.set_param("decode", "1")
+    with pytest.raises(ValueError, match="decode_window"):
+        lay.init_aux([(1, 1, 8)])
+    lay.set_param("decode_window", "16")
+    lay.set_param("seq_parallel", "ring")
+    with pytest.raises(ValueError, match="seq_parallel"):
+        lay.init_aux([(1, 1, 8)])
+
+
+@pytest.mark.slow
+def test_lm_cached_decode_matches_full_forward(corpus):
+    """The decode twin (input (1,1), KV caches in aux, absolute
+    positions via step) reproduces the trained net's per-position
+    probabilities exactly — the cli gen_cache=1 recipe."""
+    from cxxnet_tpu.io.data import DataBatch
+
+    tr, it = _lm_trainer(corpus)
+    for _ in range(3):
+        it.before_first()
+        while it.next():
+            tr.update(it.value())
+
+    t_train = tr.graph.input_shape[-1]
+    dec_cfg = []
+    for n, v in tr.cfg:
+        if n == "input_shape":
+            v = "1,1,1"
+        elif n == "batch_size":
+            v = "1"
+        dec_cfg.append((n, v))
+    dec_cfg += [("decode", "1"), ("decode_window", str(t_train)),
+                ("batch_size", "1")]
+    from cxxnet_tpu.nnet.trainer import NetTrainer as NT
+
+    dec = NT()
+    dec.set_params(dec_cfg)
+    dec.init_model()
+    for key in dec.params:
+        dec.params[key] = tr.params[key]
+
+    ids = list(b"the quick brown fox jumps over t")[:t_train]
+    full = tr.extract_feature(
+        DataBatch(data=np.asarray([ids], np.float32), label=None), "top[-1]"
+    )[0]  # (T, V) probs
+    net = dec.net
+    out_idx = net.out_node_index()
+    aux = net.init_aux(1)
+    for pos, tok in enumerate(ids):
+        nodes, _, aux = net.forward(
+            dec.params, np.asarray([[tok]], np.float32), train=False,
+            aux=aux, return_aux=True, step=jnp.asarray(pos, jnp.int32),
+        )
+        got = np.asarray(nodes[out_idx].astype(jnp.float32))[0, 0]
+        np.testing.assert_allclose(
+            got, full[pos], rtol=2e-4, atol=2e-5,
+            err_msg=f"decode twin diverged at position {pos}",
+        )
