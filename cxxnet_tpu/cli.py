@@ -24,6 +24,10 @@ from .io.data import DataIter, create_iterator
 from .nnet.trainer import NetTrainer
 
 
+class _NoDecodeSupport(Exception):
+    """The decode twin grew no KV caches — fall back to windows."""
+
+
 class LearnTask:
     def __init__(self) -> None:
         self.task = "train"
@@ -468,14 +472,21 @@ class LearnTask:
         ctx = list(prompt.encode("utf-8")) or [ord("\n")]
         t_train = tr.graph.input_shape[-1]
         if self.gen_cache and len(ctx) < t_train:
-            text = self._generate_cached(ctx)
-            with open(self.name_pred, "w", encoding="utf-8") as fo:
-                fo.write(text)
-            print(f"generated {len(text.encode())} bytes -> "
-                  f"{self.name_pred}")
-            print(text)
-            return
-        if self.gen_cache and not self.silent:
+            try:
+                text = self._generate_cached(ctx)
+            except _NoDecodeSupport:
+                if not self.silent:
+                    print("gen_cache: net has no KV-cache-capable "
+                          "layers; using the sliding-window path")
+                text = None
+            if text is not None:
+                with open(self.name_pred, "w", encoding="utf-8") as fo:
+                    fo.write(text)
+                print(f"generated {len(text.encode())} bytes -> "
+                      f"{self.name_pred}")
+                print(text)
+                return
+        elif self.gen_cache and not self.silent:
             print(f"gen_cache: prompt ({len(ctx)}) fills the KV window "
                   f"({t_train}); using the sliding-window path")
         rng = np.random.RandomState(tr.seed)
@@ -511,7 +522,6 @@ class LearnTask:
         """
         import jax
         import jax.numpy as jnp
-        import numpy as np_
 
         tr = self.net_trainer
         t_train = tr.graph.input_shape[-1]
@@ -529,7 +539,7 @@ class LearnTask:
                 continue
             dec_cfg.append((n, v))
         dec_cfg += [("decode", "1"), ("decode_window", str(t_train)),
-                    ("batch_size", "1"), ("seq_parallel", "0")]
+                    ("seq_parallel", "0")]
         dec = NetTrainer()
         dec.set_params(dec_cfg)
         dec.init_model()
@@ -539,6 +549,12 @@ class LearnTask:
             dec.params[key] = tr.params[key]
         net = dec.net
         out_idx = net.out_node_index()
+        aux0 = net.init_aux(1)
+        if not aux0:
+            # no layer grew a KV cache (e.g. pipe_transformer blocks
+            # ignore decode=) — incremental stepping would silently see
+            # one token at a time; signal the caller to slide windows
+            raise _NoDecodeSupport()
 
         @jax.jit
         def step_fn(params, aux, tok, pos):
@@ -548,8 +564,8 @@ class LearnTask:
             )
             return nodes[out_idx].astype(jnp.float32), new_aux
 
-        aux = net.init_aux(1)
-        rng = np_.random.RandomState(tr.seed)
+        aux = aux0
+        rng = np.random.RandomState(tr.seed)
         budget = t_train - len(ctx)
         gen_n = min(self.gen_len, max(budget, 0))
         if gen_n < self.gen_len and not self.silent:
@@ -559,16 +575,16 @@ class LearnTask:
         out_bytes = []
         probs = None
         for pos, tok in enumerate(ctx):
-            tok_a = np_.asarray([[tok]], np_.float32)
+            tok_a = np.asarray([[tok]], np.float32)
             probs, aux = step_fn(dec.params, aux, tok_a,
                                  jnp.asarray(pos, jnp.int32))
         pos = len(ctx)
         for _ in range(gen_n):
-            nxt = self._sample(np_.asarray(probs)[0, 0], rng)
+            nxt = self._sample(np.asarray(probs)[0, 0], rng)
             out_bytes.append(nxt)
             if len(out_bytes) == gen_n:
                 break
-            tok_a = np_.asarray([[nxt]], np_.float32)
+            tok_a = np.asarray([[nxt]], np.float32)
             probs, aux = step_fn(dec.params, aux, tok_a,
                                  jnp.asarray(pos, jnp.int32))
             pos += 1

@@ -197,6 +197,11 @@ class AttentionLayer(Layer):
                 "attention: decode=1 (single-token KV caching) does not "
                 "compose with seq_parallel"
             )
+        if not self.causal:
+            raise ValueError(
+                "attention: decode=1 requires causal=1 — incremental "
+                "decoding cannot reproduce bidirectional attention"
+            )
         if self.decode_window <= 0:
             raise ValueError(
                 "attention: decode=1 needs decode_window (max positions "

@@ -22,6 +22,7 @@ from .builders import (  # noqa: F401
     kaggle_bowl_conf,
     mnist_conv_conf,
     mnist_mlp_conf,
+    resnet50_conf,
     transformer_conf,
     transformer_lm_conf,
     vgg16_conf,
@@ -33,6 +34,7 @@ MODEL_BUILDERS = {
     "alexnet": alexnet_conf,
     "googlenet": googlenet_conf,
     "vgg16": vgg16_conf,
+    "resnet50": resnet50_conf,
     "kaggle_bowl": kaggle_bowl_conf,
     "transformer": transformer_conf,
     "transformer_lm": transformer_lm_conf,

@@ -391,6 +391,102 @@ def transformer_lm_conf(
     )
 
 
+def _res_bottleneck(prev: str, name: str, cin: int, cmid: int, cout: int,
+                    stride: int) -> str:
+    """Bottleneck residual block: 1x1 reduce -> 3x3 -> 1x1 expand, each
+    conv + batch_norm + relu (relu after the residual add), projection
+    shortcut when shape changes (He et al. 2015)."""
+    s = ""
+    def cbr(src, dst, ch, k, st, pad, tag, relu=True):
+        t = (
+            f"layer[{src}->{dst}_c] = conv:{tag}_conv\n"
+            f"  kernel_size = {k}\n  stride = {st}\n  pad = {pad}\n"
+            f"  nchannel = {ch}\n  no_bias = 1\n  random_type = kaiming\n"
+            f"layer[{dst}_c->{dst}] = batch_norm:{tag}_bn\n"
+        )
+        if relu:
+            t += f"layer[{dst}->{dst}] = relu\n"
+        return t
+
+    s += cbr(prev, f"{name}_a", cmid, 1, stride, 0, f"{name}_a")
+    s += cbr(f"{name}_a", f"{name}_b", cmid, 3, 1, 1, f"{name}_b")
+    s += cbr(f"{name}_b", f"{name}_c", cout, 1, 1, 0, f"{name}_c",
+             relu=False)
+    if cin != cout or stride != 1:
+        s += cbr(prev, f"{name}_p", cout, 1, stride, 0, f"{name}_proj",
+                 relu=False)
+        short = f"{name}_p"
+    else:
+        short = prev
+    s += (
+        f"layer[{short},{name}_c->{name}] = eltwise_sum\n"
+        f"layer[{name}->{name}] = relu\n"
+    )
+    return s
+
+
+def resnet50_conf(
+    batch_size: int = 128,
+    num_class: int = 1000,
+    input_size: int = 224,
+    synthetic: bool = True,
+    nsample: int = 0,
+    dev: str = "tpu",
+    compute_dtype: str = "bfloat16",
+) -> str:
+    """ResNet-50 (He et al. 2015, table 1) — bottleneck blocks
+    [3, 4, 6, 3], batch-norm everywhere, projection shortcuts at stage
+    boundaries.  New-scope zoo entry (the reference predates ResNets);
+    built from the paper like the GoogLeNet/VGG entries.
+    """
+    shape = f"3,{input_size},{input_size}"
+    nsample = nsample or batch_size * 4
+    data = (
+        _iter_block("data", nsample, shape, num_class, threadbuffer=True)
+        + _iter_block("eval", batch_size * 2, shape, num_class)
+        if synthetic
+        else ""
+    )
+    net = (
+        "netconfig = start\n"
+        "layer[0->c1] = conv:conv1\n"
+        "  kernel_size = 7\n  stride = 2\n  pad = 3\n  nchannel = 64\n"
+        "  no_bias = 1\n  random_type = kaiming\n"
+        "layer[c1->b1] = batch_norm:bn1\n"
+        "layer[b1->b1] = relu\n"
+        # pad 0: the framework's ceil-shape pooling (reference parity)
+        # with pad 1 would give 57x57; unpadded k3 s2 on 112 lands on
+        # the paper's 56x56
+        "layer[b1->p1] = max_pooling\n  kernel_size = 3\n  stride = 2\n"
+    )
+    prev, cin = "p1", 64
+    stages = [(3, 64, 256, 1), (4, 128, 512, 2), (6, 256, 1024, 2),
+              (3, 512, 2048, 2)]
+    for si, (blocks, cmid, cout, stride) in enumerate(stages):
+        for bi in range(blocks):
+            name = f"s{si}b{bi}"
+            st = stride if bi == 0 else 1
+            net += _res_bottleneck(prev, name, cin, cmid, cout, st)
+            prev, cin = name, cout
+    net += (
+        f"layer[{prev}->pool] = avg_pooling\n"
+        f"  kernel_size = {max(1, input_size // 32)}\n  stride = 1\n"
+        "layer[pool->flat] = flatten\n"
+        f"layer[flat->fc] = fullc:fc1000\n"
+        f"  nhidden = {num_class}\n  random_type = xavier\n"
+        "layer[fc->fc] = softmax\n"
+        "netconfig = end\n"
+    )
+    extra = (
+        "metric = rec@1\nmetric = rec@5\n"
+        "wmat:lr = 0.1\nwmat:wd = 0.0001\n"
+        f"compute_dtype = {compute_dtype}\n"
+    )
+    return data + net + _tail(batch_size, shape, 90, eta=0.1, dev=dev,
+                              extra=extra)
+
+
+
 # ---------------------------------------------------------------------------
 def vgg16_conf(
     batch_size: int = 64,

@@ -396,6 +396,9 @@ def test_attention_decode_guards():
     lay = create_layer("attention")
     lay.set_param("nhead", "2")
     lay.set_param("decode", "1")
+    with pytest.raises(ValueError, match="causal"):
+        lay.init_aux([(1, 1, 8)])  # bidirectional can't decode
+    lay.set_param("causal", "1")
     with pytest.raises(ValueError, match="decode_window"):
         lay.init_aux([(1, 1, 8)])
     lay.set_param("decode_window", "16")

@@ -36,8 +36,36 @@ def test_model_shapes(name):
     out = shapes[tr.net.out_node_index()]
     expect = {"mnist_mlp": 10, "mnist_conv": 10, "alexnet": 1000,
               "googlenet": 1000, "vgg16": 1000, "kaggle_bowl": 121,
-              "transformer": 10, "transformer_lm": 256}[name]
+              "transformer": 10, "transformer_lm": 256,
+              "resnet50": 1000}[name]
     assert out[-1] == expect
+
+
+def test_resnet50_structure():
+    """Bottleneck plan matches He et al. table 1: stage widths
+    256/512/1024/2048, spatial 56/28/14/7 at 224px, ~25.5M params."""
+    import numpy as np
+
+    from cxxnet_tpu import config as cfgmod
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    text = MODEL_BUILDERS["resnet50"](batch_size=2, dev="cpu", nsample=4,
+                                      input_size=224)
+    tr = NetTrainer()
+    tr.set_params(_global_cfg(text))
+    tr.init_model()
+    g = tr.graph
+    shapes = {g.node_names[i]: s for i, s in enumerate(tr.net.node_shapes)
+              if s is not None and i < len(g.node_names)}
+    assert shapes["s0b2"][1:] == (56, 56, 256)
+    assert shapes["s1b3"][1:] == (28, 28, 512)
+    assert shapes["s2b5"][1:] == (14, 14, 1024)
+    assert shapes["s3b2"][1:] == (7, 7, 2048)
+    total = sum(
+        int(np.prod(np.shape(w)))
+        for tags in tr.params.values() for w in tags.values()
+    )
+    assert 25e6 < total < 26e6, f"param count {total/1e6:.1f}M"
 
 
 def test_googlenet_channel_plan():
