@@ -542,7 +542,13 @@ class LearnTask:
                     ("seq_parallel", "0")]
         dec = NetTrainer()
         dec.set_params(dec_cfg)
-        dec.init_model()
+        try:
+            dec.init_model()
+        except ValueError as e:
+            # e.g. non-causal attention can't decode incrementally —
+            # degrade to the sliding-window path like any other
+            # cache-incapable net
+            raise _NoDecodeSupport(str(e)) from e
         for key in dec.params:
             if key not in tr.params:
                 raise ValueError(f"decode net key {key} missing from model")

@@ -218,6 +218,48 @@ def _maxpool_eq_bwd(kh, kw, s, py, px, res, g):
 _maxpool_eq.defvjp(_maxpool_eq_fwd, _maxpool_eq_bwd)
 
 
+_PALLAS_PBWD_OK: dict = {}
+
+
+def _pallas_pool_bwd_works(k: int, pad: int, nchannel: int, dtype) -> bool:
+    """Compile probe for the stride-1 one-pass backward kernel."""
+    key = (k, pad, int(nchannel), jnp.dtype(dtype).name)
+    if key not in _PALLAS_PBWD_OK:
+        from ..ops.maxpool import maxpool_bwd_s1
+
+        def probe():
+            v0 = jnp.ones((2, k + 2, k + 2, key[2]), dtype)
+            y0 = _maxpool_eq(v0, k, k, 1, pad, pad)
+            maxpool_bwd_s1(v0, y0, y0, k, pad).block_until_ready()
+
+        _PALLAS_PBWD_OK[key] = _run_probe_untraced(probe)
+    return _PALLAS_PBWD_OK[key]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _maxpool_eq_pb(x, k: int, pad: int, interpret: bool):
+    """Stride-1 max pooling: XLA forward tree (cheap, fuses well) with
+    the one-pass Pallas backward (``ops/maxpool.maxpool_bwd_s1``) —
+    ``pool_impl = pallas_bwd``.  Same unpool-equality semantics as
+    ``_maxpool_eq``; that path is the pairtest golden."""
+    return _maxpool_eq(x, k, k, 1, pad, pad)
+
+
+def _maxpool_eq_pb_fwd(x, k, pad, interpret):
+    y = _maxpool_eq(x, k, k, 1, pad, pad)
+    return y, (x, y)
+
+
+def _maxpool_eq_pb_bwd(k, pad, interpret, res, g):
+    from ..ops.maxpool import maxpool_bwd_s1
+
+    x, y = res
+    return (maxpool_bwd_s1(x, y, g.astype(x.dtype), k, pad, interpret),)
+
+
+_maxpool_eq_pb.defvjp(_maxpool_eq_pb_fwd, _maxpool_eq_pb_bwd)
+
+
 _PALLAS_POOL_OK: dict = {}
 
 
@@ -269,9 +311,10 @@ class _PoolBase(Layer):
 
     def set_param(self, name, val):
         if name == "pool_impl":
-            if val not in ("auto", "pallas", "xla"):
+            if val not in ("auto", "pallas", "pallas_bwd", "xla"):
                 raise ValueError(
-                    f"pool_impl must be auto|pallas|xla, got {val!r}"
+                    f"pool_impl must be auto|pallas|pallas_bwd|xla, "
+                    f"got {val!r}"
                 )
             self.pool_impl = val
         else:
@@ -348,9 +391,37 @@ class _PoolBase(Layer):
     def _max_pool(self, x: jnp.ndarray) -> jnp.ndarray:
         """Max pooling with the unpool-equality backward: the XLA
         expression (``_maxpool_eq``) by default, the fused Pallas
-        kernel (``ops/maxpool.py``) under ``pool_impl = pallas`` —
-        identical semantics, pair-tested."""
+        kernel (``ops/maxpool.py``) under ``pool_impl = pallas``, or
+        XLA forward + the one-pass Pallas backward for stride-1 pools
+        under ``pool_impl = pallas_bwd`` — identical semantics,
+        pair-tested."""
         p = self.param
+        if self.pool_impl == "pallas_bwd":
+            eligible = (
+                p.stride == 1
+                and p.kernel_height == p.kernel_width
+                and p.pad_y == p.pad_x
+                and p.pad_y * 2 == p.kernel_height - 1  # same-size only
+            )
+            if eligible:
+                interp = jax.default_backend() != "tpu"
+                if interp or _pallas_pool_bwd_works(
+                    p.kernel_height, p.pad_y, x.shape[-1], x.dtype
+                ):
+                    return _maxpool_eq_pb(
+                        x, p.kernel_height, p.pad_y, interp
+                    )
+            import warnings
+
+            warnings.warn(
+                f"{self.type_name}: pool_impl=pallas_bwd "
+                + ("probe failed"
+                   if eligible else
+                   "needs a same-size stride-1 pool (odd k, pad=(k-1)/2)")
+                + f" for k=({p.kernel_height},{p.kernel_width}) "
+                f"s={p.stride} pad=({p.pad_y},{p.pad_x}) "
+                f"C={x.shape[-1]}; using the XLA path"
+            )
         if self._use_pallas(x.shape[-1], x.dtype):
             from ..ops.maxpool import maxpool_fused
 

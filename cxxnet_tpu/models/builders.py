@@ -439,6 +439,12 @@ def resnet50_conf(
     boundaries.  New-scope zoo entry (the reference predates ResNets);
     built from the paper like the GoogLeNet/VGG entries.
     """
+    if input_size % 32:
+        raise ValueError(
+            f"resnet50_conf: input_size={input_size} must be a multiple "
+            "of 32 (the stage chain downsamples 5x; anything else leaves "
+            "the final avg pool non-global)"
+        )
     shape = f"3,{input_size},{input_size}"
     nsample = nsample or batch_size * 4
     data = (

@@ -145,3 +145,45 @@ def _mp_bwd(kh, kw, s, py, px, interpret, res, g):
 
 
 maxpool_fused.defvjp(_mp_fwd, _mp_bwd)
+
+def _bwd_s1_kernel(x_ref, y_ref, g_ref, dx_ref, *, k, pl_, pr_):
+    """One-pass stride-1 backward: pad y/g in VMEM so every output
+    window covering input position (i, j) is a plain shifted slice,
+    then sum the 9 (k*k) equality-gated gradient reads.  Measured 1.5x
+    the XLA pad-and-add form in isolation at GoogLeNet's 28x28x256
+    inception pool (doc/performance.md)."""
+    x = x_ref[:].astype(jnp.float32)
+    h, w = x.shape[1], x.shape[2]
+    # output (i', j') covers inputs i' .. i'+k-1 (left pad pl_); input
+    # (i, j) is covered by outputs i-k+1+pl_ .. i+pl_ — pad y/g so those
+    # reads become slices at offsets 0..k-1
+    yp = jnp.pad(y_ref[:].astype(jnp.float32),
+                 ((0, 0), (k - 1 - pl_, pl_), (k - 1 - pl_, pl_), (0, 0)),
+                 constant_values=jnp.inf)
+    gp = jnp.pad(g_ref[:].astype(jnp.float32),
+                 ((0, 0), (k - 1 - pl_, pl_), (k - 1 - pl_, pl_), (0, 0)))
+    acc = None
+    for dy in range(k):
+        for dx in range(k):
+            ys = yp[:, dy:dy + h, dx:dx + w, :]
+            gs = gp[:, dy:dy + h, dx:dx + w, :]
+            c = jnp.where(x == ys, gs, 0.0)
+            acc = c if acc is None else acc + c
+    dx_ref[:] = acc.astype(dx_ref.dtype)
+
+
+def maxpool_bwd_s1(x, y, g, k: int, pad: int, interpret: bool = False):
+    """Stride-1 unpool-equality backward as a single fused pass.
+
+    Semantics identical to the XLA form in ``conv._maxpool_eq_bwd``
+    restricted to ``stride == 1`` (where the ceil-shape output equals
+    the input size and no interior padding exists); the pairtest golden
+    is that path.
+    """
+    (pl_, pr_), _, oh, ow = _geometry(
+        x.shape[1], x.shape[2], k, k, 1, pad, pad
+    )
+    assert (oh, ow) == (x.shape[1], x.shape[2]), "stride-1 same-size only"
+    kern = functools.partial(_bwd_s1_kernel, k=k, pl_=pl_, pr_=pr_)
+    return _call(kern, x.shape, x.shape, x.dtype, (x, y, g), interpret)
+

@@ -44,11 +44,6 @@ def test_model_shapes(name):
 def test_resnet50_structure():
     """Bottleneck plan matches He et al. table 1: stage widths
     256/512/1024/2048, spatial 56/28/14/7 at 224px, ~25.5M params."""
-    import numpy as np
-
-    from cxxnet_tpu import config as cfgmod
-    from cxxnet_tpu.nnet.trainer import NetTrainer
-
     text = MODEL_BUILDERS["resnet50"](batch_size=2, dev="cpu", nsample=4,
                                       input_size=224)
     tr = NetTrainer()

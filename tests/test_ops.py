@@ -113,3 +113,32 @@ def test_pool_layer_uses_xla_on_cpu(rng):
     assert lay._use_pallas(8, jnp.float32) is True
     with pytest.raises(ValueError):
         lay.set_param("pool_impl", "bogus")
+
+
+def test_maxpool_pallas_bwd_matches_xla():
+    """pool_impl=pallas_bwd: one-pass stride-1 backward kernel equals
+    the XLA unpool-equality path, values and gradients."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cxxnet_tpu.layers.conv import _maxpool_eq, _maxpool_eq_pb
+
+    rng = np.random.RandomState(0)
+    # ties included: quantized values make equality duplication real
+    x = jnp.asarray(
+        np.round(rng.randn(2, 9, 9, 8) * 2) / 2, jnp.float32
+    )
+    for k, pad in ((3, 1), (5, 2)):  # same-size pools
+        ref = _maxpool_eq(x, k, k, 1, pad, pad)
+        got = _maxpool_eq_pb(x, k, pad, True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   err_msg=f"fwd k={k} pad={pad}")
+        gr = jax.grad(lambda v: (_maxpool_eq(v, k, k, 1, pad, pad)
+                                 ** 2).sum())(x)
+        gp = jax.grad(lambda v: (_maxpool_eq_pb(v, k, pad, True)
+                                 ** 2).sum())(x)
+        np.testing.assert_allclose(
+            np.asarray(gp), np.asarray(gr), rtol=1e-5, atol=1e-5,
+            err_msg=f"bwd k={k} pad={pad}",
+        )
