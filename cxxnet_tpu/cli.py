@@ -24,10 +24,6 @@ from .io.data import DataIter, create_iterator
 from .nnet.trainer import NetTrainer
 
 
-class _NoDecodeSupport(Exception):
-    """The decode twin grew no KV caches — fall back to windows."""
-
-
 class LearnTask:
     def __init__(self) -> None:
         self.task = "train"
@@ -436,165 +432,25 @@ class LearnTask:
             print(f"mesh: data={tr.mesh_plan.n_data} "
                   f"model={tr.mesh_plan.n_model} zero={tr.zero}")
 
-    def _sample(self, p_row, rng) -> int:
-        """Greedy (gen_temp=0) or log-space temperature sampling —
-        shared by the windowed and KV-cached decode paths."""
-        if self.gen_temp > 0:
-            lp = np.log(np.maximum(np.asarray(p_row, np.float64),
-                                   1e-300)) / self.gen_temp
-            lp -= lp.max()
-            pe = np.exp(lp)
-            pe /= pe.sum()
-            return int(rng.choice(len(pe), p=pe))
-        return int(np.argmax(p_row))
-
     def task_generate(self) -> None:
         """``task=generate``: autoregressive byte sampling from a trained
-        language model (new scope — embedding + causal transformer +
-        per-position softmax; see doc/python.md).
+        language model (``nnet/generate.py``; doc/tasks.md).  KV-cache
+        incremental decoding by default (``gen_cache = 1``), sliding
+        window otherwise or as the fallback."""
+        from .nnet.generate import generate
 
-        The jitted forward has a static window T (the net's input
-        shape); the context occupies positions ``0..L-1`` and the next
-        byte is read from the probability row at ``L-1`` — under causal
-        masking the padding at positions >= L is never attended by
-        position L-1, so a single compiled program serves every step.
-        ``gen_temp = 0`` is greedy argmax; ``> 0`` samples from
-        ``p^(1/temp)``.
-        """
-        from .io.data import DataBatch
-
-        tr = self.net_trainer
-        t = tr.graph.input_shape[-1]
         prompt = self.gen_prompt
         if self.gen_prompt_file:
             with open(self.gen_prompt_file, "rb") as f:
                 prompt = f.read().decode("utf-8", "replace")
-        ctx = list(prompt.encode("utf-8")) or [ord("\n")]
-        t_train = tr.graph.input_shape[-1]
-        if self.gen_cache and len(ctx) < t_train:
-            try:
-                text = self._generate_cached(ctx)
-            except _NoDecodeSupport:
-                if not self.silent:
-                    print("gen_cache: net has no KV-cache-capable "
-                          "layers; using the sliding-window path")
-                text = None
-            if text is not None:
-                with open(self.name_pred, "w", encoding="utf-8") as fo:
-                    fo.write(text)
-                print(f"generated {len(text.encode())} bytes -> "
-                      f"{self.name_pred}")
-                print(text)
-                return
-        elif self.gen_cache and not self.silent:
-            print(f"gen_cache: prompt ({len(ctx)}) fills the KV window "
-                  f"({t_train}); using the sliding-window path")
-        rng = np.random.RandomState(tr.seed)
-        out_bytes = []
-        for _ in range(self.gen_len):
-            window = ctx[-t:]
-            ln = len(window)
-            data = np.zeros((1, t), np.float32)
-            data[0, :ln] = window
-            probs = tr.extract_feature(
-                DataBatch(data=data, label=None), "top[-1]"
-            )[0, ln - 1]
-            nxt = self._sample(probs, rng)
-            ctx.append(nxt)
-            out_bytes.append(nxt)
-        text = bytes(out_bytes).decode("utf-8", "replace")
+        text = generate(
+            self.net_trainer, prompt, self.gen_len, self.gen_temp,
+            cache=bool(self.gen_cache), silent=bool(self.silent),
+        )
         with open(self.name_pred, "w", encoding="utf-8") as fo:
             fo.write(text)
-        print(f"generated {self.gen_len} bytes -> {self.name_pred}")
+        print(f"generated {len(text.encode())} bytes -> {self.name_pred}")
         print(text)
-
-    def _generate_cached(self, ctx) -> str:
-        """KV-cache incremental decoding (``gen_cache = 1``, default).
-
-        Builds a decode twin of the trained net — same structure and
-        parameter shapes, input ``(1, 1)``, with ``decode = 1`` routing
-        embedding/attention through absolute positions and per-layer
-        KV caches carried as aux state — then runs one jitted
-        single-token step per position: O(T) per token instead of the
-        windowed path's O(T^2).  Generation is capped at the training
-        window (the cache length); ``gen_cache = 0`` selects the
-        sliding-window path with no length cap.
-        """
-        import jax
-        import jax.numpy as jnp
-
-        tr = self.net_trainer
-        t_train = tr.graph.input_shape[-1]
-        dec_cfg = []
-        for n, v in tr.cfg:
-            if n == "input_shape":
-                v = "1,1,1"
-            elif n == "batch_size":
-                v = "1"
-            elif n in ("dev", "model_parallel", "seq_parallel", "zero",
-                       "fsdp", "update_on_server"):
-                # the decode twin is a single-device batch-1 loop; the
-                # training run's mesh/SP/sharding settings would make
-                # init fail (batch 1 can't split) or be meaningless
-                continue
-            dec_cfg.append((n, v))
-        dec_cfg += [("decode", "1"), ("decode_window", str(t_train)),
-                    ("seq_parallel", "0")]
-        dec = NetTrainer()
-        dec.set_params(dec_cfg)
-        try:
-            dec.init_model()
-        except ValueError as e:
-            # e.g. non-causal attention can't decode incrementally —
-            # degrade to the sliding-window path like any other
-            # cache-incapable net
-            raise _NoDecodeSupport(str(e)) from e
-        for key in dec.params:
-            if key not in tr.params:
-                raise ValueError(f"decode net key {key} missing from model")
-            dec.params[key] = tr.params[key]
-        net = dec.net
-        out_idx = net.out_node_index()
-        aux0 = net.init_aux(1)
-        if not aux0:
-            # no layer grew a KV cache (e.g. pipe_transformer blocks
-            # ignore decode=) — incremental stepping would silently see
-            # one token at a time; signal the caller to slide windows
-            raise _NoDecodeSupport()
-
-        @jax.jit
-        def step_fn(params, aux, tok, pos):
-            nodes, _, new_aux = net.forward(
-                params, tok, train=False, aux=aux, return_aux=True,
-                step=pos,
-            )
-            return nodes[out_idx].astype(jnp.float32), new_aux
-
-        aux = aux0
-        rng = np.random.RandomState(tr.seed)
-        budget = t_train - len(ctx)
-        gen_n = min(self.gen_len, max(budget, 0))
-        if gen_n < self.gen_len and not self.silent:
-            print(f"gen_cache: capping generation at {gen_n} tokens "
-                  f"(KV window {t_train}, prompt {len(ctx)}); "
-                  "gen_cache=0 removes the cap")
-        out_bytes = []
-        probs = None
-        for pos, tok in enumerate(ctx):
-            tok_a = np.asarray([[tok]], np.float32)
-            probs, aux = step_fn(dec.params, aux, tok_a,
-                                 jnp.asarray(pos, jnp.int32))
-        pos = len(ctx)
-        for _ in range(gen_n):
-            nxt = self._sample(np.asarray(probs)[0, 0], rng)
-            out_bytes.append(nxt)
-            if len(out_bytes) == gen_n:
-                break
-            tok_a = np.asarray([[nxt]], np.float32)
-            probs, aux = step_fn(dec.params, aux, tok_a,
-                                 jnp.asarray(pos, jnp.int32))
-            pos += 1
-        return bytes(out_bytes).decode("utf-8", "replace")
 
     def task_extract(self) -> None:
         if self.itr_pred is None:

@@ -1,0 +1,176 @@
+"""Autoregressive text generation from a trained language model.
+
+Shared by the CLI (``task = generate``) and the Python API
+(``wrapper.Net.generate``).  Two decode paths over the same trained
+parameters:
+
+* **KV-cache incremental decoding** (``cache=True``, default): a decode
+  twin of the trained net — identical structure and parameter shapes,
+  input ``(1, 1)``, ``decode = 1`` routing embedding/attention through
+  absolute positions with per-layer key/value caches carried as aux
+  state — runs one jitted single-token step per position: O(T) per
+  token.  Used when prompt + gen_len fit the training window.
+* **Sliding window** (``cache=False``, or the fallback when the net
+  cannot grow caches / the prompt fills the window): the full
+  static-``T`` forward re-runs per token, context right-aligned —
+  O(T^2) per token, no length cap.
+
+Both produce identical greedy outputs inside the window
+(``tests/test_lm.py``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class NoDecodeSupport(Exception):
+    """The decode twin grew no KV caches — fall back to windows."""
+
+
+def sample_token(p_row: np.ndarray, rng: np.random.RandomState,
+                 temp: float) -> int:
+    """Greedy (``temp == 0``) or log-space temperature sampling
+    (``p^(1/temp)`` computed max-subtracted so low temperatures never
+    underflow to all-zeros)."""
+    if temp > 0:
+        lp = np.log(np.maximum(np.asarray(p_row, np.float64),
+                               1e-300)) / temp
+        lp -= lp.max()
+        pe = np.exp(lp)
+        pe /= pe.sum()
+        return int(rng.choice(len(pe), p=pe))
+    return int(np.argmax(p_row))
+
+
+def generate_windowed(tr, ctx: List[int], gen_len: int, temp: float,
+                      rng: np.random.RandomState) -> str:
+    """Sliding-window generation: re-run the trained net's full forward
+    per token (the context occupies positions ``0..L-1``; causal masking
+    makes the tail padding invisible, so one compiled program serves
+    every step)."""
+    from ..io.data import DataBatch
+
+    t = tr.graph.input_shape[-1]
+    ctx = list(ctx)
+    out_bytes = []
+    for _ in range(gen_len):
+        window = ctx[-t:]
+        ln = len(window)
+        data = np.zeros((1, t), np.float32)
+        data[0, :ln] = window
+        probs = tr.extract_feature(
+            DataBatch(data=data, label=None), "top[-1]"
+        )[0, ln - 1]
+        nxt = sample_token(probs, rng, temp)
+        ctx.append(nxt)
+        out_bytes.append(nxt)
+    return bytes(out_bytes).decode("utf-8", "replace")
+
+
+def generate_cached(tr, ctx: List[int], gen_len: int, temp: float,
+                    rng: np.random.RandomState,
+                    silent: bool = True) -> str:
+    """KV-cache incremental decoding; raises :class:`NoDecodeSupport`
+    when the net cannot run it (no cache-capable layers, non-causal
+    attention)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .trainer import NetTrainer
+
+    t_train = tr.graph.input_shape[-1]
+    dec_cfg = []
+    for n, v in tr.cfg:
+        if n == "input_shape":
+            v = "1,1,1"
+        elif n == "batch_size":
+            v = "1"
+        elif n in ("dev", "model_parallel", "seq_parallel", "zero",
+                   "fsdp", "update_on_server"):
+            # the decode twin is a single-device batch-1 loop; the
+            # training run's mesh/SP/sharding settings would make init
+            # fail (batch 1 can't split) or be meaningless
+            continue
+        dec_cfg.append((n, v))
+    dec_cfg += [("decode", "1"), ("decode_window", str(t_train)),
+                ("seq_parallel", "0")]
+    dec = NetTrainer()
+    dec.set_params(dec_cfg)
+    try:
+        dec.init_model()
+    except ValueError as e:
+        # e.g. non-causal attention can't decode incrementally
+        raise NoDecodeSupport(str(e)) from e
+    for key in dec.params:
+        if key not in tr.params:
+            raise ValueError(f"decode net key {key} missing from model")
+        dec.params[key] = tr.params[key]
+    net = dec.net
+    out_idx = net.out_node_index()
+    aux0 = net.init_aux(1)
+    if not aux0:
+        # no layer grew a KV cache (e.g. pipe_transformer blocks ignore
+        # decode=) — incremental stepping would silently see one token
+        # at a time
+        raise NoDecodeSupport()
+
+    @jax.jit
+    def step_fn(params, aux, tok, pos):
+        nodes, _, new_aux = net.forward(
+            params, tok, train=False, aux=aux, return_aux=True, step=pos
+        )
+        return nodes[out_idx].astype(jnp.float32), new_aux
+
+    aux = aux0
+    gen_n = gen_len
+    out_bytes = []
+    probs = None
+    for pos, tok in enumerate(ctx):
+        tok_a = np.asarray([[tok]], np.float32)
+        probs, aux = step_fn(dec.params, aux, tok_a,
+                             jnp.asarray(pos, jnp.int32))
+    pos = len(ctx)
+    for _ in range(gen_n):
+        nxt = sample_token(np.asarray(probs)[0, 0], rng, temp)
+        out_bytes.append(nxt)
+        if len(out_bytes) == gen_n:
+            break
+        tok_a = np.asarray([[nxt]], np.float32)
+        probs, aux = step_fn(dec.params, aux, tok_a,
+                             jnp.asarray(pos, jnp.int32))
+        pos += 1
+    return bytes(out_bytes).decode("utf-8", "replace")
+
+
+def generate(tr, prompt: str = "", gen_len: int = 256, temp: float = 0.0,
+             cache: bool = True, seed: Optional[int] = None,
+             silent: bool = True) -> str:
+    """Generate ``gen_len`` bytes continuing ``prompt`` from a trained
+    byte-level language model (``tr`` is a NetTrainer with a loaded or
+    trained model).
+
+    The KV-cache path serves requests that fit the training window
+    (prompt + gen_len <= T); anything longer falls back to the
+    cap-free sliding-window path, so ``gen_len`` is always honored.
+    """
+    if tr.graph is None:
+        raise ValueError("generate: init_model/load_model first")
+    ctx = list(prompt.encode("utf-8")) or [ord("\n")]
+    rng = np.random.RandomState(tr.seed if seed is None else seed)
+    t_train = tr.graph.input_shape[-1]
+    if cache and len(ctx) + gen_len <= t_train:
+        try:
+            return generate_cached(tr, ctx, gen_len, temp, rng,
+                                   silent=silent)
+        except NoDecodeSupport:
+            if not silent:
+                print("gen_cache: net has no KV-cache-capable layers; "
+                      "using the sliding-window path")
+    elif cache and not silent:
+        print(f"gen_cache: prompt ({len(ctx)}) + gen_len ({gen_len}) "
+              f"exceeds the KV window ({t_train}); using the "
+              "sliding-window path (set gen_cache = 0 to silence this)")
+    return generate_windowed(tr, ctx, gen_len, temp, rng)

@@ -874,6 +874,14 @@ class NetTrainer:
             f.write(buf.getvalue())
 
     def load_model(self, path: str) -> None:
+        if not any(n == "netconfig" for n, _ in self.cfg):
+            raise ValueError(
+                "load_model: set the model conf first (checkpoints store "
+                "the net STRUCTURE; layer settings come from the conf — "
+                "reference parity: pred.conf carries the full netconfig "
+                "section).  Net(cfg=conf_text) / set_params(...) before "
+                "load_model."
+            )
         header, raw, raw_aux, raw_ust = self._read_model_file(path)
         graph = NetGraph.structure_from_json(json.dumps(header["structure"]))
         self._build_net(graph)

@@ -182,6 +182,17 @@ class Net:
             return self._trainer.extract_feature(batch, name)[:n]
         return self._trainer.extract_feature(_as_batch(np.asarray(data), None), name)
 
+    def generate(self, prompt: str = "", gen_len: int = 256,
+                 temp: float = 0.0, cache: bool = True,
+                 seed: Optional[int] = None) -> str:
+        """Continue ``prompt`` from a trained byte-level language model
+        (new scope; no reference analog).  KV-cache incremental decoding
+        by default, sliding-window fallback — ``nnet/generate.py``."""
+        from .nnet.generate import generate
+
+        return generate(self._trainer, prompt, gen_len, temp,
+                        cache=cache, seed=seed)
+
     def set_weight(self, weight: np.ndarray, layer_name: str, tag: str) -> None:
         self._trainer.set_weight(np.asarray(weight, np.float32), layer_name, tag)
 

@@ -455,3 +455,36 @@ def test_lm_cached_decode_matches_full_forward(corpus):
             got, full[pos], rtol=2e-4, atol=2e-5,
             err_msg=f"decode twin diverged at position {pos}",
         )
+
+
+@pytest.mark.slow
+def test_net_generate_wrapper_api(corpus):
+    """Python-API generation (Net.generate): cached and windowed paths
+    agree greedily, and over-window requests fall back transparently."""
+    from cxxnet_tpu.wrapper import Net
+
+    conf = transformer_lm_conf(
+        seq_len=32, dim=64, nhead=2, nlayer=2, text_file=corpus,
+        batch_size=16, dev="cpu", compute_dtype="float32",
+    )
+    net = Net(dev="cpu", cfg=conf)
+    net.init_model()
+    it = create_iterator(
+        cfgmod.split_sections(cfgmod.parse_pairs(conf)).find("data")[0]
+        .entries
+    )
+    it.set_param("batch_size", "16")
+    it.set_param("silent", "1")
+    it.init()
+    for _ in range(10):
+        it.before_first()
+        while it.next():
+            b = it.value()
+            net.update(b.data, b.label)
+    cached = net.generate("the quick ", gen_len=20)
+    windowed = net.generate("the quick ", gen_len=20, cache=False)
+    assert cached == windowed
+    assert "brown" in cached
+    # over-window request: falls back to windows, honors gen_len
+    long = net.generate("the quick ", gen_len=60)
+    assert len(long.encode("utf-8", "replace")) >= 60 - 3  # multibyte slack

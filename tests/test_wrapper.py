@@ -164,3 +164,28 @@ def test_train_loop_with_ndarray():
     x, y = toy_xy(16)
     net = train(MLP_CFG, x, num_round=3, param={}, label=y, dev="cpu")
     assert net.trainer.epoch_counter == 3
+
+
+def test_load_model_without_conf_errors_clearly(tmp_path):
+    """Checkpoints are structure-only (reference parity): loading into a
+    bare Net must say so instead of failing deep in shape inference."""
+    import pytest
+
+    from cxxnet_tpu.wrapper import Net
+
+    conf = """
+netconfig = start
+layer[0->1] = fullc:fc
+  nhidden = 4
+layer[1->1] = softmax
+netconfig = end
+input_shape = 1,1,8
+batch_size = 4
+eta = 0.1
+"""
+    net = Net(dev="cpu", cfg=conf)
+    net.init_model()
+    net.save_model(str(tmp_path / "m.model"))
+    bare = Net(dev="cpu")
+    with pytest.raises(ValueError, match="netconfig"):
+        bare.load_model(str(tmp_path / "m.model"))
