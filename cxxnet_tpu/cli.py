@@ -50,6 +50,8 @@ class LearnTask:
         self.gen_prompt_file = ""
         self.gen_len = 256
         self.gen_temp = 0.0
+        self.gen_topk = 0
+        self.gen_topp = 0.0
         self.gen_cache = 1
         self.cfg: List[tuple] = []
 
@@ -93,6 +95,10 @@ class LearnTask:
             self.gen_len = int(val)
         elif name == "gen_temp":
             self.gen_temp = float(val)
+        elif name == "gen_topk":
+            self.gen_topk = int(val)
+        elif name == "gen_topp":
+            self.gen_topp = float(val)
         elif name == "gen_cache":
             self.gen_cache = int(val)
         self.cfg.append((name, val))
@@ -445,7 +451,8 @@ class LearnTask:
                 prompt = f.read().decode("utf-8", "replace")
         text = generate(
             self.net_trainer, prompt, self.gen_len, self.gen_temp,
-            cache=bool(self.gen_cache), silent=bool(self.silent),
+            cache=bool(self.gen_cache), topk=self.gen_topk,
+            topp=self.gen_topp, silent=bool(self.silent),
         )
         with open(self.name_pred, "w", encoding="utf-8") as fo:
             fo.write(text)

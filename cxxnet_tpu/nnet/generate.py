@@ -31,22 +31,35 @@ class NoDecodeSupport(Exception):
 
 
 def sample_token(p_row: np.ndarray, rng: np.random.RandomState,
-                 temp: float) -> int:
+                 temp: float, topk: int = 0, topp: float = 0.0) -> int:
     """Greedy (``temp == 0``) or log-space temperature sampling
     (``p^(1/temp)`` computed max-subtracted so low temperatures never
-    underflow to all-zeros)."""
-    if temp > 0:
-        lp = np.log(np.maximum(np.asarray(p_row, np.float64),
-                               1e-300)) / temp
-        lp -= lp.max()
-        pe = np.exp(lp)
+    underflow to all-zeros), optionally truncated to the ``topk``
+    highest-probability tokens and/or the ``topp`` nucleus (smallest
+    set of tokens whose probability mass reaches ``topp``)."""
+    if temp <= 0:
+        return int(np.argmax(p_row))
+    lp = np.log(np.maximum(np.asarray(p_row, np.float64), 1e-300)) / temp
+    lp -= lp.max()
+    pe = np.exp(lp)
+    pe /= pe.sum()
+    if topk and topk < len(pe):
+        cut = np.argsort(pe)[:-topk]
+        pe[cut] = 0.0
         pe /= pe.sum()
-        return int(rng.choice(len(pe), p=pe))
-    return int(np.argmax(p_row))
+    if 0.0 < topp < 1.0:
+        order = np.argsort(-pe)
+        csum = np.cumsum(pe[order])
+        keep_n = int(np.searchsorted(csum, topp) + 1)
+        drop = order[keep_n:]
+        pe[drop] = 0.0
+        pe /= pe.sum()
+    return int(rng.choice(len(pe), p=pe))
 
 
 def generate_windowed(tr, ctx: List[int], gen_len: int, temp: float,
-                      rng: np.random.RandomState) -> str:
+                      rng: np.random.RandomState, topk: int = 0,
+                      topp: float = 0.0) -> str:
     """Sliding-window generation: re-run the trained net's full forward
     per token (the context occupies positions ``0..L-1``; causal masking
     makes the tail padding invisible, so one compiled program serves
@@ -64,22 +77,25 @@ def generate_windowed(tr, ctx: List[int], gen_len: int, temp: float,
         probs = tr.extract_feature(
             DataBatch(data=data, label=None), "top[-1]"
         )[0, ln - 1]
-        nxt = sample_token(probs, rng, temp)
+        nxt = sample_token(probs, rng, temp, topk, topp)
         ctx.append(nxt)
         out_bytes.append(nxt)
     return bytes(out_bytes).decode("utf-8", "replace")
 
 
-def generate_cached(tr, ctx: List[int], gen_len: int, temp: float,
-                    rng: np.random.RandomState,
-                    silent: bool = True) -> str:
-    """KV-cache incremental decoding; raises :class:`NoDecodeSupport`
-    when the net cannot run it (no cache-capable layers, non-causal
-    attention)."""
+def _decode_twin(tr):
+    """(decode trainer, jitted single-token step, fresh aux) — cached on
+    ``tr`` so repeated ``generate`` calls pay net construction and jit
+    compilation once; invalidated when the params object changes (a new
+    training step or load swaps the pytree)."""
     import jax
     import jax.numpy as jnp
 
     from .trainer import NetTrainer
+
+    cached = getattr(tr, "_decode_twin_cache", None)
+    if cached is not None and cached[0] is tr.params:
+        return cached[1], cached[2]
 
     t_train = tr.graph.input_shape[-1]
     dec_cfg = []
@@ -110,12 +126,13 @@ def generate_cached(tr, ctx: List[int], gen_len: int, temp: float,
         dec.params[key] = tr.params[key]
     net = dec.net
     out_idx = net.out_node_index()
-    aux0 = net.init_aux(1)
-    if not aux0:
+    if not net.init_aux(1):
         # no layer grew a KV cache (e.g. pipe_transformer blocks ignore
         # decode=) — incremental stepping would silently see one token
         # at a time
-        raise NoDecodeSupport()
+        raise NoDecodeSupport(
+            "net has no KV-cache-capable layers"
+        )
 
     @jax.jit
     def step_fn(params, aux, tok, pos):
@@ -124,8 +141,20 @@ def generate_cached(tr, ctx: List[int], gen_len: int, temp: float,
         )
         return nodes[out_idx].astype(jnp.float32), new_aux
 
-    aux = aux0
-    gen_n = gen_len
+    tr._decode_twin_cache = (tr.params, dec, step_fn)
+    return dec, step_fn
+
+
+def generate_cached(tr, ctx: List[int], gen_len: int, temp: float,
+                    rng: np.random.RandomState, topk: int = 0,
+                    topp: float = 0.0) -> str:
+    """KV-cache incremental decoding; raises :class:`NoDecodeSupport`
+    when the net cannot run it (no cache-capable layers, non-causal
+    attention)."""
+    import jax.numpy as jnp
+
+    dec, step_fn = _decode_twin(tr)
+    aux = dec.net.init_aux(1)
     out_bytes = []
     probs = None
     for pos, tok in enumerate(ctx):
@@ -133,10 +162,10 @@ def generate_cached(tr, ctx: List[int], gen_len: int, temp: float,
         probs, aux = step_fn(dec.params, aux, tok_a,
                              jnp.asarray(pos, jnp.int32))
     pos = len(ctx)
-    for _ in range(gen_n):
-        nxt = sample_token(np.asarray(probs)[0, 0], rng, temp)
+    for _ in range(gen_len):
+        nxt = sample_token(np.asarray(probs)[0, 0], rng, temp, topk, topp)
         out_bytes.append(nxt)
-        if len(out_bytes) == gen_n:
+        if len(out_bytes) == gen_len:
             break
         tok_a = np.asarray([[nxt]], np.float32)
         probs, aux = step_fn(dec.params, aux, tok_a,
@@ -147,6 +176,7 @@ def generate_cached(tr, ctx: List[int], gen_len: int, temp: float,
 
 def generate(tr, prompt: str = "", gen_len: int = 256, temp: float = 0.0,
              cache: bool = True, seed: Optional[int] = None,
+             topk: int = 0, topp: float = 0.0,
              silent: bool = True) -> str:
     """Generate ``gen_len`` bytes continuing ``prompt`` from a trained
     byte-level language model (``tr`` is a NetTrainer with a loaded or
@@ -164,13 +194,13 @@ def generate(tr, prompt: str = "", gen_len: int = 256, temp: float = 0.0,
     if cache and len(ctx) + gen_len <= t_train:
         try:
             return generate_cached(tr, ctx, gen_len, temp, rng,
-                                   silent=silent)
-        except NoDecodeSupport:
+                                   topk, topp)
+        except NoDecodeSupport as e:
             if not silent:
-                print("gen_cache: net has no KV-cache-capable layers; "
+                print(f"gen_cache: {e or 'not supported by this net'}; "
                       "using the sliding-window path")
     elif cache and not silent:
         print(f"gen_cache: prompt ({len(ctx)}) + gen_len ({gen_len}) "
               f"exceeds the KV window ({t_train}); using the "
               "sliding-window path (set gen_cache = 0 to silence this)")
-    return generate_windowed(tr, ctx, gen_len, temp, rng)
+    return generate_windowed(tr, ctx, gen_len, temp, rng, topk, topp)

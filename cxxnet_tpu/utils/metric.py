@@ -110,6 +110,19 @@ class MetricRecall(Metric):
         return total
 
 
+class MetricPerplexity(MetricLogloss):
+    """exp(mean NLL) — the language-modeling spelling of logloss
+    (per-token when the prediction is a sequence; new scope, no
+    reference analog)."""
+
+    name = "perplexity"
+
+    def get(self) -> float:
+        import math
+
+        return math.exp(self.sum_metric / max(self.cnt_inst, 1))
+
+
 def create_metric(name: str) -> Metric:
     if name == "error":
         return MetricError()
@@ -117,6 +130,8 @@ def create_metric(name: str) -> Metric:
         return MetricRMSE()
     if name == "logloss":
         return MetricLogloss()
+    if name == "perplexity":
+        return MetricPerplexity()
     if name.startswith("rec@"):
         return MetricRecall(name)
     raise ValueError(f"Metric: unknown metric name: {name}")

@@ -184,14 +184,15 @@ class Net:
 
     def generate(self, prompt: str = "", gen_len: int = 256,
                  temp: float = 0.0, cache: bool = True,
-                 seed: Optional[int] = None) -> str:
+                 seed: Optional[int] = None, topk: int = 0,
+                 topp: float = 0.0) -> str:
         """Continue ``prompt`` from a trained byte-level language model
         (new scope; no reference analog).  KV-cache incremental decoding
         by default, sliding-window fallback — ``nnet/generate.py``."""
         from .nnet.generate import generate
 
         return generate(self._trainer, prompt, gen_len, temp,
-                        cache=cache, seed=seed)
+                        cache=cache, seed=seed, topk=topk, topp=topp)
 
     def set_weight(self, weight: np.ndarray, layer_name: str, tag: str) -> None:
         self._trainer.set_weight(np.asarray(weight, np.float32), layer_name, tag)

@@ -488,3 +488,37 @@ def test_net_generate_wrapper_api(corpus):
     # over-window request: falls back to windows, honors gen_len
     long = net.generate("the quick ", gen_len=60)
     assert len(long.encode("utf-8", "replace")) >= 60 - 3  # multibyte slack
+
+
+def test_sample_token_topk_topp():
+    from cxxnet_tpu.nnet.generate import sample_token
+
+    rng = np.random.RandomState(0)
+    p = np.asarray([0.5, 0.3, 0.15, 0.05])
+    # greedy ignores truncation
+    assert sample_token(p, rng, 0.0, topk=1) == 0
+    # topk=2: only tokens 0/1 ever drawn
+    draws = {sample_token(p, rng, 1.0, topk=2) for _ in range(200)}
+    assert draws <= {0, 1}
+    # topp=0.6: nucleus is {0, 1} (0.5 + 0.3 >= 0.6)
+    draws = {sample_token(p, rng, 1.0, topp=0.6) for _ in range(200)}
+    assert draws <= {0, 1}
+    # no truncation: all tokens reachable
+    draws = {sample_token(p, rng, 1.0) for _ in range(500)}
+    assert draws == {0, 1, 2, 3}
+
+
+def test_perplexity_metric():
+    import math
+
+    from cxxnet_tpu.utils.metric import MetricSet
+
+    ms = MetricSet()
+    ms.add_metric("perplexity")
+    # uniform over 4 classes -> perplexity 4, per token
+    pred = np.full((2, 3, 4), 0.25, np.float32)
+    label = np.zeros((2, 3), np.float32)
+    ms.add_eval(pred, label, {"label": (0, 3)})
+    assert abs(ms.metrics[0].get() - 4.0) < 1e-6
+    assert abs(math.log(ms.metrics[0].get()) -
+               (-math.log(0.25))) < 1e-6
