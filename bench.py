@@ -50,6 +50,23 @@ def _emit(tag: str, img_s: float, batch: int) -> None:
     print(f"# bench[{tag}]: {img_s:.1f} img/s/chip", file=sys.stderr, flush=True)
 
 
+def _time_scans(tr, data, labels, scan_k: int, n_scans: int = 3,
+                per_step_data: bool = False) -> float:
+    """Warm twice, time n_scans device-side scans, return sec/step —
+    the shared measurement harness of every bench mode."""
+    import jax
+
+    kw = {} if per_step_data else {"n_steps": scan_k}
+    for _ in range(2):
+        tr.update_scan(data, labels, **kw)
+    jax.block_until_ready(tr.params)
+    t0 = time.perf_counter()
+    for _ in range(n_scans):
+        tr.update_scan(data, labels, **kw)
+    jax.block_until_ready(tr.params)
+    return (time.perf_counter() - t0) / n_scans / scan_k
+
+
 def bench_io(batch: int, scan_k: int) -> None:
     """``--io`` mode: the measured path includes the REAL input pipeline
     (imgbin JPEG shards -> native decode pool -> crop/mirror augment ->
@@ -149,15 +166,40 @@ def bench_lm(batch: int, seq_len: int, scan_k: int) -> None:
     rng = np.random.RandomState(0)
     data = rng.randint(0, 255, (scan_k, batch, seq_len)).astype(np.float32)
     labels = rng.randint(0, 255, (scan_k, batch, seq_len)).astype(np.float32)
-    tr.update_scan(data, labels)
-    jax.block_until_ready(tr.params)
-    t0 = time.perf_counter()
-    tr.update_scan(data, labels)
-    jax.block_until_ready(tr.params)
-    dt = (time.perf_counter() - t0) / scan_k
+    dt = _time_scans(tr, data, labels, scan_k, n_scans=1,
+                     per_step_data=True)
     print(
         f"# bench[lm]: T={seq_len} b{batch} d512 L4: {dt*1e3:.1f} ms/step "
         f"= {batch*seq_len/dt/1e3:.0f}k tokens/s/chip",
+        file=sys.stderr, flush=True,
+    )
+
+
+def bench_resnet(batch: int, scan_k: int) -> None:
+    """``--resnet`` mode: ResNet-50 training throughput (stderr only —
+    the stdout JSON stays the BASELINE GoogLeNet metric)."""
+    import jax
+
+    from cxxnet_tpu import config as cfgmod
+    from cxxnet_tpu.models import resnet50_conf
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    tr = NetTrainer()
+    tr.set_params(cfgmod.parse_pairs(
+        resnet50_conf(batch_size=batch, input_size=224, synthetic=False,
+                      dev="tpu")
+    ))
+    tr.eval_train = 0
+    tr.init_model()
+    rng = np.random.RandomState(0)
+    data = jax.device_put(rng.randn(batch, 224, 224, 3).astype(np.float32))
+    labels = jax.device_put(
+        rng.randint(0, 1000, (batch, 1)).astype(np.float32)
+    )
+    dt = _time_scans(tr, data, labels, scan_k)
+    print(
+        f"# bench[resnet]: ResNet-50 b{batch} bf16: {dt*1e3:.1f} ms/step "
+        f"= {batch/dt:.0f} img/s/chip",
         file=sys.stderr, flush=True,
     )
 
@@ -170,9 +212,11 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
-    args = [a for a in sys.argv[1:] if a not in ("--io", "--lm")]
+    args = [a for a in sys.argv[1:] if a not in ("--io", "--lm",
+                                                 "--resnet")]
     io_mode = "--io" in sys.argv[1:]
     lm_mode = "--lm" in sys.argv[1:]
+    resnet_mode = "--resnet" in sys.argv[1:]
     batch_given = len(args) > 0
     batch = int(args[0]) if batch_given else 128
     scan_k = int(args[1]) if len(args) > 1 else 50
@@ -183,6 +227,9 @@ def main() -> None:
     if lm_mode:
         bench_lm(batch=batch if batch_given else 8, seq_len=2048,
                  scan_k=min(scan_k, 20))
+        return
+    if resnet_mode:
+        bench_resnet(batch, min(scan_k, 30))
         return
 
     from __graft_entry__ import _build_googlenet

@@ -11,7 +11,15 @@ tests against the pure-XLA implementation (the PairTest discipline,
 SURVEY §4.1).
 """
 
-from .attention import mha, ring_attention, ring_self_attention  # noqa: F401
-from .flash import flash_mha  # noqa: F401
+from .attention import (  # noqa: F401
+    a2a_self_attention,
+    mha,
+    ring_attention,
+    ring_attention_flash,
+    ring_self_attention,
+    ring_self_attention_flash,
+)
+from .flash import flash_mha, flash_mha_lse  # noqa: F401
+from .maxpool import maxpool_bwd_s1, maxpool_fused  # noqa: F401
 from .lrn import lrn, lrn_xla  # noqa: F401
 from .pipeline import gpipe, pipeline_apply  # noqa: F401
