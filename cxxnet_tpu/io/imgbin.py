@@ -25,7 +25,7 @@ import io as _io
 import os
 import struct
 import warnings
-from typing import IO, List, Optional, Sequence, Tuple
+from typing import IO, List, Optional, Tuple
 
 import numpy as np
 

@@ -38,9 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import config as cfgmod
 from ..io.data import DataBatch
-from ..layers import LossLayer
 from ..parallel import MeshPlan, make_mesh
 from ..parallel.distributed import fetch_array, fetch_local_rows
 from ..updater import Updater, create_updater

@@ -19,8 +19,6 @@ sharded on ``axis_name``.
 from __future__ import annotations
 
 import functools
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 from jax import lax

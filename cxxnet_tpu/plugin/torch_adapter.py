@@ -21,7 +21,7 @@ host memory, exactly like the reference plugin's extra blob copies.
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Sequence
+from typing import List, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -16,7 +16,7 @@ anywhere a 4-D tensor is (it is reshaped to ``(N, 1, 1, D)``).
 from __future__ import annotations
 
 import sys
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
+from typing import Dict, Iterable, Optional, Tuple, Union
 
 import numpy as np
 
