@@ -456,8 +456,9 @@ class LearnTask:
         )
         with open(self.name_pred, "w", encoding="utf-8") as fo:
             fo.write(text)
-        print(f"generated {len(text.encode())} bytes -> {self.name_pred}")
-        print(text)
+        if not self.silent:
+            print(f"generated {len(text.encode())} bytes -> {self.name_pred}")
+            print(text)
 
     def task_extract(self) -> None:
         if self.itr_pred is None:

@@ -30,14 +30,31 @@ def _load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_LIB_PATH):
+    # Rebuild when the .so is missing or older than its source — a stale
+    # library must never mask source drift.  An fcntl lock serializes
+    # concurrent first-builds (multi-process training ranks all racing
+    # make); the Makefile renames atomically so a mapped .so is never
+    # rewritten in place.
+    ndir = os.path.abspath(_NATIVE_DIR)
+    src = os.path.join(ndir, "cxxnet_io.cc")
+    try:
+        stale = (not os.path.exists(_LIB_PATH)
+                 or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src))
+    except OSError:
+        stale = True
+    if stale:
         try:
-            subprocess.run(
-                ["make", "-C", os.path.abspath(_NATIVE_DIR)],
-                check=True, capture_output=True, timeout=120,
-            )
+            import fcntl
+
+            with open(os.path.join(ndir, ".build.lock"), "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                subprocess.run(
+                    ["make", "-C", ndir],
+                    check=True, capture_output=True, timeout=120,
+                )
         except Exception:
-            return None
+            if not os.path.exists(_LIB_PATH):
+                return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:

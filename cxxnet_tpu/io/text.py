@@ -136,9 +136,16 @@ class TextIterator(DataIter):
             take = np.concatenate([take, self._starts[: self._padd]])
         idx = take[:, None] + np.arange(t + 1)[None, :]
         win = self._raw[idx].astype(np.float32)
+        # inst_index mirrors `take`: wrapped pad rows reuse the leading
+        # window ids, so prediction bookkeeping stays attributable
+        inst = np.arange(lo, hi, dtype=np.uint32)
+        if self._padd:
+            inst = np.concatenate(
+                [inst, np.arange(self._padd, dtype=np.uint32)]
+            )
         return DataBatch(
             data=win[:, :-1],
             label=win[:, 1:],
-            inst_index=np.arange(lo, lo + self.batch_size, dtype=np.uint32),
+            inst_index=inst,
             num_batch_padd=self._padd,
         )

@@ -846,10 +846,15 @@ class NetTrainer:
         }
         if self.save_ustate and self._rng_key is not None:
             # exact resume includes the training rng stream (dropout /
-            # insanity noise), not just optimizer state
+            # insanity noise), not just optimizer state; the impl name is
+            # recorded so a process with a different jax_default_prng_impl
+            # reconstructs the same stream rather than silently diverging
             header["rng_key"] = np.asarray(
                 jax.random.key_data(self._rng_key)
             ).tolist()
+            header["rng_impl"] = str(
+                jax.config.jax_default_prng_impl
+            )
         hjson = json.dumps(header).encode("utf-8")
         buf = _io.BytesIO()
         flat = {}
@@ -890,7 +895,10 @@ class NetTrainer:
         self._grad_accum = None  # drop any half-window from before load
         if "rng_key" in header:
             self._rng_key = jax.random.wrap_key_data(
-                jnp.asarray(header["rng_key"], jnp.uint32)
+                jnp.asarray(header["rng_key"], jnp.uint32),
+                impl=header.get(
+                    "rng_impl", str(jax.config.jax_default_prng_impl)
+                ),
             )
         else:
             self._rng_key = jax.random.PRNGKey(self.seed + 1)

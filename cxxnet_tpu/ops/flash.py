@@ -100,6 +100,12 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         corr = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
+        if causal:
+            # a query row fully masked within a live block leaves m_new at
+            # NEG_INF, making exp(s - m_new) = 1 for every masked entry;
+            # zero such rows so `out` alone is valid even under the
+            # non-block-aligned offsets the public flash_mha_lse allows
+            p = jnp.where(m_new > NEG_INF * 0.5, p, 0.0)
         l[:, :1] = l[:, :1] * corr + p.sum(axis=-1, keepdims=True)
         m[:, :1] = m_new
         pv = jax.lax.dot_general(
