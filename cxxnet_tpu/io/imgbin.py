@@ -5,12 +5,24 @@ with a parallel ``.lst`` label file, double-buffered across two reader
 threads (``/root/reference/src/io/iter_thread_imbin_x-inl.hpp``,
 ``/root/reference/src/utils/io.h:225-300``).  This implementation keeps
 the same architecture — page-granular sequential reads, shard sharding by
-worker rank, background prefetch — with its own page layout (magic
-``CXBP``; the reference's binary layout is not reimplemented bit-for-bit,
-``tools/im2bin.py`` regenerates packs from images):
+worker rank, background prefetch — and reads TWO page layouts,
+auto-detected per file by the leading u32:
 
-    page file := { page }*
-    page      := magic u32 | nrec u32 | {len u32}*nrec | {blob}*nrec
+* ``CXBP`` (this framework's native layout; written by
+  ``tools/im2bin.py`` default mode):
+
+      page file := { page }*
+      page      := magic u32 | nrec u32 | {len u32}*nrec | {blob}*nrec
+
+* the reference's ``BinaryPage`` bit-format
+  (``/root/reference/src/utils/io.h:225-300``; written by the
+  reference's ``tools/im2bin.cpp``): fixed 64 MiB pages of little-endian
+  i32s where ``data[0] = nrec``, ``data[1..nrec+1]`` are cumulative blob
+  byte sizes (``data[1] = 0``), and blob ``r`` occupies the byte range
+  ``[page_end - data[r+2], page_end - data[r+1])`` — blobs pack
+  backwards from the end of the page.  ``RefBinPageWriter`` emits this
+  layout byte-for-byte, so cxxnet-era ``.bin`` + ``.lst`` packs train
+  without repacking (and new packs can be written for the reference).
 
 ``.lst`` line format parity: ``index \t label(s) \t filename``.
 
@@ -33,6 +45,9 @@ from .batch import DataInst, InstIterator
 
 PAGE_MAGIC = 0x43584250  # "CXBP"
 DEFAULT_PAGE_SIZE = 64 << 20
+# the reference's BinaryPage: kPageSize = 64<<18 i32s = 64 MiB exactly
+# (io.h:226); every page on disk is this many bytes, full or not
+REF_PAGE_BYTES = (64 << 18) * 4
 
 
 class BinPageWriter:
@@ -65,8 +80,104 @@ class BinPageWriter:
         self.f.close()
 
 
-def iter_bin_pages(path: str):
-    """Yield lists of blobs, one list per page (sequential 64MB reads)."""
+class RefBinPageWriter:
+    """Write the reference's BinaryPage bit-format byte-for-byte.
+
+    Mirrors ``BinaryPage::Push/Save`` (io.h:254-271) + the ``im2bin.cpp``
+    page-flush loop: i32 header array growing from the front, blobs
+    packing backwards from the 64 MiB page end, every saved page padded
+    to exactly ``REF_PAGE_BYTES``.
+    """
+
+    def __init__(self, path: str) -> None:
+        self.f: IO[bytes] = open(path, "wb")
+        self._blobs: List[bytes] = []
+        self._cum = 0  # data_[nrec+1]: cumulative blob bytes
+
+    def _free_bytes(self) -> int:
+        # FreeBytes() (io.h:286-288): ints not yet used by the header,
+        # minus the blob bytes already packed at the tail
+        n = len(self._blobs)
+        return (REF_PAGE_BYTES // 4 - (n + 2)) * 4 - self._cum
+
+    def push(self, blob: bytes) -> None:
+        if self._free_bytes() < len(blob) + 4:
+            self.flush_page()
+            if self._free_bytes() < len(blob) + 4:
+                raise ValueError(
+                    f"blob of {len(blob)} bytes exceeds the 64 MiB page"
+                )
+        self._blobs.append(blob)
+        self._cum += len(blob)
+
+    def flush_page(self) -> None:
+        if not self._blobs:
+            return
+        hdr = np.zeros(len(self._blobs) + 2, "<i4")
+        hdr[0] = len(self._blobs)
+        hdr[1:] = 0
+        np.cumsum([len(b) for b in self._blobs], out=hdr[2:])
+        page = bytearray(REF_PAGE_BYTES)
+        page[: hdr.nbytes] = hdr.tobytes()
+        end = REF_PAGE_BYTES
+        for b in self._blobs:  # first blob lands at the very page end
+            page[end - len(b): end] = b
+            end -= len(b)
+        self.f.write(page)
+        self._blobs, self._cum = [], 0
+
+    def close(self) -> None:
+        self.flush_page()
+        self.f.close()
+
+
+def detect_bin_format(path: str) -> str:
+    """``'cxbp'`` or ``'ref'`` by the leading u32.  A reference page
+    starts with its record count — far below the CXBP magic value — and
+    reference files are whole 64 MiB pages."""
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        head = f.read(8)
+    if len(head) < 8:
+        raise ValueError(f"{path}: too short to be a page file")
+    first, second = struct.unpack("<II", head)
+    if first == PAGE_MAGIC:
+        return "cxbp"
+    if size % REF_PAGE_BYTES == 0 and second == 0:
+        return "ref"
+    raise ValueError(
+        f"{path}: neither CXBP (magic {PAGE_MAGIC:#x}) nor reference "
+        f"BinaryPage (64 MiB pages, first offset 0); got "
+        f"head=({first:#x}, {second:#x}), size={size}"
+    )
+
+
+def iter_ref_bin_pages(path: str):
+    """Yield lists of blobs from a reference-format ``.bin`` (io.h layout)."""
+    with open(path, "rb") as f:
+        while True:
+            page = f.read(REF_PAGE_BYTES)
+            if not page:
+                return
+            if len(page) < REF_PAGE_BYTES:
+                raise ValueError(f"{path}: truncated 64 MiB page")
+            nrec = struct.unpack_from("<i", page)[0]
+            if nrec < 0 or (nrec + 2) * 4 > REF_PAGE_BYTES:
+                raise ValueError(f"{path}: corrupt page (nrec={nrec})")
+            offs = np.frombuffer(page, "<i4", count=nrec + 1, offset=4)
+            if offs[0] != 0 or (np.diff(offs) < 0).any() or (
+                int(offs[-1]) + (nrec + 2) * 4 > REF_PAGE_BYTES
+            ):
+                raise ValueError(f"{path}: corrupt page offsets")
+            yield [
+                page[REF_PAGE_BYTES - int(offs[r + 1]):
+                     REF_PAGE_BYTES - int(offs[r])]
+                for r in range(nrec)
+            ]
+
+
+def iter_cxbp_pages(path: str):
+    """Yield lists of blobs, one list per CXBP page (sequential reads)."""
     with open(path, "rb") as f:
         while True:
             hdr = f.read(8)
@@ -77,6 +188,18 @@ def iter_bin_pages(path: str):
                 raise ValueError(f"{path}: bad page magic {magic:#x}")
             lens = struct.unpack(f"<{nrec}I", f.read(4 * nrec))
             yield [f.read(l) for l in lens]
+
+
+def iter_bin_pages(path: str):
+    """Yield lists of blobs per page; the layout is auto-detected, so
+    cxxnet-era reference packs and native CXBP packs both read.  An
+    empty pack (what a writer closed on zero pushes produces) yields no
+    pages."""
+    if os.path.getsize(path) < 8:
+        return iter(())
+    if detect_bin_format(path) == "ref":
+        return iter_ref_bin_pages(path)
+    return iter_cxbp_pages(path)
 
 
 def parse_lst_line(line: str) -> Tuple[int, np.ndarray, str]:

@@ -34,6 +34,8 @@
 namespace {
 
 constexpr uint32_t kPageMagic = 0x43584250;  // "CXBP"
+// the reference's BinaryPage: (64<<18) i32s = 64 MiB exactly (io.h:226)
+constexpr size_t kRefPageBytes = (64u << 18) * 4;
 constexpr size_t kInQueueCap = 512;          // encoded blobs in flight
 // Sanity bounds on untrusted on-disk length fields: a 64 MB page format
 // cannot legitimately exceed these; reject instead of bad_alloc-ing.
@@ -170,6 +172,57 @@ class Pipeline {
     }
   }
 
+  // Blocks until queue space frees; false when the pipeline is stopping.
+  bool PushRecord(Record&& r) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_in_.wait(lk, [this] { return stop_ || in_.size() < kInQueueCap; });
+    if (stop_) return false;
+    in_.push_back(std::move(r));
+    cv_in_.notify_all();
+    return true;
+  }
+
+  // Parse one reference-format BinaryPage (io.h:225-300): `first` is the
+  // already-consumed record count (the page's leading i32), the 0 that
+  // followed it was cumulative-offset[0].  Blob r spans bytes
+  // [page_end - off[r+1], page_end - off[r]).  Returns 1 ok, 0 corrupt
+  // (err set), -1 pipeline stopping.
+  int ReadRefPage(FILE* f, uint32_t first, const std::string& path,
+                  uint64_t* seq, std::string* err) {
+    const uint32_t nrec = first;
+    if (nrec > kMaxRecordsPerPage ||
+        (static_cast<size_t>(nrec) + 2) * 4 > kRefPageBytes) {
+      *err = "corrupt reference page (record count) in shard: " + path;
+      return 0;
+    }
+    std::vector<uint8_t> page(kRefPageBytes);
+    std::memcpy(page.data(), &nrec, 4);
+    std::memset(page.data() + 4, 0, 4);
+    if (std::fread(page.data() + 8, 1, kRefPageBytes - 8, f) !=
+        kRefPageBytes - 8) {
+      *err = "truncated reference page in shard: " + path;
+      return 0;
+    }
+    std::vector<int32_t> offs(nrec + 1);
+    std::memcpy(offs.data(), page.data() + 4, (nrec + 1) * 4);
+    for (uint32_t r = 0; r < nrec; ++r) {
+      const int64_t lo = offs[r], hi = offs[r + 1];
+      if (lo < 0 || hi < lo ||
+          hi + (static_cast<int64_t>(nrec) + 2) * 4 >
+              static_cast<int64_t>(kRefPageBytes)) {
+        *err = "corrupt reference page offsets in shard: " + path;
+        return 0;
+      }
+      Record rec;
+      rec.seq = *seq;
+      rec.blob.assign(page.data() + kRefPageBytes - hi,
+                      page.data() + kRefPageBytes - lo);
+      if (!PushRecord(std::move(rec))) return -1;
+      ++*seq;
+    }
+    return 1;
+  }
+
   void ReadLoopImpl() {
     uint64_t seq = 0;
     std::string err;
@@ -180,11 +233,31 @@ class Pipeline {
         break;
       }
       bool shard_ok = true;
+      bool stopped = false;
       for (;;) {
         uint32_t hdr[2];
         size_t got = std::fread(hdr, sizeof(uint32_t), 2, f);
         if (got == 0) break;  // clean EOF
-        if (got != 2 || hdr[0] != kPageMagic) {
+        if (got == 2 && hdr[0] != kPageMagic) {
+          // auto-detect the reference BinaryPage bit-format (io.h:225-300):
+          // pages lead with the record count, not a magic, and the first
+          // cumulative offset is always 0
+          int rc = (hdr[1] == 0)
+                       ? ReadRefPage(f, hdr[0], path, &seq, &err)
+                       : 0;
+          if (rc == 0) {
+            if (err.empty())
+              err = "corrupt page header in shard: " + path;
+            shard_ok = false;
+            break;
+          }
+          if (rc < 0) {
+            stopped = true;
+            break;
+          }
+          continue;
+        }
+        if (got != 2) {
           err = "corrupt page header in shard: " + path;
           shard_ok = false;
           break;
@@ -215,19 +288,16 @@ class Pipeline {
             shard_ok = false;
             break;
           }
-          std::unique_lock<std::mutex> lk(mu_);
-          cv_in_.wait(lk, [this] { return stop_ || in_.size() < kInQueueCap; });
-          if (stop_) {
-            std::fclose(f);
-            return;
+          if (!PushRecord(std::move(r))) {
+            stopped = true;
+            break;
           }
-          in_.push_back(std::move(r));
           ++seq;
-          cv_in_.notify_all();
         }
-        if (!shard_ok) break;
+        if (!shard_ok || stopped) break;
       }
       std::fclose(f);
+      if (stopped) return;
       if (!shard_ok) break;
     }
     std::lock_guard<std::mutex> lk(mu_);

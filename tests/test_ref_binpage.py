@@ -1,0 +1,268 @@
+"""Reference BinaryPage bit-format: golden bytes, round-trip, end-to-end.
+
+The reference packs JPEGs into fixed 64 MiB pages of little-endian i32s
+(``/root/reference/src/utils/io.h:225-300``; writer
+``/root/reference/tools/im2bin.cpp``): ``data[0] = nrec``,
+``data[1..nrec+1]`` cumulative blob sizes, blobs packed backwards from
+the page end.  ``RefBinPageWriter`` must emit that layout byte-for-byte
+so cxxnet-era ``.bin`` + ``.lst`` packs train without repacking.
+"""
+
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.imgbin import (
+    REF_PAGE_BYTES,
+    ImageBinIterator,
+    RefBinPageWriter,
+    detect_bin_format,
+    iter_bin_pages,
+    iter_ref_bin_pages,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _golden_page(blobs):
+    """Hand-build one page exactly as BinaryPage::Push/Save would:
+    int array [N, 0, cum...] at the front, blobs back-to-front from the
+    page end (obj r at [end - off[r+1], end - off[r]))."""
+    page = bytearray(REF_PAGE_BYTES)
+    cum = np.concatenate([[0], np.cumsum([len(b) for b in blobs])])
+    hdr = np.concatenate([[len(blobs)], cum]).astype("<i4")
+    page[: hdr.nbytes] = hdr.tobytes()
+    for r, b in enumerate(blobs):
+        page[REF_PAGE_BYTES - int(cum[r + 1]):
+             REF_PAGE_BYTES - int(cum[r])] = b
+    return bytes(page)
+
+
+def test_writer_golden_bytes(tmp_path):
+    blobs = [b"hello", b"xyz", b"binpage"]
+    p = str(tmp_path / "a.bin")
+    w = RefBinPageWriter(p)
+    for b in blobs:
+        w.push(b)
+    w.close()
+    raw = open(p, "rb").read()
+    assert len(raw) == REF_PAGE_BYTES
+    assert raw == _golden_page(blobs)
+    # spot-check the C++ field semantics directly
+    ints = np.frombuffer(raw, "<i4", count=5)
+    assert list(ints) == [3, 0, 5, 8, 15]
+    assert raw[REF_PAGE_BYTES - 5:] == b"hello"          # first blob at page end
+    assert raw[REF_PAGE_BYTES - 8: REF_PAGE_BYTES - 5] == b"xyz"
+    assert raw[REF_PAGE_BYTES - 15: REF_PAGE_BYTES - 8] == b"binpage"
+
+
+def test_detect_and_roundtrip(tmp_path):
+    rng = np.random.RandomState(3)
+    blobs = [rng.bytes(rng.randint(1, 5000)) for _ in range(40)]
+    p = str(tmp_path / "r.bin")
+    w = RefBinPageWriter(p)
+    for b in blobs:
+        w.push(b)
+    w.close()
+    assert detect_bin_format(p) == "ref"
+    got = [b for page in iter_bin_pages(p) for b in page]
+    assert got == blobs
+
+
+def test_multi_page_spill(tmp_path):
+    # three ~25 MiB blobs: two fit a page, the third spills to page 2 —
+    # same decision rule as BinaryPage::Push returning false in im2bin
+    rng = np.random.RandomState(4)
+    mb25 = 25 << 20
+    blobs = [rng.bytes(mb25), rng.bytes(mb25), rng.bytes(mb25)]
+    p = str(tmp_path / "big.bin")
+    w = RefBinPageWriter(p)
+    for b in blobs:
+        w.push(b)
+    w.close()
+    assert os.path.getsize(p) == 2 * REF_PAGE_BYTES
+    pages = list(iter_ref_bin_pages(p))
+    assert [len(pg) for pg in pages] == [2, 1]
+    assert [b for pg in pages for b in pg] == blobs
+
+
+def test_oversize_blob_rejected(tmp_path):
+    w = RefBinPageWriter(str(tmp_path / "x.bin"))
+    with pytest.raises(ValueError, match="64 MiB page"):
+        w.push(b"\0" * (REF_PAGE_BYTES - 4))
+    w.close()
+
+
+def test_oversize_blob_rejected_mid_page(tmp_path):
+    # oversize after a valid push must also raise (not corrupt the pack)
+    p = str(tmp_path / "y.bin")
+    w = RefBinPageWriter(p)
+    w.push(b"ok")
+    with pytest.raises(ValueError, match="64 MiB page"):
+        w.push(b"\0" * REF_PAGE_BYTES)
+    w.close()
+    assert os.path.getsize(p) == REF_PAGE_BYTES
+    assert [b for pg in iter_bin_pages(p) for b in pg] == [b"ok"]
+
+
+def test_empty_pack_iterates_as_no_pages(tmp_path):
+    p = str(tmp_path / "empty.bin")
+    w = RefBinPageWriter(p)
+    w.close()
+    assert os.path.getsize(p) == 0
+    assert list(iter_bin_pages(p)) == []
+
+
+def test_im2bin_rejects_unknown_option(tmp_path):
+    lst = str(tmp_path / "i.lst")
+    open(lst, "w").write("0\t0\tx.jpg\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2bin.py"),
+         lst, str(tmp_path), str(tmp_path / "o.bin"), "--fromat", "ref"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 1
+    assert "unknown option" in r.stderr
+
+
+def _write_jpeg_pack(tmp_path, writer_cls, n=10, size=32):
+    from PIL import Image
+    import io as _io
+
+    binp = str(tmp_path / "pack.bin")
+    lst = str(tmp_path / "pack.lst")
+    w = writer_cls(binp)
+    arrs = []
+    with open(lst, "w") as f:
+        for i in range(n):
+            # smooth gradients survive JPEG nearly intact (noise wouldn't)
+            g = np.arange(size, dtype=np.float32)
+            arr = np.stack(
+                [
+                    np.add.outer(g * 3, g * 2) % 256,
+                    np.add.outer(g, g * 5 + i * 17) % 256,
+                    np.full((size, size), (i * 29) % 256, np.float32),
+                ],
+                axis=-1,
+            ).astype(np.uint8)
+            buf = _io.BytesIO()
+            Image.fromarray(arr).save(buf, "JPEG", quality=95)
+            w.push(buf.getvalue())
+            arrs.append(arr)
+            f.write(f"{i}\t{i % 3}\timg{i}.jpg\n")
+    w.close()
+    return binp, lst, arrs
+
+
+def test_imgbin_iterator_reads_ref_pack(tmp_path):
+    binp, lst, arrs = _write_jpeg_pack(tmp_path, RefBinPageWriter)
+    it = ImageBinIterator()
+    it.set_param("image_bin", binp)
+    it.set_param("image_list", lst)
+    it.set_param("silent", "1")
+    it.set_param("native_decoder", "0")
+    it.init()
+    seen = 0
+    while it.next():
+        inst = it.value()
+        assert inst.index == seen
+        assert inst.data.shape == arrs[seen].shape
+        # JPEG is lossy; just require closeness
+        assert np.abs(inst.data - arrs[seen]).mean() < 12.0
+        seen += 1
+    assert seen == len(arrs)
+
+
+def test_native_reader_reads_ref_pack(tmp_path):
+    from cxxnet_tpu.io import native
+
+    if not native.available():
+        pytest.skip("native IO library unavailable")
+    binp, lst, arrs = _write_jpeg_pack(tmp_path, RefBinPageWriter)
+    it = ImageBinIterator()
+    it.set_param("image_bin", binp)
+    it.set_param("image_list", lst)
+    it.set_param("silent", "1")
+    it.set_param("native_decoder", "1")
+    it.init()
+    assert it._native is not None, "native path should engage on ref packs"
+    seen = 0
+    while it.next():
+        inst = it.value()
+        assert np.abs(inst.data - arrs[seen]).mean() < 12.0
+        seen += 1
+    assert seen == len(arrs)
+
+
+def test_im2bin_tool_ref_format(tmp_path):
+    from PIL import Image
+
+    rng = np.random.RandomState(9)
+    root = tmp_path / "imgs"
+    root.mkdir()
+    lst = str(tmp_path / "i.lst")
+    with open(lst, "w") as f:
+        for i in range(4):
+            arr = rng.randint(0, 256, (16, 16, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(str(root / f"{i}.jpg"), "JPEG")
+            f.write(f"{i}\t0\t{i}.jpg\n")
+    out = str(tmp_path / "o.bin")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2bin.py"),
+         lst, str(root) + os.sep, out, "--format", "ref"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    assert detect_bin_format(out) == "ref"
+    assert sum(len(pg) for pg in iter_bin_pages(out)) == 4
+
+
+def test_train_on_ref_pack_end_to_end(tmp_path):
+    """A cxxnet-era pack (ref bit-format .bin + .lst) trains via the conf
+    path with zero repacking — the VERDICT #2 'done' criterion."""
+    from cxxnet_tpu import config as C
+    from cxxnet_tpu.io.data import create_iterator
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    binp, lst, _ = _write_jpeg_pack(tmp_path, RefBinPageWriter, n=12, size=16)
+    sec = C.split_sections(C.parse_pairs(f"""
+data = train
+iter = imgbin
+  image_bin = "{binp}"
+  image_list = "{lst}"
+  native_decoder = 0
+  input_shape = 3,16,16
+  batch_size = 4
+  round_batch = 1
+  label_width = 1
+iter = end
+""")).find("data")[0]
+    it = create_iterator(sec.entries)
+    it.init()
+    tr = NetTrainer()
+    tr.set_params(C.parse_pairs("""
+batch_size = 4
+input_shape = 3,16,16
+eta = 0.01
+netconfig = start
+layer[0->1] = flatten
+layer[1->2] = fullc:fc
+  nhidden = 3
+layer[2->2] = softmax
+netconfig = end
+"""))
+    tr.init_model()
+    steps = 0
+    it.before_first()
+    while it.next():
+        tr.update(it.value())
+        steps += 1
+    assert steps == 3
+    assert all(
+        np.isfinite(np.asarray(w)).all()
+        for tags in tr.params.values() for w in tags.values()
+    )

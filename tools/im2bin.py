@@ -1,14 +1,19 @@
 #!/usr/bin/env python
-"""im2bin: pack images listed in a .lst file into CXBP binary pages.
+"""im2bin: pack images listed in a .lst file into binary pages.
 
 Parity with the reference packer (``/root/reference/tools/im2bin.cpp``):
 
-    python tools/im2bin.py image.lst image_root output.bin
+    python tools/im2bin.py image.lst image_root output.bin [--format ref]
 
 ``image.lst`` lines are ``index \t label(s) \t filename`` (tab-separated);
 ``image_root`` is prefixed to each filename.  Blobs are stored as-is
-(JPEG bytes) in ~64MB pages; the reader decodes them off-thread
-(native/cxxnet_io.cc).
+(JPEG bytes); the reader decodes them off-thread (native/cxxnet_io.cc).
+
+``--format cxbp`` (default) writes this framework's CXBP pages;
+``--format ref`` writes the reference's BinaryPage bit-format
+(io.h:225-300), byte-compatible with cxxnet's own tools.  The reader
+auto-detects either, so the flag only matters for interop with the
+reference binary.
 """
 
 from __future__ import annotations
@@ -18,15 +23,33 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from cxxnet_tpu.io.imgbin import BinPageWriter, parse_lst_line  # noqa: E402
+from cxxnet_tpu.io.imgbin import (  # noqa: E402
+    BinPageWriter,
+    RefBinPageWriter,
+    parse_lst_line,
+)
 
 
 def main(argv) -> int:
-    if len(argv) < 4:
+    fmt = "cxbp"
+    if "--format" in argv:
+        i = argv.index("--format")
+        fmt = argv[i + 1] if i + 1 < len(argv) else ""
+        argv = argv[:i] + argv[i + 2:]
+    else:
+        for i, a in enumerate(argv):
+            if a.startswith("--format="):
+                fmt = a.split("=", 1)[1]
+                argv = argv[:i] + argv[i + 1:]
+                break
+    extra = [a for a in argv[1:] if a.startswith("--")]
+    if len(argv) < 4 or fmt not in ("cxbp", "ref") or extra:
+        if extra:
+            print(f"unknown option(s): {' '.join(extra)}", file=sys.stderr)
         print(__doc__)
         return 1
     lst_path, root, out_path = argv[1], argv[2], argv[3]
-    writer = BinPageWriter(out_path)
+    writer = (RefBinPageWriter if fmt == "ref" else BinPageWriter)(out_path)
     n = 0
     with open(lst_path, "r", encoding="utf-8") as f:
         for line in f:
