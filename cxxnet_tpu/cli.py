@@ -325,6 +325,9 @@ class LearnTask:
                 and self.net_trainer.update_period == 1
                 and not self.net_trainer._n_extras()
                 and _jax.process_count() == 1  # update_scan is 1-process
+                # node-bound train metrics need the per-step node
+                # forwards only update() provides
+                and not self.net_trainer.train_metric.need_nodes()
             )
             while self.itr_train.next():
                 if self.test_io == 0:

@@ -194,8 +194,8 @@ def iter_bin_pages(path: str):
     """Yield lists of blobs per page; the layout is auto-detected, so
     cxxnet-era reference packs and native CXBP packs both read.  An
     empty pack (what a writer closed on zero pushes produces) yields no
-    pages."""
-    if os.path.getsize(path) < 8:
+    pages; a 1-7 byte file is a truncation and still raises."""
+    if os.path.getsize(path) == 0:
         return iter(())
     if detect_bin_format(path) == "ref":
         return iter_ref_bin_pages(path)

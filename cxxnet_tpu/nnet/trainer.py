@@ -174,8 +174,25 @@ class NetTrainer:
             if hasattr(lay, "bind_mesh"):
                 lay.bind_mesh(self.mesh_plan)
 
+    def _check_metric_nodes(self) -> None:
+        """Fail fast on a bad ``metric[field,node]`` node name — the
+        reference checks at InitModel (nnet_impl-inl.hpp:369-370), not at
+        the first evaluation."""
+        for mset in (self.metric, self.train_metric):
+            for node in mset.nodes:
+                if node is None:
+                    continue
+                try:
+                    self.graph.node_index_of(node)
+                except (KeyError, ValueError) as e:
+                    raise ValueError(
+                        f"metric[...,{node}]: cannot find node name "
+                        f"{node!r} in the net graph"
+                    ) from e
+
     def init_model(self) -> None:
         self._build_net()
+        self._check_metric_nodes()
         self._build_mesh()
         self._bind_mesh_to_layers()
         self._rng_key = jax.random.PRNGKey(self.seed)
@@ -625,6 +642,31 @@ class NetTrainer:
         return (_pad(batch.data), _pad(batch.label),
                 tuple(_pad(e) for e in batch.extra_data), mask, n)
 
+    def _node_pred_cache(self, data, extras, n_real):
+        """Eval-mode forwards for the train metric's node-bound entries,
+        run on the CURRENT (pre-update) weights — call before the fused
+        step, which donates the param buffers.  Every metric then scores
+        the same weight version, like the reference's eval_req snapshots
+        from the training forward itself."""
+        cache = {}
+        for node in self.train_metric.nodes:
+            if node is not None and node not in cache:
+                fn = self._metric_node_fn(node)
+                cache[node] = fetch_local_rows(
+                    fn(self.params, self.aux, data, extras)
+                )[:n_real]
+        return cache
+
+    def _train_metric_preds(self, out, n_real, node_cache):
+        """Per-metric predictions for eval_train: the step's own output
+        for default entries, the precomputed node forwards for
+        ``metric[field,node]`` entries (no extra compute otherwise)."""
+        base = fetch_local_rows(out)[:n_real]
+        if not node_cache:
+            return base
+        cache = {None: base, **node_cache}
+        return [cache[node] for node in self.train_metric.nodes]
+
     def update(self, batch: DataBatch) -> None:
         """One micro-batch: fwd/bwd + (every update_period-th call) update."""
         assert self.net is not None, "init_model/load_model first"
@@ -636,6 +678,9 @@ class NetTrainer:
         mask = self._to_device(mask_np)
         extras = tuple(self._to_device(e) for e in extras_np)
         step = jnp.asarray(self.epoch_counter, jnp.int32)
+        node_cache = {}
+        if self.eval_train and self.train_metric.need_nodes():
+            node_cache = self._node_pred_cache(data, extras, n_real)
         if self.update_period == 1:
             # fused SPMD fast path: fwd+bwd+update in one donated program
             (self.params, self.ustates, self.aux, loss, out) = (
@@ -646,7 +691,7 @@ class NetTrainer:
             )
             if self.eval_train:
                 self.train_metric.add_eval(
-                    fetch_local_rows(out)[:n_real],
+                    self._train_metric_preds(out, n_real, node_cache),
                     np.asarray(batch.label)[:n_real],
                     self._label_ranges(),
                 )
@@ -658,7 +703,7 @@ class NetTrainer:
                 self._next_rng(), step, extras,
             )
             self.train_metric.add_eval(
-                fetch_local_rows(out)[:n_real],
+                self._train_metric_preds(out, n_real, node_cache),
                 np.asarray(batch.label)[:n_real],
                 self._label_ranges(),
             )
@@ -715,6 +760,13 @@ class NetTrainer:
         )
         return out[:n] if pad else out
 
+    def _metric_node_fn(self, node):
+        """Forward fn for one metric's node selector (None = final out) —
+        the per-metric ``eval_req`` binding, nnet_impl-inl.hpp:363-372."""
+        if node is None:
+            return self._eval_fn()
+        return self._node_fn(self.graph.node_index_of(node))
+
     def evaluate(self, iter_eval, data_name: str) -> str:
         """Round-end evaluation; format parity ``\\tname-metric:value``."""
         ret = ""
@@ -726,15 +778,19 @@ class NetTrainer:
         if len(self.metric) == 0:
             return ret
         self.metric.clear()
-        fn = self._eval_fn()
+        fns = [self._metric_node_fn(n) for n in self.metric.nodes]
         iter_eval.before_first()
         while iter_eval.next():
             batch = iter_eval.value()
-            out = self._run_sharded(
-                fn, np.asarray(batch.data), tuple(batch.extra_data)
-            )
+            data = np.asarray(batch.data)
+            extras = tuple(batch.extra_data)
             n = batch.batch_size - batch.num_batch_padd
-            self.metric.add_eval(out[:n], batch.label[:n], self._label_ranges())
+            outs, preds = {}, []
+            for fn in fns:
+                if id(fn) not in outs:
+                    outs[id(fn)] = self._run_sharded(fn, data, extras)[:n]
+                preds.append(outs[id(fn)])
+            self.metric.add_eval(preds, batch.label[:n], self._label_ranges())
         ret += self.metric.print(data_name)
         return ret
 
@@ -888,6 +944,7 @@ class NetTrainer:
         header, raw, raw_aux, raw_ust = self._read_model_file(path)
         graph = NetGraph.structure_from_json(json.dumps(header["structure"]))
         self._build_net(graph)
+        self._check_metric_nodes()
         self._build_mesh()
         self._bind_mesh_to_layers()
         self.epoch_counter = int(header["epoch_counter"])

@@ -15,9 +15,11 @@ Parity: ``/root/reference/src/utils/metric.h`` —
   ``\\tname-metric[field]:value`` (metric.h:193-203)
 
 Config parsing (``nnet_impl-inl.hpp:57-67``): ``metric = error`` binds to
-field "label"; ``metric[field,node] = error`` selects a label field (the
-node part selects an output node; all example configs evaluate the final
-output, which is what the trainer provides).
+field "label" and the final output node; ``metric[field,node] = error``
+selects a label field AND a named graph node to score — each metric
+carries its node selector (``None`` = final out), and the trainer feeds
+per-metric predictions the way the reference fills one ``eval_req``
+entry per metric (``nnet_impl-inl.hpp:363-372``).
 """
 
 from __future__ import annotations
@@ -144,10 +146,13 @@ class MetricSet:
     def __init__(self) -> None:
         self.metrics: List[Metric] = []
         self.fields: List[str] = []
+        self.nodes: List[object] = []  # per-metric node name; None = out
 
-    def add_metric(self, name: str, field: str = "label") -> None:
+    def add_metric(self, name: str, field: str = "label",
+                   node: str | None = None) -> None:
         self.metrics.append(create_metric(name))
         self.fields.append(field)
+        self.nodes.append(node)
 
     def try_add_from_config(self, key: str, val: str) -> bool:
         """Parse a ``metric`` / ``metric[field]`` / ``metric[field,node]``
@@ -158,8 +163,12 @@ class MetricSet:
         if not m:
             return False
         field = m.group("field") or "label"
-        self.add_metric(val, field)
+        self.add_metric(val, field, m.group("node"))
         return True
+
+    def need_nodes(self) -> bool:
+        """True when any metric scores a non-default graph node."""
+        return any(n is not None for n in self.nodes)
 
     def clear(self) -> None:
         for mt in self.metrics:
@@ -167,14 +176,27 @@ class MetricSet:
 
     def add_eval(
         self,
-        pred: np.ndarray,
+        pred,
         labels: np.ndarray,
         label_ranges: Dict[str, Tuple[int, int]],
     ) -> None:
-        """labels: (N, label_width); label_ranges: field → column span."""
+        """labels: (N, label_width); label_ranges: field → column span.
+
+        ``pred`` is one (N, K) array applied to every metric, or a list
+        with one prediction per metric (the reference's per-metric
+        ``eval_req`` scores, metric.h AddEval)."""
         if labels.ndim == 1:
             labels = labels[:, None]
-        for mt, field in zip(self.metrics, self.fields):
+        if isinstance(pred, (list, tuple)):
+            if len(pred) != len(self.metrics):
+                raise ValueError(
+                    f"MetricSet: {len(pred)} predictions for "
+                    f"{len(self.metrics)} metrics"
+                )
+            preds = list(pred)
+        else:
+            preds = [pred] * len(self.metrics)
+        for mt, field, pred in zip(self.metrics, self.fields, preds):
             if field not in label_ranges:
                 raise ValueError(f"Metric: unknown target = {field}")
             a, b = label_ranges[field]

@@ -266,3 +266,10 @@ netconfig = end
         np.isfinite(np.asarray(w)).all()
         for tags in tr.params.values() for w in tags.values()
     )
+
+
+def test_truncated_pack_raises(tmp_path):
+    p = str(tmp_path / "t.bin")
+    open(p, "wb").write(b"\x01\x00")  # 2 bytes: truncation, not empty
+    with pytest.raises(Exception):
+        list(iter_bin_pages(p))

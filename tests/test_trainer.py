@@ -545,3 +545,94 @@ def test_save_ustate_exact_resume(tmp_path):
     t_d.load_model(ck2)
     st = t_d.ustates["l0_fc"]["wmat"]
     assert float(np.abs(np.asarray(st["m"])).max()) == 0
+
+
+MIDNODE_CFG = """
+netconfig=start
+layer[0->hid] = fullc:f1
+  nhidden = 4
+  init_sigma = 0.3
+layer[hid->out] = fullc:f2
+  nhidden = 4
+  init_sigma = 0.3
+layer[out->out] = softmax
+netconfig=end
+input_shape = 1,1,8
+batch_size = 16
+eta = 0.1
+metric = error
+metric[label,hid] = error
+"""
+
+
+def test_metric_node_selection_eval():
+    """metric[field,node] scores the named mid-graph node
+    (nnet_impl-inl.hpp:57-67, 363-372) — not just the final out."""
+    tr = NetTrainer()
+    tr.set_params(C.parse_pairs(MIDNODE_CFG))
+    tr.init_model()
+    assert tr.metric.nodes == [None, "hid"]
+    x, y = toy_data(32)
+
+    class OneShot:
+        def __init__(self):
+            self.done = False
+
+        def before_first(self):
+            self.done = False
+
+        def next(self):
+            if self.done:
+                return False
+            self.done = True
+            return True
+
+        def value(self):
+            return DataBatch(data=x[:16], label=y[:16])
+
+    line = tr.evaluate(OneShot(), "val")
+    assert line.count("val-error") == 2
+    # the node-bound metric must equal argmax over the hid node's values
+    hid = tr.extract_feature(DataBatch(data=x[:16], label=y[:16]), "hid")
+    expect = float((hid.argmax(1) != y[:16, 0]).mean())
+    assert abs(tr.metric.metrics[1].get() - expect) < 1e-6
+    # and differ from the final-out metric in general
+    out_err = tr.metric.metrics[0].get()
+    assert tr.metric.metrics[1].cnt_inst == 16
+    assert isinstance(out_err, float)
+
+
+def test_metric_node_selection_train():
+    """eval_train with a node-bound metric runs the extra node forward."""
+    tr = NetTrainer()
+    tr.set_params(C.parse_pairs(MIDNODE_CFG + "eval_train = 1\n"))
+    tr.init_model()
+    x, y = toy_data(16)
+    tr.update(DataBatch(data=x, label=y))
+    assert tr.train_metric.metrics[1].cnt_inst == 16
+    line = tr.evaluate(None, "train")
+    assert line.count("train-error") == 2
+
+
+def test_metric_bad_node_fails_at_init():
+    tr = NetTrainer()
+    tr.set_params(C.parse_pairs(
+        MIDNODE_CFG.replace("metric[label,hid]", "metric[label,hdi]")
+    ))
+    with pytest.raises(ValueError, match="hdi"):
+        tr.init_model()
+
+
+def test_metric_node_same_weights_as_base():
+    """Node-bound and final-out train metrics must score the SAME
+    (pre-update) weight version in the fused update_period=1 path."""
+    cfg = MIDNODE_CFG.replace("metric[label,hid] = error",
+                              "metric[label,out] = error")
+    tr = NetTrainer()
+    tr.set_params(C.parse_pairs(cfg + "eval_train = 1\n"))
+    tr.init_model()
+    x, y = toy_data(16)
+    tr.update(DataBatch(data=x, label=y))
+    # 'out' IS the final node: both metrics see identical predictions,
+    # so identical error — any pre/post-update skew would break this
+    assert tr.train_metric.metrics[0].get() == tr.train_metric.metrics[1].get()
