@@ -326,8 +326,10 @@ class LearnTask:
                 and not self.net_trainer._n_extras()
                 and _jax.process_count() == 1  # update_scan is 1-process
                 # node-bound train metrics need the per-step node
-                # forwards only update() provides
-                and not self.net_trainer.train_metric.need_nodes()
+                # forwards only update() provides (irrelevant when
+                # eval_train is off — train metrics never run then)
+                and not (self.net_trainer.eval_train
+                         and self.net_trainer.train_metric.need_nodes())
             )
             while self.itr_train.next():
                 if self.test_io == 0:

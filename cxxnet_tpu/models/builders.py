@@ -116,9 +116,14 @@ def alexnet_conf(
     synthetic: bool = True,
     nsample: int = 0,
     dev: str = "tpu",
+    input_size: int = 227,
 ) -> str:
-    """AlexNet (ImageNet.conf parity: grouped convs, LRN, dropout FCs)."""
-    shape = "3,227,227"
+    """AlexNet (ImageNet.conf parity: grouped convs, LRN, dropout FCs).
+
+    ``input_size`` shrinks the input for CPU-feasible fixtures (ceil-mode
+    pooling keeps every stage valid down to ~67px); 227 is the paper/
+    reference shape."""
+    shape = f"3,{input_size},{input_size}"
     nsample = nsample or batch_size * 4
     data = (
         _iter_block("data", nsample, shape, num_class, threadbuffer=True)
@@ -183,10 +188,15 @@ def _inception(x: str, m: str, c1: int, c3r: int, c3: int, c5r: int, c5: int,
     """One GoogLeNet inception module: 4 branches ch_concat'd to node m."""
 
     def conv(src: str, dst: str, tag: str, k: int, ch: int, pad: int) -> str:
+        # kaiming, not xavier: every branch conv feeds a relu, and xavier
+        # halves activation variance per relu layer — measured signal
+        # collapse of ~2x per inception block by i5b (the vanishing the
+        # paper's auxiliary heads existed to patch); He-init keeps the
+        # forward signal unit-scale through all 9 modules
         return (
             f"layer[{src}->{dst}] = conv:{tag}\n"
             f"  kernel_size = {k}\n  nchannel = {ch}\n  pad = {pad}\n"
-            "  random_type = xavier\n"
+            "  random_type = kaiming\n"
         )
 
     s = conv(x, f"{m}_c1", f"{m}_1x1", 1, c1, 0)
@@ -239,16 +249,16 @@ def googlenet_conf(
         "netconfig = start\n"
         "layer[0->c1] = conv:conv1\n"
         "  kernel_size = 7\n  stride = 2\n  pad = 3\n  nchannel = 64\n"
-        "  random_type = xavier\n"
+        "  random_type = kaiming\n"
         "layer[+1:c1r] = relu\n"
         "layer[c1r->p1] = max_pooling\n  kernel_size = 3\n  stride = 2\n"
         "layer[p1->n1] = lrn\n" + lrn +
         "layer[n1->c2r] = conv:conv2_reduce\n"
-        "  kernel_size = 1\n  nchannel = 64\n  random_type = xavier\n"
+        "  kernel_size = 1\n  nchannel = 64\n  random_type = kaiming\n"
         "layer[+1:c2rr] = relu\n"
         "layer[c2rr->c2] = conv:conv2\n"
         "  kernel_size = 3\n  pad = 1\n  nchannel = 192\n"
-        "  random_type = xavier\n"
+        "  random_type = kaiming\n"
         "layer[+1:c2a] = relu\n"
         "layer[c2a->n2] = lrn\n" + lrn +
         "layer[n2->p2] = max_pooling\n  kernel_size = 3\n  stride = 2\n"

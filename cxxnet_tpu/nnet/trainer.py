@@ -415,6 +415,11 @@ class NetTrainer:
             raise ValueError(
                 "update_scan does not support extra_data nodes; use update()"
             )
+        if self.eval_train and self.train_metric.need_nodes():
+            raise ValueError(
+                "update_scan cannot score node-bound train metrics "
+                "(metric[field,node] with eval_train); use update()"
+            )
         in_ndim = len(self.net.input_node_shape(self.batch_size))
         data_arr = data if hasattr(data, "ndim") else np.asarray(data)
         per_step = data_arr.ndim == in_ndim + 1
@@ -646,8 +651,11 @@ class NetTrainer:
         """Eval-mode forwards for the train metric's node-bound entries,
         run on the CURRENT (pre-update) weights — call before the fused
         step, which donates the param buffers.  Every metric then scores
-        the same weight version, like the reference's eval_req snapshots
-        from the training forward itself."""
+        the same weight version.  Deliberate divergence from the
+        reference: its eval_req snapshots come from the TRAIN forward
+        (dropout noise included, nnet_impl-inl.hpp:363-372); here the
+        node forward runs eval-mode, so on stochastic nets a node-bound
+        metric and the default metric can differ even on the out node."""
         cache = {}
         for node in self.train_metric.nodes:
             if node is not None and node not in cache:

@@ -1,0 +1,121 @@
+"""Accuracy-parity evidence (VERDICT r2 #3).
+
+* the bundled UCI-digits conv recipe must beat the MLP's ~4% and land in
+  the reference's ~2%-in-15-rounds class
+  (``/root/reference/example/MNIST/README.md``);
+* membuffer-overfit smokes for the ImageNet models — cache one batch and
+  drive train error to 0 — the reference's own sanity discipline
+  (``/root/reference/src/io/iter_mem_buffer-inl.hpp``).
+"""
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import config as C
+from cxxnet_tpu.io.data import create_iterator
+from cxxnet_tpu.models import alexnet_conf, googlenet_conf
+from cxxnet_tpu.nnet.trainer import NetTrainer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_digits_conv_beats_mlp_bar(tmp_path):
+    """example/MNIST/digits_conv.conf through the real CLI: <= 4% test
+    error in 15 rounds on real handwritten digits (the committed log
+    records 1.6%)."""
+    pytest.importorskip("sklearn")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "make_digits_idx.py"),
+         str(tmp_path / "data")],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    shutil.copy(os.path.join(REPO, "example", "MNIST", "digits_conv.conf"),
+                str(tmp_path / "digits_conv.conf"))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO  # drops /root/.axon_site -> pure CPU jax
+    r = subprocess.run(
+        [sys.executable, "-m", "cxxnet_tpu", "digits_conv.conf",
+         "task=train"],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    errs = {
+        int(m.group(1)): float(m.group(2))
+        for m in re.finditer(r"\[(\d+)\]\ttrain-error:\S+\ttest-error:(\S+)",
+                             r.stderr)
+    }
+    assert 15 in errs, r.stderr[-2000:]
+    assert errs[15] <= 0.04, f"round-15 test error {errs[15]:.3f} > 4%"
+    # convergence, not luck: the tail of the trajectory stays under 6%
+    assert max(errs[k] for k in (13, 14, 15)) <= 0.06
+
+
+def _overfit_one_cached_batch(conf_text, shape, n_steps):
+    """The membuffer discipline: synthetic source + ``iter = membuffer``
+    caching ONE batch; training must drive eval-mode error to 0."""
+    it = create_iterator(C.split_sections(C.parse_pairs(f"""
+data = train
+iter = synthetic
+  nsample = 8
+  input_shape = {shape}
+  nclass = 10
+  label_width = 1
+  batch_size = 8
+iter = membuffer
+  max_nbatch = 1
+iter = end
+""")).find("data")[0].entries)
+    it.init()
+    tr = NetTrainer()
+    tr.set_params(C.parse_pairs(conf_text))
+    # memorization settings: the ImageNet schedules are tuned for real
+    # data at scale, not for saturating 8 noise images
+    for k, v in [("updater", "adam"), ("eta", "0.001"),
+                 ("wmat:lr", "0.001"), ("bias:lr", "0.001"),
+                 ("wd", "0.0"), ("wmat:wd", "0.0")]:
+        tr.set_param(k, v)
+    tr.eval_train = 0
+    tr.init_model()
+    it.before_first()
+    assert it.next()
+    cached = it.value()
+    err = 1.0
+    for step in range(n_steps):
+        it.before_first()
+        while it.next():
+            tr.update(it.value())
+        if (step + 1) % 25 == 0:
+            pred = tr.predict(cached)
+            err = float((pred != cached.label[:, 0]).mean())
+            if err == 0.0:
+                break
+    assert err == 0.0, f"did not overfit the cached batch: err={err}"
+    # and the second epoch really replayed the same cached data
+    it.before_first()
+    assert it.next()
+    np.testing.assert_array_equal(np.asarray(it.value().data),
+                                  np.asarray(cached.data))
+
+
+def test_membuffer_overfit_alexnet():
+    _overfit_one_cached_batch(
+        alexnet_conf(batch_size=8, num_class=10, synthetic=False,
+                     dev="cpu", input_size=67),
+        "3,67,67", n_steps=300,
+    )
+
+
+def test_membuffer_overfit_googlenet():
+    _overfit_one_cached_batch(
+        googlenet_conf(batch_size=8, num_class=10, synthetic=False,
+                       dev="cpu", input_size=64),
+        "3,64,64", n_steps=300,
+    )

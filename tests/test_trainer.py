@@ -636,3 +636,18 @@ def test_metric_node_same_weights_as_base():
     # 'out' IS the final node: both metrics see identical predictions,
     # so identical error — any pre/post-update skew would break this
     assert tr.train_metric.metrics[0].get() == tr.train_metric.metrics[1].get()
+
+
+def test_update_scan_rejects_node_metrics():
+    tr = NetTrainer()
+    tr.set_params(C.parse_pairs(MIDNODE_CFG + "eval_train = 1\n"))
+    tr.init_model()
+    x, y = toy_data(16)
+    with pytest.raises(ValueError, match="node-bound"):
+        tr.update_scan(x, y, n_steps=2)
+    # with eval_train off the scan path is allowed again
+    tr2 = NetTrainer()
+    tr2.set_params(C.parse_pairs(MIDNODE_CFG + "eval_train = 0\n"))
+    tr2.init_model()
+    tr2.update_scan(x, y, n_steps=2)
+    assert tr2.epoch_counter == 2
