@@ -231,12 +231,17 @@ class LearnTask:
             if it is not None:
                 for n, v in split.global_entries:
                     it.set_param(n, v)
-                if nproc > 1 and it is self.itr_train:
+                if nproc > 1 and (it is self.itr_train
+                                  or it in self.itr_evals):
                     # multi-process contract (trainer._pad_train_batch):
                     # each process feeds batch_size/nproc LOCAL rows of
                     # its own data shard; batch_size in the conf is
-                    # GLOBAL.  Shard + shrink the train iterator here so
-                    # dist confs run unchanged on any process count.
+                    # GLOBAL.  Shard + shrink the iterator here so dist
+                    # confs run unchanged on any process count.  Eval
+                    # iterators shard too (cross-process metric reduction
+                    # reassembles the global number — trainer.evaluate);
+                    # an eval chain that can't shard still works, every
+                    # process just scores the full set redundantly.
                     gbs = self.net_trainer.batch_size
                     if gbs % nproc != 0:
                         raise ValueError(
@@ -244,16 +249,18 @@ class LearnTask:
                             f"process count ({nproc})"
                         )
                     if not it.supports_dist_shard():
-                        raise ValueError(
-                            "multi-process training needs a train "
-                            "iterator that honors dist_num_worker "
-                            "(mnist/imgbin/img/csv/synthetic); this "
-                            "chain would silently feed every process "
-                            "identical data"
-                        )
-                    it.set_param("batch_size", str(gbs // nproc))
-                    it.set_param("dist_num_worker", str(nproc))
-                    it.set_param("dist_worker_rank", str(pid))
+                        if it is self.itr_train:
+                            raise ValueError(
+                                "multi-process training needs a train "
+                                "iterator that honors dist_num_worker "
+                                "(mnist/imgbin/img/csv/synthetic); this "
+                                "chain would silently feed every process "
+                                "identical data"
+                            )
+                    else:
+                        it.set_param("batch_size", str(gbs // nproc))
+                        it.set_param("dist_num_worker", str(nproc))
+                        it.set_param("dist_worker_rank", str(pid))
                 it.init()
 
     # ------------------------------------------------------------------
@@ -318,13 +325,14 @@ class LearnTask:
                 global_step += len(pending)
                 pending.clear()
 
-            import jax as _jax
-
+            # multi-process scan is safe from the CLI: sharded train
+            # iterators run equal batch counts per round (equal-steps
+            # contract), so every process flushes identical [K, ...]
+            # stacks at the same points
             scan_ok = (
                 self.scan_steps > 1
                 and self.net_trainer.update_period == 1
                 and not self.net_trainer._n_extras()
-                and _jax.process_count() == 1  # update_scan is 1-process
                 # node-bound train metrics need the per-step node
                 # forwards only update() provides (irrelevant when
                 # eval_train is off — train metrics never run then)

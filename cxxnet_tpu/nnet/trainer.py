@@ -406,11 +406,6 @@ class NetTrainer:
         assert self.net is not None, "init_model/load_model first"
         if self.update_period != 1:
             raise ValueError("update_scan requires update_period == 1")
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "update_scan is single-process; multi-host runs dispatch "
-                "per-batch (update) so every process feeds its local shard"
-            )
         if self._n_extras():
             raise ValueError(
                 "update_scan does not support extra_data nodes; use update()"
@@ -435,19 +430,47 @@ class NetTrainer:
                     "single-batch mode needs n_steps (or pass [K,B,...])"
                 )
             k = int(n_steps)
+        if jax.process_count() > 1:
+            # multi-host: each process feeds its LOCAL [K, B/nproc, ...]
+            # stack; the global step-stacks are assembled over the batch
+            # axis (the DCN-spanning analog of _to_device).  K must match
+            # on every process (the iterators' equal-steps contract) —
+            # verified with a cheap allgather so a mismatched tail chunk
+            # fails fast instead of deadlocking the SPMD collectives.
+            local = self.batch_size // jax.process_count()
+            got = data_arr.shape[1] if per_step else data_arr.shape[0]
+            if got != local:
+                raise ValueError(
+                    f"distributed update_scan: each process must feed "
+                    f"batch_size/process_count = {local} rows, got {got}"
+                )
+            from jax.experimental import multihost_utils
+
+            ks = np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray([k], np.int32)
+                )
+            ).reshape(-1)
+            if not (ks == k).all():
+                raise ValueError(
+                    f"distributed update_scan: step counts differ across "
+                    f"processes ({sorted(set(int(v) for v in ks))}); every "
+                    "process must scan the same K"
+                )
         with_out = bool(self.eval_train)
         fn = self._scan_step_fn(k, per_step, with_out)
         step0 = jnp.asarray(self.epoch_counter, jnp.int32)
         (self.params, self.ustates, self.aux, self._rng_key, _end, ys) = fn(
             self.params, self.ustates, self.aux,
-            self._to_device(data), self._to_device(labels),
+            self._stage_scan(data, per_step),
+            self._stage_scan(labels, per_step),
             self._next_rng(), step0,
         )
         self.epoch_counter += k
         if with_out:
             losses, outs = ys
-            outs_np = np.asarray(jax.device_get(outs))
-            labels_np = np.asarray(jax.device_get(labels))
+            outs_np = self._local_scan_rows(outs)
+            labels_np = np.asarray(labels)
             if not per_step:
                 labels_np = np.broadcast_to(
                     labels_np, (k,) + labels_np.shape
@@ -459,6 +482,34 @@ class NetTrainer:
         else:
             losses = ys
         return np.asarray(jax.device_get(losses))
+
+    def _stage_scan(self, x, per_step: bool):
+        """Host stack → device array for update_scan; multi-process runs
+        assemble the global array from per-process shards ([K, B, ...]
+        step-stacks shard on batch axis 1; one staged batch is exactly
+        the _to_device case)."""
+        if not per_step:
+            return self._to_device(x)
+        if jax.process_count() == 1:
+            return jnp.asarray(x)
+        return jax.make_array_from_process_local_data(
+            self.mesh_plan.data_sharding(axis=1), np.asarray(x)
+        )
+
+    @staticmethod
+    def _local_scan_rows(outs) -> np.ndarray:
+        """[K, B, ...] global scan output → this process's batch rows."""
+        if jax.process_count() == 1:
+            return np.asarray(jax.device_get(outs))
+        by_start = {}
+        for s in outs.addressable_shards:
+            start = s.index[1].start or 0
+            if start not in by_start:
+                by_start[start] = s
+        return np.concatenate(
+            [np.asarray(by_start[kk].data) for kk in sorted(by_start)],
+            axis=1,
+        )
 
     def _grad_fn(self):
         if "grad" not in self._jit_cache:
@@ -776,9 +827,14 @@ class NetTrainer:
         return self._node_fn(self.graph.node_index_of(node))
 
     def evaluate(self, iter_eval, data_name: str) -> str:
-        """Round-end evaluation; format parity ``\\tname-metric:value``."""
+        """Round-end evaluation; format parity ``\\tname-metric:value``.
+
+        Multi-process: every process evaluates its own (sharded) rows
+        and the metric counters are summed across the job before
+        printing, so the line reports the GLOBAL metric on each rank."""
         ret = ""
         if self.eval_train:
+            self.train_metric.reduce_across_processes()
             ret += self.train_metric.print("train")
             self.train_metric.clear()
         if iter_eval is None:
@@ -799,6 +855,7 @@ class NetTrainer:
                     outs[id(fn)] = self._run_sharded(fn, data, extras)[:n]
                 preds.append(outs[id(fn)])
             self.metric.add_eval(preds, batch.label[:n], self._label_ranges())
+        self.metric.reduce_across_processes()
         ret += self.metric.print(data_name)
         return ret
 

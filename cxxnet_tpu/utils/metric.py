@@ -219,6 +219,42 @@ class MetricSet:
             else:
                 mt.add_eval(pred, labels[:, a:b])
 
+    def reduce_across_processes(self) -> None:
+        """Sum (sum_metric, cnt_inst) over all processes of a
+        jax.distributed job — the cross-worker eval reduction (the
+        reference evaluates on sharded workers too,
+        nnet_impl-inl.hpp:224-245).  Collective: every process must
+        call.  A no-op single-process.  Correct for sharded iterators
+        (disjoint contributions sum to the global metric) and harmless
+        for unsharded ones (identical contributions scale numerator and
+        denominator alike)."""
+        import jax
+
+        if jax.process_count() == 1 or not self.metrics:
+            return
+        from jax.experimental import multihost_utils
+
+        # the gather runs in float32 (x64 is typically disabled), which
+        # would corrupt counters past 2^24 — ship each float64 as a
+        # (hi, lo) float32 pair and each count as divmod(2^20) words,
+        # then reconstruct in float64 host-side
+        rows = []
+        for m in self.metrics:
+            s_hi = np.float32(m.sum_metric)
+            s_lo = np.float32(m.sum_metric - float(s_hi))
+            c_hi, c_lo = divmod(int(m.cnt_inst), 1 << 20)
+            rows.append([s_hi, s_lo, np.float32(c_hi), np.float32(c_lo)])
+        gathered = np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray(rows, np.float32)
+            ),
+            np.float64,
+        )  # [nproc, nmetric, 4]
+        total = gathered.sum(axis=0)
+        for m, (s_hi, s_lo, c_hi, c_lo) in zip(self.metrics, total):
+            m.sum_metric = float(s_hi) + float(s_lo)
+            m.cnt_inst = int(round(c_hi)) * (1 << 20) + int(round(c_lo))
+
     def print(self, evname: str) -> str:
         out = []
         for mt, field in zip(self.metrics, self.fields):
