@@ -293,11 +293,21 @@ class LearnTask:
             self.itr_train.before_first()
             timer.clear()
             pending: List = []  # scan_steps>1: batches staged for ONE dispatch
+            in_flight: List = []  # async scan handles (chunk overlap)
 
             def _flush_pending() -> None:
                 """Run staged batches as one device program (lax.scan over
                 the fused step) — amortizes per-dispatch host cost
-                exactly like bench.py (doc/performance.md)."""
+                exactly like bench.py (doc/performance.md).
+
+                With ``eval_train = 0`` the scan dispatch is ASYNC: the
+                device chews chunk k while the host decodes/augments
+                chunk k+1 (the reference's two-stage ThreadBuffer
+                overlap, here via XLA's async dispatch queue).  At most
+                two chunks stay in flight — a double buffer — so host
+                memory stays bounded; the per-chunk timer then measures
+                the PIPELINE rate (max of host and device time), which
+                is the honest number for a training system."""
                 nonlocal global_step
                 if not pending:
                     return
@@ -309,21 +319,40 @@ class LearnTask:
                     self.net_trainer.update(
                         _DB(data=pending[0][0], label=pending[0][1])
                     )
+                    if not self.net_trainer.eval_train:
+                        self.net_trainer.sync()
                 else:
                     import numpy as _np
 
-                    self.net_trainer.update_scan(
+                    handle = self.net_trainer.update_scan(
                         _np.stack([d for d, _ in pending]),
                         _np.stack([l for _, l in pending]),
+                        sync=bool(self.net_trainer.eval_train),
+                        # sharded iterators guarantee equal K per process
+                        # (equal-steps contract) — skip the collective
+                        # K-check so the async overlap stays unbroken
+                        check_steps=False,
                     )
+                    if not self.net_trainer.eval_train:
+                        in_flight.append(handle)
                 if not self.net_trainer.eval_train:
-                    # async dispatch: fence so the timer measures the
-                    # step, not the enqueue (eval_train's metric fetch
-                    # already synchronizes)
-                    self.net_trainer.sync()
+                    # double buffer: fence on the OLDER in-flight chunk
+                    # (chunk k-1 must be done before k+2 is staged); the
+                    # newest keeps running while the host loads more
+                    while len(in_flight) > 1:
+                        import jax as _jx
+
+                        _jx.block_until_ready(in_flight.pop(0))
                 timer.stop(n_steps=len(pending))
                 global_step += len(pending)
                 pending.clear()
+
+            def _drain_in_flight() -> None:
+                if in_flight:
+                    import jax as _jx
+
+                    _jx.block_until_ready(in_flight)
+                    in_flight.clear()
 
             # multi-process scan is safe from the CLI: sharded train
             # iterators run equal batch counts per round (equal-steps
@@ -370,6 +399,7 @@ class LearnTask:
                         flush=True,
                     )
             _flush_pending()  # tail chunk shorter than scan_steps
+            _drain_in_flight()  # round boundary: device queue empty
             if self.test_io == 0:
                 if not self.silent and timer.count:
                     print(

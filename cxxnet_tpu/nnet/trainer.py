@@ -390,7 +390,8 @@ class NetTrainer:
             )
         return self._jit_cache[key]
 
-    def update_scan(self, data, labels, n_steps: Optional[int] = None) -> np.ndarray:
+    def update_scan(self, data, labels, n_steps: Optional[int] = None,
+                    sync: bool = True, check_steps: bool = True) -> np.ndarray:
         """Run K train steps in ONE dispatched device program.
 
         Two modes, both requiring full ``batch_size`` batches and
@@ -401,7 +402,15 @@ class NetTrainer:
         * ``data`` of shape ``[B, ...]`` with ``n_steps=K`` — the same
           staged batch is reused every step (synthetic benchmark mode).
 
-        Returns the per-step f32 losses, shape ``[K]``.
+        Returns the per-step f32 losses, shape ``[K]``.  With
+        ``sync=False`` (and ``eval_train`` off) the losses come back as a
+        device array WITHOUT draining the dispatch queue — the caller
+        overlaps host work (decode/augment of the next chunk) with the
+        device scan and fences later (``sync()`` or ``np.asarray`` on the
+        result).  This is the two-stage ThreadBuffer overlap
+        (``iter_thread_imbin_x-inl.hpp:203-354``) in its TPU form: the
+        host side of the double buffer is the input pipeline, the device
+        side is the in-flight scan program.
         """
         assert self.net is not None, "init_model/load_model first"
         if self.update_period != 1:
@@ -444,19 +453,26 @@ class NetTrainer:
                     f"distributed update_scan: each process must feed "
                     f"batch_size/process_count = {local} rows, got {got}"
                 )
-            from jax.experimental import multihost_utils
+            if check_steps:
+                # fail fast instead of deadlocking; collective, so it
+                # costs a cross-host rendezvous per call — a caller whose
+                # iterators already guarantee equal K (the CLI's
+                # equal-steps contract) passes check_steps=False to keep
+                # the async overlap unbroken
+                from jax.experimental import multihost_utils
 
-            ks = np.asarray(
-                multihost_utils.process_allgather(
-                    np.asarray([k], np.int32)
-                )
-            ).reshape(-1)
-            if not (ks == k).all():
-                raise ValueError(
-                    f"distributed update_scan: step counts differ across "
-                    f"processes ({sorted(set(int(v) for v in ks))}); every "
-                    "process must scan the same K"
-                )
+                ks = np.asarray(
+                    multihost_utils.process_allgather(
+                        np.asarray([k], np.int32)
+                    )
+                ).reshape(-1)
+                if not (ks == k).all():
+                    raise ValueError(
+                        f"distributed update_scan: step counts differ "
+                        f"across processes "
+                        f"({sorted(set(int(v) for v in ks))}); every "
+                        "process must scan the same K"
+                    )
         with_out = bool(self.eval_train)
         fn = self._scan_step_fn(k, per_step, with_out)
         step0 = jnp.asarray(self.epoch_counter, jnp.int32)
@@ -481,6 +497,8 @@ class NetTrainer:
                 )
         else:
             losses = ys
+            if not sync:
+                return losses  # async: device array, queue not drained
         return np.asarray(jax.device_get(losses))
 
     def _stage_scan(self, x, per_step: bool):
@@ -501,15 +519,7 @@ class NetTrainer:
         """[K, B, ...] global scan output → this process's batch rows."""
         if jax.process_count() == 1:
             return np.asarray(jax.device_get(outs))
-        by_start = {}
-        for s in outs.addressable_shards:
-            start = s.index[1].start or 0
-            if start not in by_start:
-                by_start[start] = s
-        return np.concatenate(
-            [np.asarray(by_start[kk].data) for kk in sorted(by_start)],
-            axis=1,
-        )
+        return fetch_local_rows(outs, axis=1)
 
     def _grad_fn(self):
         if "grad" not in self._jit_cache:

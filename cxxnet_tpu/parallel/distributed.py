@@ -108,8 +108,11 @@ def fetch_array(x) -> "np.ndarray":
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
 
-def fetch_local_rows(x) -> "np.ndarray":
-    """Batch-major global array → this process's rows (device order)."""
+def fetch_local_rows(x, axis: int = 0) -> "np.ndarray":
+    """Global array → this process's rows along ``axis`` (device order).
+
+    ``axis=0`` for batch-major arrays; ``axis=1`` for ``[K, B, ...]``
+    scan step-stacks sharded over the batch axis."""
     import numpy as np
 
     if not hasattr(x, "sharding") or jax.process_count() == 1:
@@ -118,11 +121,11 @@ def fetch_local_rows(x) -> "np.ndarray":
     # identical row blocks on several local devices — keep the first each
     by_start = {}
     for s in x.addressable_shards:
-        start = s.index[0].start or 0
+        start = s.index[axis].start or 0
         if start not in by_start:
             by_start[start] = s
     return np.concatenate(
-        [np.asarray(by_start[k].data) for k in sorted(by_start)], axis=0
+        [np.asarray(by_start[k].data) for k in sorted(by_start)], axis=axis
     )
 
 
