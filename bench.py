@@ -217,20 +217,18 @@ def bench_lm(batch: int, seq_len: int, scan_k: int) -> None:
     )
 
 
-def bench_resnet(batch: int, scan_k: int) -> None:
-    """``--resnet`` mode: ResNet-50 training throughput (stderr only —
-    the stdout JSON stays the BASELINE GoogLeNet metric)."""
+def _bench_imagenet_conf(tag: str, desc: str, conf: str, batch: int,
+                         scan_k: int) -> None:
+    """Shared trainer setup + synthetic-data measurement for the
+    ImageNet-model bench modes (stderr only — the stdout JSON stays the
+    BASELINE GoogLeNet metric)."""
     import jax
 
     from cxxnet_tpu import config as cfgmod
-    from cxxnet_tpu.models import resnet50_conf
     from cxxnet_tpu.nnet.trainer import NetTrainer
 
     tr = NetTrainer()
-    tr.set_params(cfgmod.parse_pairs(
-        resnet50_conf(batch_size=batch, input_size=224, synthetic=False,
-                      dev="tpu")
-    ))
+    tr.set_params(cfgmod.parse_pairs(conf))
     tr.eval_train = 0
     tr.init_model()
     rng = np.random.RandomState(0)
@@ -240,9 +238,35 @@ def bench_resnet(batch: int, scan_k: int) -> None:
     )
     dt = _time_scans(tr, data, labels, scan_k)
     print(
-        f"# bench[resnet]: ResNet-50 b{batch} bf16: {dt*1e3:.1f} ms/step "
+        f"# bench[{tag}]: {desc} b{batch} bf16: {dt*1e3:.1f} ms/step "
         f"= {batch/dt:.0f} img/s/chip",
         file=sys.stderr, flush=True,
+    )
+
+
+def bench_resnet(batch: int, scan_k: int) -> None:
+    """``--resnet`` mode: ResNet-50 training throughput."""
+    from cxxnet_tpu.models import resnet50_conf
+
+    _bench_imagenet_conf(
+        "resnet", "ResNet-50",
+        resnet50_conf(batch_size=batch, input_size=224, synthetic=False,
+                      dev="tpu"),
+        batch, scan_k,
+    )
+
+
+def bench_vgg(batch: int, scan_k: int) -> None:
+    """``--vgg`` mode: VGG-16 training throughput.  BASELINE.json's
+    config list names "ImageNet GoogLeNet/VGG-16 DP v5e-8"; this is the
+    single-chip VGG-16 number (doc/performance.md has the batch curve)."""
+    from cxxnet_tpu.models import vgg16_conf
+
+    _bench_imagenet_conf(
+        "vgg", "VGG-16",
+        vgg16_conf(batch_size=batch, input_size=224, synthetic=False,
+                   dev="tpu"),
+        batch, scan_k,
     )
 
 
@@ -255,10 +279,11 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
     args = [a for a in sys.argv[1:] if a not in ("--io", "--lm",
-                                                 "--resnet")]
+                                                 "--resnet", "--vgg")]
     io_mode = "--io" in sys.argv[1:]
     lm_mode = "--lm" in sys.argv[1:]
     resnet_mode = "--resnet" in sys.argv[1:]
+    vgg_mode = "--vgg" in sys.argv[1:]
     batch_given = len(args) > 0
     batch = int(args[0]) if batch_given else 128
     scan_k = int(args[1]) if len(args) > 1 else 50
@@ -272,6 +297,9 @@ def main() -> None:
         return
     if resnet_mode:
         bench_resnet(batch, min(scan_k, 30))
+        return
+    if vgg_mode:
+        bench_vgg(batch, min(scan_k, 20))
         return
 
     from __graft_entry__ import _build_googlenet

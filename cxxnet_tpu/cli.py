@@ -292,8 +292,31 @@ class LearnTask:
             self.net_trainer.start_round(self.start_counter)
             self.itr_train.before_first()
             timer.clear()
+            pipe_mark = time.perf_counter()  # last fence (lap start)
             pending: List = []  # scan_steps>1: batches staged for ONE dispatch
-            in_flight: List = []  # async scan handles (chunk overlap)
+            in_flight: List = []  # async (handle, n_steps) chunks in flight
+
+            def _lap(n_steps: int) -> None:
+                """Fold the span since the last fence into the timer —
+                decode + dispatch + device wait for one chunk.  The laps
+                (plus the round-end drain) tile the round's wall time
+                exactly, so samples/sec is the true PIPELINE rate (max of
+                host and device time per chunk), not just device time."""
+                nonlocal pipe_mark
+                now = time.perf_counter()
+                timer.add(now - pipe_mark, n_steps)
+                pipe_mark = now
+
+            def _fence(drain_all: bool) -> None:
+                """Block on finished chunks, recording a lap per chunk.
+                ``drain_all=False`` keeps the newest chunk running — the
+                double buffer (chunk k-1 must land before k+2 stages)."""
+                import jax as _jx
+
+                while len(in_flight) > (0 if drain_all else 1):
+                    handle, ns = in_flight.pop(0)
+                    _jx.block_until_ready(handle)
+                    _lap(ns)
 
             def _flush_pending() -> None:
                 """Run staged batches as one device program (lax.scan over
@@ -305,54 +328,52 @@ class LearnTask:
                 chunk k+1 (the reference's two-stage ThreadBuffer
                 overlap, here via XLA's async dispatch queue).  At most
                 two chunks stay in flight — a double buffer — so host
-                memory stays bounded; the per-chunk timer then measures
-                the PIPELINE rate (max of host and device time), which
-                is the honest number for a training system."""
+                memory stays bounded.  Timing is fence-to-fence (_lap):
+                each recorded span covers a chunk's host decode AND its
+                device wait, so the round statistics report the honest
+                pipeline rate.  With ``eval_train = 1`` every chunk is
+                synchronous (metrics fetch outputs) and the timer spans
+                just the dispatch+wait, the plain step-time metric."""
                 nonlocal global_step
                 if not pending:
                     return
                 tracer.step(global_step)
-                timer.start()
+                sync_mode = bool(self.net_trainer.eval_train)
+                if sync_mode:
+                    timer.start()
                 if len(pending) == 1:
                     from .io.data import DataBatch as _DB
 
+                    if not sync_mode:
+                        _fence(drain_all=True)  # update() syncs anyway
                     self.net_trainer.update(
                         _DB(data=pending[0][0], label=pending[0][1])
                     )
-                    if not self.net_trainer.eval_train:
+                    if not sync_mode:
                         self.net_trainer.sync()
+                        _lap(1)
                 else:
                     import numpy as _np
 
                     handle = self.net_trainer.update_scan(
                         _np.stack([d for d, _ in pending]),
                         _np.stack([l for _, l in pending]),
-                        sync=bool(self.net_trainer.eval_train),
+                        sync=sync_mode,
                         # sharded iterators guarantee equal K per process
                         # (equal-steps contract) — skip the collective
                         # K-check so the async overlap stays unbroken
                         check_steps=False,
                     )
-                    if not self.net_trainer.eval_train:
-                        in_flight.append(handle)
-                if not self.net_trainer.eval_train:
-                    # double buffer: fence on the OLDER in-flight chunk
-                    # (chunk k-1 must be done before k+2 is staged); the
-                    # newest keeps running while the host loads more
-                    while len(in_flight) > 1:
-                        import jax as _jx
-
-                        _jx.block_until_ready(in_flight.pop(0))
-                timer.stop(n_steps=len(pending))
+                    if not sync_mode:
+                        in_flight.append((handle, len(pending)))
+                        _fence(drain_all=False)
+                if sync_mode:
+                    timer.stop(n_steps=len(pending))
                 global_step += len(pending)
                 pending.clear()
 
             def _drain_in_flight() -> None:
-                if in_flight:
-                    import jax as _jx
-
-                    _jx.block_until_ready(in_flight)
-                    in_flight.clear()
+                _fence(drain_all=True)
 
             # multi-process scan is safe from the CLI: sharded train
             # iterators run equal batch counts per round (equal-steps
@@ -382,6 +403,8 @@ class LearnTask:
                             _flush_pending()
                     else:
                         _flush_pending()  # keep update order
+                        _fence(drain_all=True)  # update()'s sync would
+                        # fence leftovers inside the timed span otherwise
                         tracer.step(global_step)
                         timer.start()
                         self.net_trainer.update(batch)
@@ -389,6 +412,7 @@ class LearnTask:
                             self.net_trainer.sync()
                         timer.stop()
                         global_step += 1
+                        pipe_mark = time.perf_counter()  # span was timed
                 sample_counter += 1
                 if (self.print_step > 0 and sample_counter % self.print_step == 0
                         and not self.silent):

@@ -612,6 +612,7 @@ class BatchNormLayer(Layer):
         self.eps = 1e-10
         self.bn_eval = "batch"  # reference parity; "running" for EMA stats
         self.bn_momentum = 0.9
+        self.bn_stats = "twopass"  # "onepass": E[x^2]-E[x]^2, one read
 
     def set_param(self, name, val):
         if name == "init_slope":
@@ -626,6 +627,10 @@ class BatchNormLayer(Layer):
             self.bn_eval = val
         elif name == "bn_momentum":
             self.bn_momentum = float(val)
+        elif name == "bn_stats":
+            if val not in ("twopass", "onepass"):
+                raise ValueError("bn_stats must be twopass or onepass")
+            self.bn_stats = val
         else:
             super().set_param(name, val)
 
@@ -669,7 +674,16 @@ class BatchNormLayer(Layer):
         axes = tuple(range(x.ndim - 1))  # all but channel
         xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axis=axes)
-        var = jnp.mean((xf - mean) ** 2, axis=axes)
+        if self.bn_stats == "onepass":
+            # one read of x: E[x^2]-E[x]^2, both reductions fuse into a
+            # single pass (the two-pass form serializes: var needs mean).
+            # f32 accumulation over activations in [-5,5] keeps ~7
+            # significant digits — fine for BN, and each step's stats are
+            # recomputed so no error accumulates across steps.
+            var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean,
+                              0.0)
+        else:
+            var = jnp.mean((xf - mean) ** 2, axis=axes)
         return mean, var
 
     def apply(self, params, inputs, *, train=False, rng=None, step=None):

@@ -511,6 +511,7 @@ def vgg16_conf(
     synthetic: bool = True,
     nsample: int = 0,
     dev: str = "tpu",
+    compute_dtype: str = "bfloat16",
 ) -> str:
     """VGG-16 (configuration D, Simonyan & Zisserman 2014)."""
     shape = f"3,{input_size},{input_size}"
@@ -558,7 +559,10 @@ def vgg16_conf(
         "layer[f8->f8] = softmax\n"
         "netconfig = end\n"
     )
-    extra = "metric = rec@1\nmetric = rec@5\n"
+    extra = (
+        "metric = rec@1\nmetric = rec@5\n"
+        f"compute_dtype = {compute_dtype}\n"
+    )
     return data + net + _tail(batch_size, shape, 74, eta=0.01, dev=dev, extra=extra)
 
 

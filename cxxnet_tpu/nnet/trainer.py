@@ -391,7 +391,7 @@ class NetTrainer:
         return self._jit_cache[key]
 
     def update_scan(self, data, labels, n_steps: Optional[int] = None,
-                    sync: bool = True, check_steps: bool = True) -> np.ndarray:
+                    sync: bool = True, check_steps: bool = True):
         """Run K train steps in ONE dispatched device program.
 
         Two modes, both requiring full ``batch_size`` batches and
@@ -402,8 +402,11 @@ class NetTrainer:
         * ``data`` of shape ``[B, ...]`` with ``n_steps=K`` — the same
           staged batch is reused every step (synthetic benchmark mode).
 
-        Returns the per-step f32 losses, shape ``[K]``.  With
-        ``sync=False`` (and ``eval_train`` off) the losses come back as a
+        Returns the per-step f32 losses, shape ``[K]`` — a host
+        ``np.ndarray`` when ``sync=True``, a ``jax.Array`` otherwise.
+        With ``sync=False`` (requires ``eval_train`` off — per-step train
+        metrics must fetch outputs, which is a full sync, so the combo
+        raises instead of silently serializing) the losses come back as a
         device array WITHOUT draining the dispatch queue — the caller
         overlaps host work (decode/augment of the next chunk) with the
         device scan and fences later (``sync()`` or ``np.asarray`` on the
@@ -413,6 +416,12 @@ class NetTrainer:
         side is the in-flight scan program.
         """
         assert self.net is not None, "init_model/load_model first"
+        if not sync and self.eval_train:
+            raise ValueError(
+                "update_scan(sync=False) cannot overlap with eval_train: "
+                "per-step train metrics fetch the scan outputs (a full "
+                "sync); pass sync=True or set eval_train = 0"
+            )
         if self.update_period != 1:
             raise ValueError("update_scan requires update_period == 1")
         if self._n_extras():

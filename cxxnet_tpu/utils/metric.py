@@ -8,9 +8,13 @@ Parity: ``/root/reference/src/utils/metric.h`` —
   (the reference never takes the square root despite the name — kept)
 * ``logloss``: -log p[target], clamped to [1e-15, 1-1e-15]; binary form
   for 1-column predictions with the built-in NaN check
-* ``rec@n``: fraction of the label list present in the top-n predictions
-  (deterministic sort here; the reference shuffles before sorting to break
-  ties randomly)
+* ``rec@n``: fraction of the label list present in the top-n predictions.
+  Ties are broken RANDOMLY per instance, matching the reference
+  (src/utils/metric.h:150-170 shuffles the index vector before its
+  partial sort): fresh per-row random jitter from a seeded per-metric
+  PRNG is the lexsort secondary key, so equal scores enter the top-n
+  in a different random order for every row while runs stay
+  reproducible.
 * ``MetricSet``: multiple metrics over named label fields; report format
   ``\\tname-metric[field]:value`` (metric.h:193-203)
 
@@ -97,6 +101,7 @@ class MetricRecall(Metric):
             raise ValueError("must specify n for rec@n")
         self.topn = int(m.group(1))
         self.name = name
+        self._rng = np.random.RandomState(0)
 
     def _batch_sum(self, pred, label):
         if pred.shape[1] < self.topn:
@@ -104,7 +109,12 @@ class MetricRecall(Metric):
                 f"rec@{self.topn} meaningless for prediction list of "
                 f"size {pred.shape[1]}"
             )
-        top = np.argsort(-pred, axis=1)[:, : self.topn]
+        # random tie-break (reference parity): sort by score with a
+        # fresh per-row random secondary key, so equal scores enter the
+        # top-n in random order per instance
+        jitter = self._rng.random_sample(pred.shape)
+        order = np.lexsort((jitter, -pred), axis=1)
+        top = order[:, : self.topn]
         total = 0.0
         for i in range(pred.shape[0]):
             hits = np.isin(label[i].astype(np.int64), top[i]).sum()

@@ -37,9 +37,15 @@ class StepTimer:
         program (update_scan); record the per-step average so the round
         statistics stay per-step comparable."""
         if self._t0 is not None:
-            dt = (time.perf_counter() - self._t0) / max(1, n_steps)
-            self._times.extend([dt] * max(1, n_steps))
+            self.add(time.perf_counter() - self._t0, n_steps)
             self._t0 = None
+
+    def add(self, dt: float, n_steps: int = 1) -> None:
+        """Record an externally measured span covering ``n_steps`` steps
+        (the async-overlap train loop times fence-to-fence laps itself
+        so the spans sum to the round's wall time)."""
+        per = dt / max(1, n_steps)
+        self._times.extend([per] * max(1, n_steps))
 
     def clear(self) -> None:
         self._times = []

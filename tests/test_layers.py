@@ -258,6 +258,19 @@ def test_batch_norm(rng, shape):
     np.testing.assert_allclose(out_eval, want, rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.parametrize("shape", [(8, 5, 5, 3), (16, 7)])
+def test_batch_norm_onepass_stats_parity(rng, shape):
+    # bn_stats = onepass (E[x^2]-E[x]^2, single read) must match the
+    # two-pass default to f32 working precision
+    x = (rng.randn(*shape) * 3 + 1).astype(np.float32)
+    two = mk("batch_norm", [("init_slope", "1.5"), ("init_bias", "0.2")])
+    one = mk("batch_norm", [("init_slope", "1.5"), ("init_bias", "0.2"),
+                            ("bn_stats", "onepass")])
+    (out2,), _ = run1(two, x, train=True)
+    (out1,), _ = run1(one, x, train=True)
+    np.testing.assert_allclose(out1, out2, rtol=1e-3, atol=1e-4)
+
+
 # ---------------------------------------------------------------- elemwise
 
 

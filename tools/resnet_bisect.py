@@ -1,0 +1,92 @@
+"""ResNet-50 step-time bisection (doc/performance.md discipline).
+
+Times the scanned train step for diagnostic variants of the conf,
+isolating cost centers the way the GoogLeNet pooling/fusion bisection
+did.  Run on the TPU host:
+
+    python tools/resnet_bisect.py [variant ...]
+
+Variants: base, onepass, nobn, noavg, nomaxpool (default: all).
+"""
+
+import os
+import re
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+)
+
+
+def variant_conf(name: str, batch: int) -> str:
+    from cxxnet_tpu.models import resnet50_conf
+
+    conf = resnet50_conf(batch_size=batch, input_size=224, synthetic=False,
+                         dev="tpu")
+    if name == "base":
+        return conf
+    if name == "onepass":
+        # every batch_norm computes E[x^2]-E[x]^2 in one pass
+        return re.sub(r"(= batch_norm:\w+\n)", r"\1  bn_stats = onepass\n",
+                      conf)
+    if name == "nobn":
+        # batch_norm -> bias: isolates what all 53 BNs cost
+        return re.sub(r"= batch_norm:(\w+)\n", r"= bias:\1\n", conf)
+    if name == "noavg":
+        # global avg pool -> stride-7 max slice (cheap): isolates tail
+        return conf.replace(
+            "layer[s3b2->pool] = avg_pooling\n  kernel_size = 7\n"
+            "  stride = 1\n",
+            "layer[s3b2->pool] = max_pooling\n  kernel_size = 1\n"
+            "  stride = 7\n",
+        )
+    if name == "nomaxpool":
+        # stem max_pool k3 s2 -> avg (GoogLeNet diag analog)
+        return conf.replace(
+            "layer[b1->p1] = max_pooling\n  kernel_size = 3\n  stride = 2\n",
+            "layer[b1->p1] = avg_pooling\n  kernel_size = 3\n  stride = 2\n",
+        )
+    raise SystemExit(f"unknown variant {name}")
+
+
+def time_variant(name: str, batch: int = 128, scan_k: int = 30) -> float:
+    import jax
+
+    from bench import _time_scans  # the shared measurement harness
+    from cxxnet_tpu import config as cfgmod
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    tr = NetTrainer()
+    tr.set_params(cfgmod.parse_pairs(variant_conf(name, batch)))
+    tr.eval_train = 0
+    tr.init_model()
+    rng = np.random.RandomState(0)
+    data = jax.device_put(rng.randn(batch, 224, 224, 3).astype(np.float32))
+    labels = jax.device_put(
+        rng.randint(0, 1000, (batch, 1)).astype(np.float32)
+    )
+    dt = _time_scans(tr, data, labels, scan_k)
+    print(f"{name:10s} {dt*1e3:6.1f} ms/step  {batch/dt:6.0f} img/s",
+          flush=True)
+    return dt
+
+
+def main() -> None:
+    import jax
+
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+    names = sys.argv[1:] or ["base", "onepass", "nobn", "noavg", "nomaxpool"]
+    for name in names:
+        time_variant(name)
+
+
+if __name__ == "__main__":
+    main()
