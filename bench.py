@@ -218,23 +218,31 @@ def bench_lm(batch: int, seq_len: int, scan_k: int) -> None:
 
 
 def _bench_imagenet_conf(tag: str, desc: str, conf: str, batch: int,
-                         scan_k: int) -> None:
+                         scan_k: int, input_size: int = 224,
+                         num_class: int = 1000,
+                         fuse: bool = False) -> float:
     """Shared trainer setup + synthetic-data measurement for the
     ImageNet-model bench modes (stderr only — the stdout JSON stays the
-    BASELINE GoogLeNet metric)."""
+    BASELINE GoogLeNet metric).  Also the harness tools/resnet_bisect.py
+    times its diagnostic variants with, so bisect numbers stay
+    comparable to bench numbers.  Returns sec/step."""
     import jax
 
     from cxxnet_tpu import config as cfgmod
     from cxxnet_tpu.nnet.trainer import NetTrainer
 
+    if fuse:
+        conf += "fuse_1x1 = 1\n"
     tr = NetTrainer()
     tr.set_params(cfgmod.parse_pairs(conf))
     tr.eval_train = 0
     tr.init_model()
     rng = np.random.RandomState(0)
-    data = jax.device_put(rng.randn(batch, 224, 224, 3).astype(np.float32))
+    data = jax.device_put(
+        rng.randn(batch, input_size, input_size, 3).astype(np.float32)
+    )
     labels = jax.device_put(
-        rng.randint(0, 1000, (batch, 1)).astype(np.float32)
+        rng.randint(0, num_class, (batch, 1)).astype(np.float32)
     )
     dt = _time_scans(tr, data, labels, scan_k)
     print(
@@ -242,9 +250,10 @@ def _bench_imagenet_conf(tag: str, desc: str, conf: str, batch: int,
         f"= {batch/dt:.0f} img/s/chip",
         file=sys.stderr, flush=True,
     )
+    return dt
 
 
-def bench_resnet(batch: int, scan_k: int) -> None:
+def bench_resnet(batch: int, scan_k: int, fuse: bool = False) -> None:
     """``--resnet`` mode: ResNet-50 training throughput."""
     from cxxnet_tpu.models import resnet50_conf
 
@@ -252,11 +261,11 @@ def bench_resnet(batch: int, scan_k: int) -> None:
         "resnet", "ResNet-50",
         resnet50_conf(batch_size=batch, input_size=224, synthetic=False,
                       dev="tpu"),
-        batch, scan_k,
+        batch, scan_k, fuse=fuse,
     )
 
 
-def bench_vgg(batch: int, scan_k: int) -> None:
+def bench_vgg(batch: int, scan_k: int, fuse: bool = False) -> None:
     """``--vgg`` mode: VGG-16 training throughput.  BASELINE.json's
     config list names "ImageNet GoogLeNet/VGG-16 DP v5e-8"; this is the
     single-chip VGG-16 number (doc/performance.md has the batch curve)."""
@@ -266,7 +275,39 @@ def bench_vgg(batch: int, scan_k: int) -> None:
         "vgg", "VGG-16",
         vgg16_conf(batch_size=batch, input_size=224, synthetic=False,
                    dev="tpu"),
-        batch, scan_k,
+        batch, scan_k, fuse=fuse,
+    )
+
+
+def bench_alexnet(batch: int, scan_k: int, fuse: bool = False) -> None:
+    """``--alexnet`` mode: AlexNet training throughput (BASELINE.json's
+    "ImageNet AlexNet single-chip" config)."""
+    from cxxnet_tpu.models import alexnet_conf
+
+    _bench_imagenet_conf(
+        "alexnet", "AlexNet",
+        alexnet_conf(batch_size=batch, synthetic=False, dev="tpu"),
+        batch, scan_k, input_size=227, fuse=fuse,
+    )
+
+
+def bench_bowl(batch: int, scan_k: int) -> None:
+    """``--bowl`` mode: Kaggle NDSB plankton convnet throughput.  The
+    reference's one semi-quantitative claim is ~5 min for 100 rounds at
+    batch 64 on a GTX 780 (BASELINE.md); the printed steps/s implies the
+    equivalent 100-round wall time for a 30k-image train set."""
+    from cxxnet_tpu.models import kaggle_bowl_conf
+
+    dt = _bench_imagenet_conf(
+        "bowl", "NDSB convnet",
+        kaggle_bowl_conf(batch_size=batch, synthetic=False, dev="tpu"),
+        batch, scan_k, input_size=40, num_class=121,
+    )
+    rounds100 = 100 * 30000 / (batch / dt)
+    print(
+        f"# bench[bowl]: 100 rounds x 30k imgs = {rounds100:.0f}s device "
+        "time (reference claim: ~300s on a GTX 780)",
+        file=sys.stderr, flush=True,
     )
 
 
@@ -279,15 +320,26 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
     args = [a for a in sys.argv[1:] if a not in ("--io", "--lm",
-                                                 "--resnet", "--vgg")]
+                                                 "--resnet", "--vgg",
+                                                 "--alexnet", "--bowl",
+                                                 "--fuse")]
     io_mode = "--io" in sys.argv[1:]
     lm_mode = "--lm" in sys.argv[1:]
     resnet_mode = "--resnet" in sys.argv[1:]
     vgg_mode = "--vgg" in sys.argv[1:]
+    alexnet_mode = "--alexnet" in sys.argv[1:]
+    bowl_mode = "--bowl" in sys.argv[1:]
+    fuse_mode = "--fuse" in sys.argv[1:]  # fuse_1x1=1 A/B on image modes
     batch_given = len(args) > 0
     batch = int(args[0]) if batch_given else 128
     scan_k = int(args[1]) if len(args) > 1 else 50
     n_scans = int(args[2]) if len(args) > 2 else 3
+    if fuse_mode and (io_mode or lm_mode or bowl_mode):
+        # bowl too: its net has no sibling 1x1 convs, so an A/B there
+        # would print two identical numbers — refuse instead
+        raise SystemExit(
+            "--fuse only applies to the googlenet/resnet/vgg/alexnet modes"
+        )
     if io_mode:
         bench_io(batch, min(scan_k, 10))
         return
@@ -296,10 +348,18 @@ def main() -> None:
                  scan_k=min(scan_k, 20))
         return
     if resnet_mode:
-        bench_resnet(batch, min(scan_k, 30))
+        bench_resnet(batch, min(scan_k, 30), fuse=fuse_mode)
         return
     if vgg_mode:
-        bench_vgg(batch, min(scan_k, 20))
+        bench_vgg(batch, min(scan_k, 20), fuse=fuse_mode)
+        return
+    if alexnet_mode:
+        bench_alexnet(batch=batch if batch_given else 256,
+                      scan_k=min(scan_k, 30), fuse=fuse_mode)
+        return
+    if bowl_mode:
+        bench_bowl(batch=batch if batch_given else 64,
+                   scan_k=min(scan_k, 50))
         return
 
     from __graft_entry__ import _build_googlenet
@@ -307,6 +367,9 @@ def main() -> None:
     t_build = time.perf_counter()
     tr = _build_googlenet(batch_size=batch, input_size=224, dev="tpu")
     tr.eval_train = 0  # pure step time; no per-step metric fetch
+    if fuse_mode:
+        # sibling 1x1 fusion (net.py _sibling_1x1_groups) A/B mode
+        tr.net.fuse_1x1 = 1
 
     rng = np.random.RandomState(0)
     data = jax.device_put(rng.randn(batch, 224, 224, 3).astype(np.float32))

@@ -46,6 +46,8 @@ class FunctionalNet:
         self.update_period = 1
         self.compute_dtype = jnp.float32
         self.remat = 0
+        self.fuse_1x1 = 0
+        self._fuse_cache = None
         # instantiate layers (shared layers alias the primary instance)
         self.layer_objs: List[Layer] = []
         self.param_key: List[Optional[str]] = []  # params pytree key per layer
@@ -93,6 +95,10 @@ class FunctionalNet:
                 # backprop instead of keeping them in HBM (memory for
                 # FLOPs — lets bigger batches fit per chip)
                 self.remat = int(val)
+            elif name == "fuse_1x1":
+                # execute sibling 1x1 convs on one input node as ONE
+                # concatenated conv (see _sibling_1x1_groups)
+                self.fuse_1x1 = int(val)
             elif name == "compute_dtype":
                 if val in ("bfloat16", "bf16"):
                     self.compute_dtype = jnp.bfloat16
@@ -198,6 +204,93 @@ class FunctionalNet:
         return params
 
     # ------------------------------------------------------------------
+    def _sibling_1x1_groups(self):
+        """Groups of distinct 1x1/s1/p0/ungrouped conv layers sharing one
+        input node, to be executed as ONE concatenated conv.
+
+        Inception blocks issue 3-4 narrow 1x1 convs on the same tensor
+        (GoogLeNet: 16-192 output channels each); the MXU runs one wide
+        GEMM far better than several narrow ones (a 128-lane systolic
+        array is mostly idle on a 16-channel output), and XLA does not
+        merge separate convolutions itself.  Concatenating the HWIO
+        kernels on the O axis and splitting the output channels back is
+        mathematically exact, and parameters stay per-layer — the
+        checkpoint format, weight getters and updater keys are
+        untouched.  Opt-in via ``fuse_1x1 = 1``.
+
+        Returns ``(groups, member)``: leader layer index -> all member
+        indices (declaration order), and member index -> leader.
+        """
+        if self._fuse_cache is not None:
+            return self._fuse_cache
+        from ..layers.conv import ConvolutionLayer
+
+        # group key is (node, write-version at read time): a self-loop
+        # layer (layer[a->a] = relu) WRITES the shared node between two
+        # sibling declarations, so siblings across that write see
+        # different values and must not fuse.  Fused members also run
+        # EARLY (at the leader's position), so a member must be the sole
+        # writer of its output node — otherwise the declaration-order
+        # overwrite sequence changes
+        writes = [0] * self.graph.num_nodes
+        for spec in self.graph.layers:
+            for n in spec.nindex_out:
+                writes[n] += 1
+        version = [0] * self.graph.num_nodes
+        by_input: Dict[Tuple[int, int], List[int]] = {}
+        for i, spec in enumerate(self.graph.layers):
+            is_candidate = False
+            if spec.type_name != "shared":  # aliased params: plain path
+                lay = self.layer_objs[i]
+                if type(lay) is ConvolutionLayer:
+                    p = lay.param
+                    is_candidate = (
+                        (p.kernel_height, p.kernel_width, p.stride,
+                         p.pad_x, p.pad_y, p.num_group)
+                        == (1, 1, 1, 0, 0, 1)
+                        and len(spec.nindex_in) == 1
+                        and len(spec.nindex_out) == 1
+                        and spec.nindex_out[0] != spec.nindex_in[0]
+                        and writes[spec.nindex_out[0]] == 1
+                    )
+            if is_candidate:
+                n = spec.nindex_in[0]
+                by_input.setdefault((n, version[n]), []).append(i)
+            for n in spec.nindex_out:  # reads above happen before writes
+                version[n] += 1
+        groups: Dict[int, List[int]] = {}
+        member: Dict[int, int] = {}
+        for idxs in by_input.values():
+            if len(idxs) < 2:
+                continue
+            groups[idxs[0]] = idxs
+            for j in idxs:
+                member[j] = idxs[0]
+        self._fuse_cache = (groups, member)
+        return self._fuse_cache
+
+    @staticmethod
+    def _apply_fused_1x1(gparams: List[dict], x):
+        """One conv for the whole sibling group; per-member outputs."""
+        from jax import lax
+
+        ws = [d["wmat"].astype(x.dtype) for d in gparams]
+        y = lax.conv_general_dilated(
+            x, jnp.concatenate(ws, axis=3),
+            window_strides=(1, 1), padding=((0, 0), (0, 0)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        outs = []
+        off = 0
+        for d, w in zip(gparams, ws):
+            part = lax.slice_in_dim(y, off, off + w.shape[3], axis=3)
+            off += w.shape[3]
+            if "bias" in d:
+                part = part + d["bias"].astype(x.dtype)
+            outs.append(part)
+        return outs
+
+    # ------------------------------------------------------------------
     def forward(
         self,
         params: Dict[str, dict],
@@ -244,7 +337,25 @@ class FunctionalNet:
             nodes[k + 1] = e
         total_loss = jnp.zeros((), jnp.float32)
         batch = self.batch_size if self.batch_size > 0 else data.shape[0]
+        fuse_groups, fuse_member = (
+            self._sibling_1x1_groups() if self.fuse_1x1 else ({}, {})
+        )
         for i, spec in enumerate(g.layers):
+            if i in fuse_member:
+                if fuse_member[i] != i:
+                    continue  # output produced by its group leader below
+                idxs = fuse_groups[i]
+                x = nodes[spec.nindex_in[0]]
+                if x is None:
+                    raise ValueError(f"layer {i}: unset input node")
+                gparams = [params.get(self.param_key[j], {}) for j in idxs]
+                run_f = (
+                    jax.checkpoint(self._apply_fused_1x1)
+                    if (self.remat and train) else self._apply_fused_1x1
+                )
+                for j, out in zip(idxs, run_f(gparams, x)):
+                    nodes[g.layers[j].nindex_out[0]] = out
+                continue
             lay = self.layer_objs[i]
             inputs = [nodes[n] for n in spec.nindex_in]
             if any(v is None for v in inputs):

@@ -174,10 +174,14 @@ batch_size = 32
 dev = cpu
 num_round = 2
 eval_train = 0
+scan_steps = 4
 eta = 0.1
 metric = error
 silent = 1
 """)
+    # scan_steps + eval_train=0: the CLI's ASYNC overlapped chunk path
+    # (check_steps=False, double buffer) must not deadlock across
+    # processes and must keep weights replicated
     _run_cli_dist(tmp_path, conf, port)
     m0 = (tmp_path / "p0" / "models" / "0002.model").read_bytes()
     m1 = (tmp_path / "p1" / "models" / "0002.model").read_bytes()

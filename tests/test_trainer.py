@@ -1,5 +1,6 @@
 """NetTrainer tests: overfit, accumulation, checkpointing, finetune, weights."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -651,3 +652,125 @@ def test_update_scan_rejects_node_metrics():
     tr2.init_model()
     tr2.update_scan(x, y, n_steps=2)
     assert tr2.epoch_counter == 2
+
+
+INCEPTION_CFG = """
+netconfig=start
+layer[0->stem] = conv:stem
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+  init_sigma = 0.1
+layer[stem->stem] = relu
+layer[stem->b1] = conv:br1
+  kernel_size = 1
+  nchannel = 4
+  init_sigma = 0.1
+layer[stem->b2] = conv:br2
+  kernel_size = 1
+  nchannel = 6
+  init_sigma = 0.1
+layer[stem->b3] = conv:br3
+  kernel_size = 1
+  nchannel = 2
+  init_sigma = 0.1
+layer[b1,b2,b3->cat] = ch_concat
+layer[cat->fl] = flatten
+layer[fl->out] = fullc:fc
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,6,6
+batch_size = 16
+eta = 0.1
+momentum = 0.9
+metric = error
+"""
+
+
+@pytest.mark.parametrize("remat", ["0", "1"])
+def test_fuse_1x1_sibling_convs_parity(remat):
+    """fuse_1x1=1 executes the three sibling 1x1 branch convs as one
+    concatenated conv; weights after training and predictions must match
+    the unfused graph (same seed) to fp tolerance."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(32, 6, 6, 3).astype(np.float32)
+    y = rng.randint(0, 4, (32, 1)).astype(np.float32)
+
+    def run(fuse):
+        tr = NetTrainer()
+        tr.set_params(C.parse_pairs(
+            INCEPTION_CFG + f"fuse_1x1 = {fuse}\nremat = {remat}\n"
+        ))
+        tr.set_param("seed", "7")
+        tr.init_model()
+        groups, member = tr.net._sibling_1x1_groups()
+        if fuse:
+            assert [len(v) for v in groups.values()] == [3]
+        for _ in range(3):
+            for b in batches(x, y):
+                tr.update(b)
+        preds = np.concatenate(
+            [tr.predict(b) for b in batches(x, y)]
+        )
+        return preds, jax.tree_util.tree_map(np.asarray, tr.params)
+
+    p0, w0 = run(0)
+    p1, w1 = run(1)
+    f0 = {jax.tree_util.keystr(k): a
+          for k, a in jax.tree_util.tree_leaves_with_path(w0)}
+    f1 = {jax.tree_util.keystr(k): a
+          for k, a in jax.tree_util.tree_leaves_with_path(w1)}
+    assert sorted(f0) == sorted(f1)
+    for k in f0:
+        np.testing.assert_allclose(f0[k], f1[k], rtol=1e-5, atol=1e-5,
+                                   err_msg=k)
+    np.testing.assert_allclose(p0, p1, rtol=1e-5, atol=1e-5)
+
+
+def test_fuse_1x1_respects_selfloop_writes():
+    """A self-loop layer (relu writing the shared node) between sibling
+    1x1 declarations versions the node: siblings across the write must
+    NOT fuse (they read different values)."""
+    cfg = """
+netconfig=start
+layer[0->stem] = conv:stem
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+  init_sigma = 0.1
+layer[stem->b1] = conv:br1
+  kernel_size = 1
+  nchannel = 4
+  init_sigma = 0.1
+layer[stem->stem] = relu
+layer[stem->b2] = conv:br2
+  kernel_size = 1
+  nchannel = 4
+  init_sigma = 0.1
+layer[b1,b2->cat] = ch_concat
+layer[cat->fl] = flatten
+layer[fl->out] = fullc:fc
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,6,6
+batch_size = 8
+eta = 0.1
+metric = error
+fuse_1x1 = 1
+"""
+    tr = NetTrainer()
+    tr.set_params(C.parse_pairs(cfg))
+    tr.init_model()
+    groups, member = tr.net._sibling_1x1_groups()
+    assert groups == {} and member == {}  # the relu write splits them
+
+    # and the net still trains correctly through the plain path
+    rng = np.random.RandomState(2)
+    x = rng.randn(8, 6, 6, 3).astype(np.float32)
+    y = rng.randint(0, 4, (8, 1)).astype(np.float32)
+    tr.update(DataBatch(data=x, label=y))
+    tr.sync()

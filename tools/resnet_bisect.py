@@ -13,8 +13,6 @@ import os
 import re
 import sys
 
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 CACHE_DIR = os.path.join(
@@ -54,25 +52,13 @@ def variant_conf(name: str, batch: int) -> str:
 
 
 def time_variant(name: str, batch: int = 128, scan_k: int = 30) -> float:
-    import jax
+    # the bench harness itself, so variant numbers stay comparable to
+    # `bench.py --resnet`
+    from bench import _bench_imagenet_conf
 
-    from bench import _time_scans  # the shared measurement harness
-    from cxxnet_tpu import config as cfgmod
-    from cxxnet_tpu.nnet.trainer import NetTrainer
-
-    tr = NetTrainer()
-    tr.set_params(cfgmod.parse_pairs(variant_conf(name, batch)))
-    tr.eval_train = 0
-    tr.init_model()
-    rng = np.random.RandomState(0)
-    data = jax.device_put(rng.randn(batch, 224, 224, 3).astype(np.float32))
-    labels = jax.device_put(
-        rng.randint(0, 1000, (batch, 1)).astype(np.float32)
+    return _bench_imagenet_conf(
+        f"bisect:{name}", name, variant_conf(name, batch), batch, scan_k
     )
-    dt = _time_scans(tr, data, labels, scan_k)
-    print(f"{name:10s} {dt*1e3:6.1f} ms/step  {batch/dt:6.0f} img/s",
-          flush=True)
-    return dt
 
 
 def main() -> None:
