@@ -155,6 +155,18 @@ class Net:
         else:
             raise TypeError(f"update does not support type {type(data)}")
 
+    def update_scan(self, data: np.ndarray, label: np.ndarray,
+                    n_steps: Optional[int] = None) -> np.ndarray:
+        """Run K train steps as ONE device program (the CLI's
+        ``scan_steps`` fast path, ``NetTrainer.update_scan``): ``data``
+        is a ``[K, B, ...]`` micro-batch stack, or a single ``[B, ...]``
+        batch reused ``n_steps`` times.  Returns the per-step losses —
+        the library-API spelling of device-side multi-step training."""
+        return np.asarray(
+            self._trainer.update_scan(np.asarray(data), np.asarray(label),
+                                      n_steps=n_steps)
+        )
+
     def evaluate(self, data: DataIter, name: str) -> str:
         if not isinstance(data, DataIter):
             raise TypeError(f"evaluate does not support type {type(data)}")

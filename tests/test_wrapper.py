@@ -189,3 +189,18 @@ eta = 0.1
     bare = Net(dev="cpu")
     with pytest.raises(ValueError, match="netconfig"):
         bare.load_model(str(tmp_path / "m.model"))
+
+
+def test_net_update_scan_trains_like_update():
+    # [K, B, ...] stack path: 4 chunks of 16 per epoch as one dispatch
+    net = Net(dev="cpu", cfg=MLP_CFG)
+    net.init_model()
+    x, y = toy_xy(64)
+    stack = x.reshape(4, 16, -1)
+    lstack = y.reshape(4, 16, -1)
+    losses = None
+    for _ in range(60):
+        losses = net.update_scan(stack, lstack)
+    assert losses.shape == (4,)
+    pred = net.predict(x[:16])
+    assert (pred == y[:16]).mean() >= 0.9
