@@ -209,3 +209,27 @@ def test_attention_layer_ring_pallas_matches_xla_ring():
         np.asarray(outs["pallas"]), np.asarray(outs["xla"]),
         rtol=2e-5, atol=2e-5,
     )
+
+
+def test_flash_lse_fully_masked_rows_are_zero():
+    """Misaligned offsets can fully mask a query row inside a live block
+    (causal, keys strictly in the row's future): `out` must be zeros for
+    that row — not a mean of v (the exp(s - NEG_INF)=1 failure) — so
+    `out` is valid standalone, not only jointly with lse."""
+    from cxxnet_tpu.ops.flash import flash_mha_lse
+
+    b, t, h, d = 1, 32, 2, 16
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+    # keys start 8 positions after the queries: query rows 0..7 see no
+    # key at all under the causal mask
+    out, lse = flash_mha_lse(q, k, v, q_off=0, k_off=8, causal=True,
+                             block_q=16, block_k=16, interpret=True)
+    out = np.asarray(out)
+    np.testing.assert_array_equal(out[:, :8], np.zeros_like(out[:, :8]))
+    # the masked rows' lse stays ~NEG_INF so a ring merge washes them out
+    assert np.all(np.asarray(lse)[:, :8] < -1e29)
+    # live rows are real attention outputs
+    assert np.abs(out[:, 8:]).max() > 0

@@ -211,6 +211,14 @@ def test_text_iterator_round_batch_pads_final(corpus):
     if n_windows % 64:
         assert last.num_batch_padd == 64 - n_windows % 64
         assert last.data.shape == (64, 16)
+        # pad rows reuse the LEADING windows; inst_index must mirror the
+        # actual rows served (advisor r2: arange past len(starts) used to
+        # misattribute prediction bookkeeping for wrapped rows)
+        padd = last.num_batch_padd
+        np.testing.assert_array_equal(
+            last.inst_index[-padd:], np.arange(padd)
+        )
+        assert last.inst_index.max() < n_windows
     # round_batch = 0 drops the partial batch (mnist-style)
     it2 = _text_iter(corpus, seq_len=16, batch_size=64, round_batch=0)
     it2.before_first()

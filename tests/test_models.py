@@ -114,3 +114,30 @@ def test_googlenet_train_step_small():
     labels = rng.randint(0, 1000, size=(2, 1)).astype(np.float32)
     tr.update_all(data, labels)
     assert tr.epoch_counter == 1
+
+
+def test_googlenet_fuse_1x1_prediction_parity():
+    """fuse_1x1 finds 9 groups of 3 on the real GoogLeNet graph and the
+    fused forward matches the plain one on identical weights."""
+    text = MODEL_BUILDERS["googlenet"](
+        batch_size=2, dev="cpu", input_size=64, nsample=4
+    )
+    rng = np.random.RandomState(1)
+    data = rng.randn(2, 64, 64, 3).astype(np.float32)
+
+    def build(fuse):
+        tr = NetTrainer()
+        tr.set_params(_global_cfg(text + f"fuse_1x1 = {fuse}\n"))
+        tr.set_param("seed", "3")
+        tr.init_model()
+        return tr
+
+    t0, t1 = build(0), build(1)
+    groups, member = t1.net._sibling_1x1_groups()
+    assert sorted(len(v) for v in groups.values()) == [3] * 9
+    from cxxnet_tpu.io.data import DataBatch
+    b = DataBatch(data=data, label=None)
+    p0 = t0.extract_feature(b, "top[-1]")
+    p1 = t1.extract_feature(b, "top[-1]")
+    np.testing.assert_allclose(np.asarray(p0), np.asarray(p1),
+                               rtol=2e-4, atol=2e-5)

@@ -319,3 +319,64 @@ def test_zero_rejects_unsupported_levels():
     tr = NetTrainer()
     with pytest.raises(ValueError, match="zero=2"):
         tr.set_param("zero", "2")
+
+
+CONV_FUSE_CFG = """
+netconfig=start
+layer[0->stem] = conv:stem
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+  init_sigma = 0.1
+layer[stem->stem] = relu
+layer[stem->b1] = conv:br1
+  kernel_size = 1
+  nchannel = 8
+  init_sigma = 0.1
+layer[stem->b2] = conv:br2
+  kernel_size = 1
+  nchannel = 8
+  init_sigma = 0.1
+layer[b1,b2->cat] = ch_concat
+layer[cat->fl] = flatten
+layer[fl->out] = fullc:fc
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,8,8
+batch_size = 16
+seed = 7
+eta = 0.1
+momentum = 0.9
+"""
+
+
+@pytest.mark.parametrize("mp", [1, 2])
+def test_fuse_1x1_matches_under_mesh(mp):
+    """The concatenated sibling conv composes with DP (and DP x TP)
+    sharding: fused training over the 8-device mesh equals unfused."""
+    from cxxnet_tpu import config as C
+
+    def train(fuse):
+        tr = NetTrainer()
+        tr.set_params(C.parse_pairs(
+            CONV_FUSE_CFG
+            + f"dev = tpu:0-7\nmodel_parallel = {mp}\nfuse_1x1 = {fuse}\n"
+        ))
+        tr.init_model()
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            tr.update_all(rng.randn(16, 8, 8, 3).astype(np.float32),
+                          rng.randint(0, 4, (16, 1)).astype(np.float32))
+        return tr
+
+    t0, t1 = train(0), train(1)
+    assert t1.net._sibling_1x1_groups()[0]  # groups actually formed
+    for key in t0.params:
+        for tag in t0.params[key]:
+            np.testing.assert_allclose(
+                np.asarray(t0.params[key][tag]),
+                np.asarray(t1.params[key][tag]),
+                rtol=2e-4, atol=2e-5, err_msg=f"{key}/{tag}"
+            )
