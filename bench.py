@@ -220,7 +220,7 @@ def bench_lm(batch: int, seq_len: int, scan_k: int) -> None:
 def _bench_imagenet_conf(tag: str, desc: str, conf: str, batch: int,
                          scan_k: int, input_size: int = 224,
                          num_class: int = 1000,
-                         fuse: bool = False) -> float:
+                         fuse: bool = True) -> float:
     """Shared trainer setup + synthetic-data measurement for the
     ImageNet-model bench modes (stderr only — the stdout JSON stays the
     BASELINE GoogLeNet metric).  Also the harness tools/resnet_bisect.py
@@ -231,8 +231,8 @@ def _bench_imagenet_conf(tag: str, desc: str, conf: str, batch: int,
     from cxxnet_tpu import config as cfgmod
     from cxxnet_tpu.nnet.trainer import NetTrainer
 
-    if fuse:
-        conf += "fuse_1x1 = 1\n"
+    if not fuse:
+        conf += "fuse_1x1 = 0\n"
     tr = NetTrainer()
     tr.set_params(cfgmod.parse_pairs(conf))
     tr.eval_train = 0
@@ -253,7 +253,7 @@ def _bench_imagenet_conf(tag: str, desc: str, conf: str, batch: int,
     return dt
 
 
-def bench_resnet(batch: int, scan_k: int, fuse: bool = False) -> None:
+def bench_resnet(batch: int, scan_k: int, fuse: bool = True) -> None:
     """``--resnet`` mode: ResNet-50 training throughput."""
     from cxxnet_tpu.models import resnet50_conf
 
@@ -265,7 +265,7 @@ def bench_resnet(batch: int, scan_k: int, fuse: bool = False) -> None:
     )
 
 
-def bench_vgg(batch: int, scan_k: int, fuse: bool = False) -> None:
+def bench_vgg(batch: int, scan_k: int, fuse: bool = True) -> None:
     """``--vgg`` mode: VGG-16 training throughput.  BASELINE.json's
     config list names "ImageNet GoogLeNet/VGG-16 DP v5e-8"; this is the
     single-chip VGG-16 number (doc/performance.md has the batch curve)."""
@@ -279,7 +279,7 @@ def bench_vgg(batch: int, scan_k: int, fuse: bool = False) -> None:
     )
 
 
-def bench_alexnet(batch: int, scan_k: int, fuse: bool = False) -> None:
+def bench_alexnet(batch: int, scan_k: int, fuse: bool = True) -> None:
     """``--alexnet`` mode: AlexNet training throughput (BASELINE.json's
     "ImageNet AlexNet single-chip" config)."""
     from cxxnet_tpu.models import alexnet_conf
@@ -322,23 +322,25 @@ def main() -> None:
     args = [a for a in sys.argv[1:] if a not in ("--io", "--lm",
                                                  "--resnet", "--vgg",
                                                  "--alexnet", "--bowl",
-                                                 "--fuse")]
+                                                 "--nofuse")]
     io_mode = "--io" in sys.argv[1:]
     lm_mode = "--lm" in sys.argv[1:]
     resnet_mode = "--resnet" in sys.argv[1:]
     vgg_mode = "--vgg" in sys.argv[1:]
     alexnet_mode = "--alexnet" in sys.argv[1:]
     bowl_mode = "--bowl" in sys.argv[1:]
-    fuse_mode = "--fuse" in sys.argv[1:]  # fuse_1x1=1 A/B on image modes
+    if "--fuse" in sys.argv[1:]:
+        raise SystemExit("--fuse is now the default; use --nofuse for the A/B")
+    nofuse_mode = "--nofuse" in sys.argv[1:]  # fuse_1x1=0 A/B on image modes
     batch_given = len(args) > 0
     batch = int(args[0]) if batch_given else 128
     scan_k = int(args[1]) if len(args) > 1 else 50
     n_scans = int(args[2]) if len(args) > 2 else 3
-    if fuse_mode and (io_mode or lm_mode or bowl_mode):
+    if nofuse_mode and (io_mode or lm_mode or bowl_mode):
         # bowl too: its net has no sibling 1x1 convs, so an A/B there
         # would print two identical numbers — refuse instead
         raise SystemExit(
-            "--fuse only applies to the googlenet/resnet/vgg/alexnet modes"
+            "--nofuse only applies to the googlenet/resnet/vgg/alexnet modes"
         )
     if io_mode:
         bench_io(batch, min(scan_k, 10))
@@ -348,14 +350,14 @@ def main() -> None:
                  scan_k=min(scan_k, 20))
         return
     if resnet_mode:
-        bench_resnet(batch, min(scan_k, 30), fuse=fuse_mode)
+        bench_resnet(batch, min(scan_k, 30), fuse=not nofuse_mode)
         return
     if vgg_mode:
-        bench_vgg(batch, min(scan_k, 20), fuse=fuse_mode)
+        bench_vgg(batch, min(scan_k, 20), fuse=not nofuse_mode)
         return
     if alexnet_mode:
         bench_alexnet(batch=batch if batch_given else 256,
-                      scan_k=min(scan_k, 30), fuse=fuse_mode)
+                      scan_k=min(scan_k, 30), fuse=not nofuse_mode)
         return
     if bowl_mode:
         bench_bowl(batch=batch if batch_given else 64,
@@ -367,9 +369,9 @@ def main() -> None:
     t_build = time.perf_counter()
     tr = _build_googlenet(batch_size=batch, input_size=224, dev="tpu")
     tr.eval_train = 0  # pure step time; no per-step metric fetch
-    if fuse_mode:
-        # sibling 1x1 fusion (net.py _sibling_1x1_groups) A/B mode
-        tr.net.fuse_1x1 = 1
+    if nofuse_mode:
+        # sibling 1x1 fusion is default-on; --nofuse is the A/B control
+        tr.net.fuse_1x1 = 0
 
     rng = np.random.RandomState(0)
     data = jax.device_put(rng.randn(batch, 224, 224, 3).astype(np.float32))
