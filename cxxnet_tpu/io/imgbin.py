@@ -242,6 +242,8 @@ class ImageBinIterator(InstIterator):
     def __init__(self) -> None:
         self.image_bin: List[str] = []
         self.image_list: List[str] = []
+        self.image_conf_prefix = ""  # printf shard pattern, e.g. tr_%03d
+        self.image_conf_ids = ""  # inclusive id range "lb-ub"
         self.silent = 0
         self.shuffle_shards = 0
         self.dist_num_worker = 1
@@ -268,6 +270,10 @@ class ImageBinIterator(InstIterator):
             self.image_bin.append(val)
         elif name in ("image_list", "image_list_x"):
             self.image_list.append(val)
+        elif name == "image_conf_prefix":
+            self.image_conf_prefix = val
+        elif name == "image_conf_ids":
+            self.image_conf_ids = val
         elif name == "silent":
             self.silent = int(val)
         elif name == "shuffle_bin":
@@ -284,10 +290,47 @@ class ImageBinIterator(InstIterator):
             self.decode_thread = int(val)
 
     def init(self):
-        # PS_RANK env parity (iter_thread_imbin_x-inl.hpp:110-113)
-        if self.dist_num_worker == 1 and os.environ.get("PS_RANK"):
+        # PS_RANK env parity: the reference applies it UNCONDITIONALLY
+        # (iter_thread_imbin-inl.hpp:190-194), so a hadoop-style launch
+        # where the conf carries dist_num_worker and only the env knows
+        # the rank still shards correctly
+        if os.environ.get("PS_RANK"):
             self.dist_worker_rank = int(os.environ["PS_RANK"])
-            self.dist_num_worker = int(os.environ.get("PS_NUM_WORKER", "1") or 1)
+            if self.dist_num_worker == 1:
+                self.dist_num_worker = int(
+                    os.environ.get("PS_NUM_WORKER", "1") or 1
+                )
+        conf_mode = bool(self.image_conf_prefix)
+        if conf_mode:
+            # shard-list shorthand: a printf pattern plus an inclusive id
+            # range expands to <prefix%i>.lst/.bin pairs, and workers take
+            # CONTIGUOUS id blocks (iter_thread_imbin-inl.hpp:189-220)
+            if self.image_bin or self.image_list:
+                raise ValueError(
+                    "imgbin: set either image_conf_prefix or "
+                    "image_bin/image_list, not both"
+                )
+            import re as _re
+
+            m = _re.fullmatch(r"\s*(\d+)-(\d+)\s*", self.image_conf_ids)
+            if not m:
+                raise ValueError(
+                    "imgbin: image_conf_ids only supports a range like 1-100"
+                )
+            lb, ub = int(m.group(1)), int(m.group(2))
+            if ub < lb:
+                raise ValueError("imgbin: image_conf_ids range is empty")
+            try:
+                names = [self.image_conf_prefix % i for i in range(lb, ub + 1)]
+                if names[0] == self.image_conf_prefix:
+                    raise ValueError("pattern formats nothing")
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    "imgbin: image_conf_prefix must contain one %d-style "
+                    f"pattern (got {self.image_conf_prefix!r}): {e}"
+                ) from e
+            self.image_bin = [n + ".bin" for n in names]
+            self.image_list = [n + ".lst" for n in names]
         if len(self.image_bin) != len(self.image_list):
             raise ValueError("imgbin: need matching image_bin / image_list counts")
         if not self.image_bin:
@@ -302,10 +345,24 @@ class ImageBinIterator(InstIterator):
                     "repack with tools/imgbin_partition_maker.py "
                     "(>= one shard per worker)"
                 )
+            if conf_mode:
+                # ceil-step contiguous blocks; a tail worker may come up
+                # empty even when len(shards) >= num_worker (e.g. 4 ids
+                # over 3 workers -> blocks of 2,2,0)
+                step = -(-len(shards) // self.dist_num_worker)
+                owner = lambda i: i // step  # noqa: E731
+                if (self.dist_num_worker - 1) * step >= len(shards):
+                    raise ValueError(
+                        "imgbin: too many workers — the image_conf_ids "
+                        "range cannot be divided into non-empty "
+                        "contiguous blocks"
+                    )
+            else:
+                owner = lambda i: i % self.dist_num_worker  # noqa: E731
             mine = [
                 s
                 for i, s in enumerate(shards)
-                if i % self.dist_num_worker == self.dist_worker_rank
+                if owner(i) == self.dist_worker_rank
             ]
             # equal-steps contract (io/data.shard_rows): every process
             # must run the same batch count per round or the SPMD train
@@ -314,7 +371,7 @@ class ImageBinIterator(InstIterator):
             # at the global minimum.
             per_worker = [0] * self.dist_num_worker
             for i, (_, lst) in enumerate(shards):
-                per_worker[i % self.dist_num_worker] += _count_lst_rows(lst)
+                per_worker[owner(i)] += _count_lst_rows(lst)
             self._epoch_cap = min(per_worker)
             if self._epoch_cap == 0:
                 # 0 would read as "no cap" in next() and revive the

@@ -547,3 +547,119 @@ def test_imgbin_epoch_cap_equalizes_steps(tmp_path):
     assert counts == [1, 1]
 
 
+
+# ------------------------------------------------ image_conf shorthand
+def _write_conf_shards(tmp_path, ids, rows_per_shard=2):
+    """<prefix%i>.bin/.lst shard fixtures with per-shard labels = id."""
+    import io as _pyio
+
+    from PIL import Image
+
+    from cxxnet_tpu.io.imgbin import BinPageWriter
+
+    def jpeg():
+        buf = _pyio.BytesIO()
+        Image.new("RGB", (4, 4)).save(buf, "JPEG")
+        return buf.getvalue()
+
+    prefix = str(tmp_path / "part_%02d")
+    for i in ids:
+        w = BinPageWriter((prefix % i) + ".bin")
+        lines = []
+        for r in range(rows_per_shard):
+            w.push(jpeg())
+            lines.append(f"{i * 100 + r}\t{i}\tp{i}_{r}.jpg")
+        w.close()
+        with open((prefix % i) + ".lst", "w") as f:
+            f.write("\n".join(lines) + "\n")
+    return prefix
+
+
+def _conf_iter(prefix, ids, rank=0, nworker=1):
+    from cxxnet_tpu.io.imgbin import ImageBinIterator
+
+    it = ImageBinIterator()
+    it.set_param("native_decoder", "0")
+    it.set_param("image_conf_prefix", prefix)
+    it.set_param("image_conf_ids", ids)
+    if nworker > 1:
+        it.set_param("dist_num_worker", str(nworker))
+        it.set_param("dist_worker_rank", str(rank))
+    return it
+
+
+def test_image_conf_prefix_expands_range(tmp_path):
+    """image_conf_prefix/ids is shard-list shorthand: tr_%02d + 1-3 reads
+    part_01..part_03 (iter_thread_imbin-inl.hpp:189-220 parity)."""
+    prefix = _write_conf_shards(tmp_path, [1, 2, 3])
+    it = _conf_iter(prefix, "1-3")
+    it.init()
+    labels = []
+    while it.next():
+        labels.append(int(it.value().label[0]))
+    assert labels == [1, 1, 2, 2, 3, 3]  # all shards, id order
+
+
+def test_image_conf_dist_contiguous_blocks(tmp_path):
+    """Workers take CONTIGUOUS id blocks (ceil split), not round-robin:
+    4 ids over 2 workers -> {1,2} and {3,4}."""
+    prefix = _write_conf_shards(tmp_path, [1, 2, 3, 4])
+    per_rank = []
+    for rank in range(2):
+        it = _conf_iter(prefix, "1-4", rank=rank, nworker=2)
+        it.init()
+        seen = set()
+        while it.next():
+            seen.add(int(it.value().label[0]))
+        per_rank.append(seen)
+    assert per_rank == [{1, 2}, {3, 4}]
+
+
+def test_image_conf_too_many_workers(tmp_path):
+    """4 ids over 3 workers: ceil blocks are 2,2,0 — the empty tail
+    worker is an error (reference raises the same)."""
+    import pytest
+
+    prefix = _write_conf_shards(tmp_path, [1, 2, 3, 4])
+    it = _conf_iter(prefix, "1-4", rank=2, nworker=3)
+    with pytest.raises(ValueError, match="too many workers"):
+        it.init()
+
+
+def test_image_conf_exclusive_with_explicit_lists(tmp_path):
+    import pytest
+
+    prefix = _write_conf_shards(tmp_path, [1])
+    it = _conf_iter(prefix, "1-1")
+    it.set_param("image_bin", (prefix % 1) + ".bin")
+    it.set_param("image_list", (prefix % 1) + ".lst")
+    with pytest.raises(ValueError, match="not both"):
+        it.init()
+
+
+def test_image_conf_bad_prefix_is_labeled_error(tmp_path):
+    import pytest
+
+    it = _conf_iter(str(tmp_path / "no_pattern_"), "1-2")
+    with pytest.raises(ValueError, match="image_conf_prefix"):
+        it.init()
+
+
+def test_ps_rank_env_overrides_rank_with_conf_workers(tmp_path, monkeypatch):
+    """Hadoop-style launch parity: conf sets dist_num_worker, only the
+    PS_RANK env carries the per-process rank — rank must apply
+    (iter_thread_imbin-inl.hpp:190-194 applies it unconditionally)."""
+    prefix = _write_conf_shards(tmp_path, [1, 2, 3, 4])
+    from cxxnet_tpu.io.imgbin import ImageBinIterator
+
+    monkeypatch.setenv("PS_RANK", "1")
+    it = ImageBinIterator()
+    it.set_param("native_decoder", "0")
+    it.set_param("image_conf_prefix", prefix)
+    it.set_param("image_conf_ids", "1-4")
+    it.set_param("dist_num_worker", "2")  # conf knows W, env knows rank
+    it.init()
+    seen = set()
+    while it.next():
+        seen.add(int(it.value().label[0]))
+    assert seen == {3, 4}  # second contiguous block

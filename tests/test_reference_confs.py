@@ -88,3 +88,49 @@ def test_repo_example_conf_builds_net(rel, nclass):
     tr.init_model()
     out = tr.net.node_shapes[tr.net.out_node_index()]
     assert out[-1] == nclass, f"{rel}: output {out}"
+
+
+def test_reference_only_keys_accepted():
+    """The reference's GPU/PS-specific knobs (cuDNN `algo`, mshadow
+    layout `force_contiguous`, async-PS `bigarray_bound` /
+    `init_on_worker` / `pull_at_backprop` / `test_on_server`, vestigial
+    `net_type` / `reset_net_type` — cxxnet_main.cpp:85-86, CreateNet_
+    always returns the one trainer) parse and train without error: on
+    TPU they are no-ops by design (XLA autotunes convs; SPMD replaces
+    the parameter server)."""
+    import numpy as np
+
+    from cxxnet_tpu.io.data import DataBatch
+
+    conf = """
+netconfig = start
+layer[0->1] = conv:cv
+  nchannel = 4
+  kernel_size = 1
+  algo = 1
+layer[1->2] = flatten:fl
+layer[2->3] = fullc:fc2
+  nhidden = 4
+  force_contiguous = 1
+layer[3->3] = softmax:sm
+netconfig = end
+input_shape = 1,4,4
+batch_size = 8
+dev = cpu
+updater = sgd
+eta = 0.01
+net_type = 0
+reset_net_type = 0
+bigarray_bound = 1000000
+init_on_worker = 1
+pull_at_backprop = 1
+test_on_server = 0
+param_server = local
+"""
+    tr = NetTrainer()
+    tr.set_params(C.parse_pairs(conf))
+    tr.init_model()
+    b = DataBatch(data=np.random.RandomState(0).randn(8, 4, 4, 1)
+                  .astype("float32"),
+                  label=np.zeros((8, 1), "float32"))
+    tr.update(b)
