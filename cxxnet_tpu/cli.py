@@ -36,6 +36,7 @@ class LearnTask:
         self.num_round = 10
         self.max_round = 1 << 30
         self.test_io = 0
+        self.test_on_server = 0
         self.silent = 0
         self.start_counter = 0
         self.continue_training = 0
@@ -81,6 +82,10 @@ class LearnTask:
             self.task = val
         elif name == "test_io":
             self.test_io = int(val)
+        elif name == "test_on_server":
+            # per-round cross-process weight-divergence check
+            # (reference async_updater-inl.hpp:148-153 discipline)
+            self.test_on_server = int(val)
         elif name == "extract_node_name":
             self.extract_node_name = val
         elif name == "output_format":
@@ -438,6 +443,13 @@ class LearnTask:
                     sys.stderr.write(self.net_trainer.evaluate(it, nm))
                 sys.stderr.write("\n")
                 sys.stderr.flush()
+                if self.test_on_server:
+                    dev = self.net_trainer.check_weight_sync()
+                    sys.stderr.write(
+                        f"[{self.start_counter}]\tweight-sync:"
+                        f"max_dev={dev:g} ok\n"
+                    )
+                    sys.stderr.flush()
             self._save_model()
         tracer.close()
         if not self.silent:

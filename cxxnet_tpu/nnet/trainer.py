@@ -636,6 +636,73 @@ class NetTrainer:
         if self.params is not None:
             jax.block_until_ready(self.params)
 
+    def check_weight_sync(self, tol: float = 0.0) -> float:
+        """Cross-process weight-consistency check — the reference's
+        ``test_on_server = 1`` discipline (each worker pulls the server
+        copy and compares to its local weights,
+        ``/root/reference/src/updater/async_updater-inl.hpp:148-153``)
+        re-expressed for SPMD: there is no server copy, so each process
+        fingerprints the locally addressable shard of every replicated
+        parameter (float64 sum + sum of squares per leaf) and the
+        fingerprints are allgathered across the process group.  Replicas
+        that drifted (a bad collective, host memory fault, divergent
+        dispatch order) produce differing rows.  Parameters sharded
+        *across* processes (model parallel / ZeRO-3) are skipped —
+        their per-process shards differ by design and their global
+        consistency is XLA's own invariant.
+
+        Returns the max abs fingerprint deviation across processes
+        (0.0 single-process); raises RuntimeError when it exceeds
+        ``tol``.
+        """
+        assert self.params is not None, "init_model/load_model first"
+        if jax.process_count() == 1 and len(jax.local_devices()) == 1:
+            return 0.0  # nothing to compare; skip the host transfers
+        rows = []
+        for key in sorted(self.params):
+            for tag in sorted(self.params[key]):
+                arr = self.params[key][tag]
+                sh = getattr(arr, "sharding", None)
+                if sh is not None and not sh.is_fully_replicated:
+                    continue
+                shards = getattr(arr, "addressable_shards", None)
+                if not shards:
+                    local = np.asarray(arr, dtype=np.float64)
+                    rows.append([local.sum(), (local * local).sum()])
+                    continue
+                # every LOCAL device holds a full replica: fingerprint
+                # each and require intra-process equality too (a single
+                # corrupted on-device replica must not hide behind its
+                # healthy neighbours)
+                fps = []
+                for s in shards:
+                    local = np.asarray(s.data, dtype=np.float64)
+                    fps.append([local.sum(), (local * local).sum()])
+                intra = float(
+                    np.abs(np.asarray(fps) - np.asarray(fps[0])).max()
+                )
+                if intra > tol:
+                    raise RuntimeError(
+                        f"weight-sync check failed: parameter {key}/{tag} "
+                        f"differs across LOCAL devices by {intra:g} "
+                        f"(tol {tol:g}) — an on-device replica is corrupt"
+                    )
+                rows.append(fps[0])
+        fp = np.asarray(rows, np.float64).reshape(-1)
+        if jax.process_count() == 1:
+            return 0.0
+        from jax.experimental import multihost_utils
+
+        all_fp = np.asarray(multihost_utils.process_allgather(fp))
+        dev = float(np.abs(all_fp - all_fp[0]).max()) if fp.size else 0.0
+        if dev > tol:
+            raise RuntimeError(
+                f"weight-sync check failed: max fingerprint deviation "
+                f"{dev:g} across {jax.process_count()} processes "
+                f"(tol {tol:g}) — replicated weights have diverged"
+            )
+        return dev
+
     def _next_rng(self) -> jax.Array:
         self._rng_key, sub = jax.random.split(self._rng_key)
         return sub

@@ -53,6 +53,21 @@ WORKER = textwrap.dedent(
     assert tr.epoch_counter == 3
     np.save(os.path.join(out_dir, f"w{rank}.npy"),
             np.asarray(tr.params["l0_fc1"]["wmat"]))
+    # test_on_server discipline: replicated weights identical everywhere
+    assert tr.check_weight_sync() == 0.0
+    # ... and the check actually DETECTS divergence: perturb one rank's
+    # local replica and expect the RuntimeError on every process
+    if rank == 1:
+        # (eager math on a cross-process global array is not allowed;
+        # rebuild the leaf as a process-local array instead)
+        w = tr.params["l0_fc1"]["wmat"]
+        tr.params["l0_fc1"]["wmat"] = jax.device_put(
+            np.asarray(w.addressable_shards[0].data) + 1.0)
+    try:
+        tr.check_weight_sync()
+        raise SystemExit("divergence not detected")
+    except RuntimeError:
+        pass
     """
 )
 
@@ -178,10 +193,12 @@ scan_steps = 4
 eta = 0.1
 metric = error
 silent = 1
+test_on_server = 1
 """)
     # scan_steps + eval_train=0: the CLI's ASYNC overlapped chunk path
     # (check_steps=False, double buffer) must not deadlock across
-    # processes and must keep weights replicated
+    # processes and must keep weights replicated; test_on_server makes
+    # the CLI itself assert replication every round
     _run_cli_dist(tmp_path, conf, port)
     m0 = (tmp_path / "p0" / "models" / "0002.model").read_bytes()
     m1 = (tmp_path / "p1" / "models" / "0002.model").read_bytes()

@@ -380,3 +380,11 @@ def test_fuse_1x1_matches_under_mesh(mp):
                 np.asarray(t1.params[key][tag]),
                 rtol=2e-4, atol=2e-5, err_msg=f"{key}/{tag}"
             )
+
+
+def test_check_weight_sync_single_process_multi_device():
+    """check_weight_sync's intra-process path: 8 local replicas of every
+    DP-replicated parameter fingerprint identically (and the call is the
+    same code the CLI's test_on_server=1 runs every round)."""
+    tr = _train(8, steps=2)
+    assert tr.check_weight_sync() == 0.0

@@ -93,11 +93,13 @@ def test_repo_example_conf_builds_net(rel, nclass):
 def test_reference_only_keys_accepted():
     """The reference's GPU/PS-specific knobs (cuDNN `algo`, mshadow
     layout `force_contiguous`, async-PS `bigarray_bound` /
-    `init_on_worker` / `pull_at_backprop` / `test_on_server`, vestigial
-    `net_type` / `reset_net_type` — cxxnet_main.cpp:85-86, CreateNet_
-    always returns the one trainer) parse and train without error: on
-    TPU they are no-ops by design (XLA autotunes convs; SPMD replaces
-    the parameter server)."""
+    `init_on_worker` / `pull_at_backprop`, vestigial `net_type` /
+    `reset_net_type` — cxxnet_main.cpp:85-86, CreateNet_ always returns
+    the one trainer) parse and train without error: on TPU they are
+    no-ops by design (XLA autotunes convs; SPMD replaces the parameter
+    server).  `test_on_server` is NOT a no-op — the CLI implements it
+    as the per-round cross-process weight-sync check
+    (tests/test_distributed.py)."""
     import numpy as np
 
     from cxxnet_tpu.io.data import DataBatch
