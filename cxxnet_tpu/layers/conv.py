@@ -191,6 +191,10 @@ def _maxpool_eq_bwd(kh, kw, s, py, px, res, g):
     )
     hp, wp = xp.shape[1], xp.shape[2]
     zero = jnp.zeros((), g.dtype)
+    if s > 1:
+        dx_p = _unpool_strided(xp, y, g, kh, kw, s, oh, ow)
+        dx_ = dx_p[:, plh : plh + h, plw : plw + w, :]
+        return (dx_.astype(x.dtype),)
     # note: a gather-style s==1 formulation (read y/g at k*k shifts, one
     # pass at input resolution) measured SLOWER on v5e than this
     # pad-and-add form (2044 vs 2128 img/s GoogLeNet b128) — the pads
@@ -213,6 +217,68 @@ def _maxpool_eq_bwd(kh, kw, s, py, px, res, g):
         total = exp if total is None else total + exp
     dx_ = total[:, plh : plh + h, plw : plw + w, :]
     return (dx_.astype(x.dtype),)
+
+
+def _unpool_strided(xp, y, g, kh, kw, s, oh, ow):
+    """The unpool-equality backward for s > 1 as a parity decomposition
+    — scatter-free, one write per input position.
+
+    The s == 1 pad-and-add form above interior-pads every one of the
+    k*k window contributions back onto the FULL padded-input grid (for
+    s=2 each dilated tensor is 3/4 zeros) and adds k*k of them: ~k*k
+    full-resolution HBM writes.  Measured on the ResNet-50 stem pool
+    (k3 s2 on 112x112x64, b128) that single pool's backward cost
+    ~9 ms/step (doc/performance.md bisection).
+
+    Strided pooling makes the transpose cheap instead: input row
+    p = s*m + r (parity r = p mod s) collects contributions only from
+    window elements dy ≡ r (mod s), shifted by t = (dy-r)/s in window
+    index: ``sub_r[m] = sum_t c[r+s*t][m-t]``.  So build the s*s parity
+    subgrids at window resolution (each 1/s² of the input area, at most
+    ceil(k/s)² terms), then interleave them with one reshape.  Total
+    traffic ~ k² window-size reads + one input-size write, vs k²
+    input-size writes.
+    """
+    zero = jnp.zeros((), g.dtype)
+    hp, wp = xp.shape[1], xp.shape[2]
+    ohp = -(-hp // s)  # ceil: parity subgrids must cover every p < hp
+    owp = -(-wp // s)
+    contrib = {
+        off: jnp.where(xw == y, g, zero)
+        for off, xw in _shifted_slices(xp, kh, kw, s, oh, ow)
+    }
+    n, c = g.shape[0], g.shape[3]
+    rows = []
+    for ry in range(s):
+        cols = []
+        for rx in range(s):
+            acc = None
+            for dy in range(ry, kh, s):
+                for dx in range(rx, kw, s):
+                    t, u = (dy - ry) // s, (dx - rx) // s
+                    # c[dy,dx][m-t, n-u] → pad t/u zeros in front, out to
+                    # (ohp, owp) behind (window-resolution tensors: cheap)
+                    term = lax.pad(
+                        contrib[(dy, dx)],
+                        zero,
+                        (
+                            (0, 0, 0),
+                            (t, ohp - oh - t, 0),
+                            (u, owp - ow - u, 0),
+                            (0, 0, 0),
+                        ),
+                    )
+                    acc = term if acc is None else acc + term
+            cols.append(
+                acc
+                if acc is not None
+                else jnp.zeros((n, ohp, owp, c), g.dtype)
+            )
+        rows.append(jnp.stack(cols, axis=3))  # (N, ohp, owp, s, C)
+    big = jnp.stack(rows, axis=2)  # (N, ohp, s, owp, s, C)
+    # interleave: p = s*m + ry, q = s*n + rx
+    big = big.reshape(n, ohp * s, owp * s, c)
+    return big[:, :hp, :wp, :]
 
 
 _maxpool_eq.defvjp(_maxpool_eq_fwd, _maxpool_eq_bwd)

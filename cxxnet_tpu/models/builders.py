@@ -498,6 +498,11 @@ def resnet50_conf(
     extra = (
         "metric = rec@1\nmetric = rec@5\n"
         "wmat:lr = 0.1\nwmat:wd = 0.0001\n"
+        # one-pass E[x^2]-E[x]^2 batch-norm statistics: the 53 BNs read
+        # their activations once instead of twice (stats in f32 either
+        # way); measured 68.3 -> 63.6 ms/step on the v5e b128 step
+        # (doc/performance.md ResNet bisection)
+        "bn_stats = onepass\n"
         f"compute_dtype = {compute_dtype}\n"
     )
     return data + net + _tail(batch_size, shape, 90, eta=0.1, dev=dev,

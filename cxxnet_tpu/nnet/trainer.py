@@ -693,7 +693,15 @@ class NetTrainer:
             return 0.0
         from jax.experimental import multihost_utils
 
-        all_fp = np.asarray(multihost_utils.process_allgather(fp))
+        # gather the f64 fingerprints as uint32 words: process_allgather
+        # round-trips through jax.device_put, which (x64 mode off — the
+        # repo default) would silently truncate float64 to float32 and
+        # let sub-f32-resolution drift pass the tol=0 bit-exactness check
+        words = np.ascontiguousarray(fp).view(np.uint32)
+        all_words = np.asarray(multihost_utils.process_allgather(words))
+        all_fp = all_words.view(np.float64).reshape(
+            jax.process_count(), -1
+        )
         dev = float(np.abs(all_fp - all_fp[0]).max()) if fp.size else 0.0
         if dev > tol:
             raise RuntimeError(

@@ -210,6 +210,44 @@ def test_maxpool_backward_is_reference_unpool(rng, hw, k, s):
                                atol=1e-5)
 
 
+@pytest.mark.parametrize(
+    "hw,k,s,p",
+    [(12, 3, 2, 0), (7, 3, 2, 1), (11, 3, 2, 1), (14, 2, 2, 0),
+     (10, 5, 3, 2), (9, 4, 2, 1)],
+)
+def test_strided_unpool_matches_pad_and_add(rng, hw, k, s, p):
+    """conv._unpool_strided (the s>1 parity-decomposed backward) must be
+    bit-identical to the pad-and-add transpose it replaced — same math,
+    scatter-free assembly (doc/performance.md, round 3)."""
+    from jax import lax
+
+    from cxxnet_tpu.layers import conv as C
+
+    x = jnp.asarray(rng.randn(2, hw, hw + 2, 5).astype(np.float32))
+    y = C._maxpool_eq(x, k, k, s, p, p)
+    g = jnp.asarray(rng.randn(*y.shape).astype(np.float32))
+    (got,) = C._maxpool_eq_bwd(k, k, s, p, p, (x, y), g)
+
+    xp, ((plh, _), (plw, _), oh, ow) = C._pad_for_pool(
+        x, k, k, s, p, p, -jnp.inf
+    )
+    hp, wp = xp.shape[1], xp.shape[2]
+    zero = jnp.zeros((), g.dtype)
+    total = None
+    for (dy, dx), xw in C._shifted_slices(xp, k, k, s, oh, ow):
+        contrib = jnp.where(xw == y, g, zero)
+        exp = lax.pad(
+            contrib, zero,
+            ((0, 0, 0),
+             (dy, hp - (dy + (oh - 1) * s + 1), s - 1),
+             (dx, wp - (dx + (ow - 1) * s + 1), s - 1),
+             (0, 0, 0)),
+        )
+        total = exp if total is None else total + exp
+    want = total[:, plh : plh + x.shape[1], plw : plw + x.shape[2], :]
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_insanity_pooling_eval_is_maxpool(rng):
     x = rng.randn(2, 6, 6, 2).astype(np.float32)
     lay = mk("insanity_max_pooling", [("kernel_size", "2"), ("stride", "2"), ("keep", "0.7")])

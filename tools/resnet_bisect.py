@@ -25,6 +25,10 @@ def variant_conf(name: str, batch: int) -> str:
 
     conf = resnet50_conf(batch_size=batch, input_size=224, synthetic=False,
                          dev="tpu")
+    # resnet50_conf now emits a global `bn_stats = onepass` (the measured
+    # default); the bisect's base/onepass A/B isolates the statistics
+    # form, so "base" must restore the twopass control
+    conf = conf.replace("bn_stats = onepass\n", "bn_stats = twopass\n")
     if name == "base":
         return conf
     if name == "onepass":
@@ -32,8 +36,9 @@ def variant_conf(name: str, batch: int) -> str:
         return re.sub(r"(= batch_norm:\w+\n)", r"\1  bn_stats = onepass\n",
                       conf)
     if name == "nobn":
-        # batch_norm -> bias: isolates what all 53 BNs cost
-        return re.sub(r"= batch_norm:(\w+)\n", r"= bias:\1\n", conf)
+        # batch_norm -> relu (fuses into the conv epilogue, ~free):
+        # isolates what all 53 BNs cost
+        return re.sub(r"= batch_norm:\w+\n", "= relu\n", conf)
     if name == "noavg":
         # global avg pool -> stride-7 max slice (cheap): isolates tail
         return conf.replace(
