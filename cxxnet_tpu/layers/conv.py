@@ -622,8 +622,10 @@ class LRNLayer(Layer):
         elif name == "knorm":
             self.knorm = float(val)
         elif name == "lrn_impl":
-            if val not in ("auto", "pallas", "xla"):
-                raise ValueError(f"lrn_impl must be auto|pallas|xla, got {val!r}")
+            if val not in ("auto", "pallas", "xla", "matmul"):
+                raise ValueError(
+                    f"lrn_impl must be auto|pallas|xla|matmul, got {val!r}"
+                )
             self.impl = val
         else:
             super().set_param(name, val)
@@ -656,12 +658,14 @@ class LRNLayer(Layer):
         return [tuple(in_shapes[0])]
 
     def apply(self, params, inputs, *, train=False, rng=None, step=None):
-        from ..ops.lrn import lrn, lrn_xla
+        from ..ops.lrn import lrn, lrn_matmul, lrn_xla
 
         x = inputs[0]
         if self._use_pallas(x.shape[-1], x.dtype):
             interp = jax.default_backend() != "tpu"  # forced-on off-TPU
             y = lrn(x, self.nsize, self.alpha, self.beta, self.knorm, interp)
+        elif self.impl == "matmul":
+            y = lrn_matmul(x, self.nsize, self.alpha, self.beta, self.knorm)
         else:
             y = lrn_xla(x, self.nsize, self.alpha, self.beta, self.knorm)
         return [y]

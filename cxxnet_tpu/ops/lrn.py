@@ -144,6 +144,33 @@ def _lrn_bwd(nsize, alpha, beta, knorm, interpret, x, g):
 lrn.defvjp(_lrn_fwd, _lrn_bwd)
 
 
+def lrn_matmul(x, nsize: int = 3, alpha: float = 0.001, beta: float = 0.75,
+               knorm: float = 1.0):
+    """LRN whose channel-window sum is a banded C×C matmul — MXU work.
+
+    The window sum ``win[c] = sum_{c-half <= j < c-half+nsize} x²[j]``
+    is ``x² @ B`` with ``B[j, c] = 1`` on the band (same clipped-edge
+    semantics as ``lrn_xla``'s reduce_window padding).  Flattened to
+    ``(N·H·W, C) @ (C, C)`` this is exactly MXU-shaped, and autodiff's
+    backward is another banded GEMM (``@ Bᵀ``) — no reduce_window, no
+    shifted-add chain on the VPU.  f32 accumulation in the GEMM (one
+    rounding) vs the shifted-add chain's per-add rounding: same-or-better
+    numerics.
+    """
+    c = x.shape[-1]
+    half = nsize // 2
+    j = jnp.arange(c)
+    # band rows j, cols c: win[c] sums j in [c - half, c + nsize-1-half]
+    d = j[:, None] - j[None, :]
+    band = ((d >= -half) & (d <= nsize - 1 - half)).astype(x.dtype)
+    sq = x * x
+    win = jnp.matmul(
+        sq.reshape(-1, c), band, preferred_element_type=jnp.float32
+    ).astype(x.dtype).reshape(x.shape)
+    norm = knorm + (alpha / nsize) * win
+    return x * norm ** (-beta)
+
+
 def lrn_xla(x, nsize: int = 3, alpha: float = 0.001, beta: float = 0.75,
             knorm: float = 1.0):
     """Stock-XLA reference implementation (reduce_window over channels).

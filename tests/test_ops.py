@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from cxxnet_tpu.ops.lrn import lrn, lrn_xla
+from cxxnet_tpu.ops.lrn import lrn, lrn_matmul, lrn_xla
 
 
 @pytest.mark.parametrize("shape", [(2, 5, 5, 64), (16, 192), (2, 7, 7, 96)])
@@ -37,6 +37,34 @@ def test_lrn_pallas_matches_xla_grad(rng, nsize):
     g2 = jax.grad(loss_xla)(x)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("c,nsize", [(8, 3), (8, 4), (16, 5), (16, 2)])
+def test_lrn_matmul_band_exact(rng, c, nsize):
+    """The banded-matmul window (lrn_matmul) must select EXACTLY the
+    reduce_window channels, including even-nsize asymmetric windows and
+    clipped edges: integer-valued x with beta=1, knorm=0, alpha=n makes
+    any band mistake an integer-sized discrepancy."""
+    x = jnp.asarray(rng.randint(1, 5, (2, 3, 3, c)).astype(np.float32))
+    a = lrn_xla(x, nsize, float(nsize), 1.0, 0.0)
+    b = lrn_matmul(x, nsize, float(nsize), 1.0, 0.0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("shape", [(2, 5, 5, 64), (16, 192)])
+@pytest.mark.parametrize("nsize", [3, 5])
+def test_lrn_matmul_matches_xla(rng, shape, nsize):
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    got = lrn_matmul(x, nsize, 0.001, 0.75, 1.0)
+    want = lrn_xla(x, nsize, 0.001, 0.75, 1.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    g1 = jax.grad(lambda v: jnp.sum(lrn_matmul(v, nsize, 0.001, 0.75,
+                                               1.0) ** 2))(x)
+    g2 = jax.grad(lambda v: jnp.sum(lrn_xla(v, nsize, 0.001, 0.75,
+                                            1.0) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=2e-4, atol=2e-4)
 
 
 def test_lrn_pallas_bf16(rng):
