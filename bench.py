@@ -217,6 +217,54 @@ def bench_lm(batch: int, seq_len: int, scan_k: int) -> None:
     )
 
 
+def bench_flash(seq_lens) -> None:
+    """``--flash`` mode: the flash-attention kernel vs the XLA mha path,
+    fwd+bwd, causal, b4 h8 d64 bf16 (the doc/performance.md fixture) —
+    codifies the round-2 ad-hoc numbers as a reproducible sweep.  The
+    XLA path is skipped where its (B,H,T,T) score matrix cannot compile
+    (T >= 8192 on a 16 GB v5e)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cxxnet_tpu.ops.attention import mha
+    from cxxnet_tpu.ops.flash import flash_mha
+
+    b, h, d = 4, 8, 64
+    rng = np.random.RandomState(0)
+    for t in seq_lens:
+        # (B, T, H, Dh) — the layout flash_mha and attention.mha share
+        qkv = [
+            jax.device_put(rng.randn(b, t, h, d).astype(np.float32)
+                           .astype(jnp.bfloat16))
+            for _ in range(3)
+        ]
+        flops = 2 * 2 * b * h * t * t * d * 3.5 / 2  # causal fwd+bwd approx
+
+        def timed(fn, tag):
+            def loss(q, k, v):
+                return (fn(q, k, v).astype(jnp.float32) ** 2).sum()
+
+            g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            try:
+                out = g(*qkv)
+                jax.block_until_ready(out)
+            except Exception as e:  # noqa: BLE001 - report, keep sweeping
+                print(f"# bench[flash]: T={t} {tag}: FAILS "
+                      f"({type(e).__name__})", file=sys.stderr, flush=True)
+                return
+            t0 = time.perf_counter()
+            for _ in range(10):
+                out = g(*qkv)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / 10
+            print(f"# bench[flash]: T={t} {tag}: {dt*1e3:.2f} ms "
+                  f"fwd+bwd = {flops/dt/1e12:.1f} TFLOP/s",
+                  file=sys.stderr, flush=True)
+
+        timed(lambda q, k, v: flash_mha(q, k, v, causal=True), "flash")
+        timed(lambda q, k, v: mha(q, k, v, causal=True), "xla")
+
+
 def _bench_imagenet_conf(tag: str, desc: str, conf: str, batch: int,
                          scan_k: int, input_size: int = 224,
                          num_class: int = 1000,
@@ -322,13 +370,14 @@ def main() -> None:
     args = [a for a in sys.argv[1:] if a not in ("--io", "--lm",
                                                  "--resnet", "--vgg",
                                                  "--alexnet", "--bowl",
-                                                 "--nofuse")]
+                                                 "--flash", "--nofuse")]
     io_mode = "--io" in sys.argv[1:]
     lm_mode = "--lm" in sys.argv[1:]
     resnet_mode = "--resnet" in sys.argv[1:]
     vgg_mode = "--vgg" in sys.argv[1:]
     alexnet_mode = "--alexnet" in sys.argv[1:]
     bowl_mode = "--bowl" in sys.argv[1:]
+    flash_mode = "--flash" in sys.argv[1:]
     if "--fuse" in sys.argv[1:]:
         raise SystemExit("--fuse is now the default; use --nofuse for the A/B")
     nofuse_mode = "--nofuse" in sys.argv[1:]  # fuse_1x1=0 A/B on image modes
@@ -342,6 +391,10 @@ def main() -> None:
         raise SystemExit(
             "--nofuse only applies to the googlenet/resnet/vgg/alexnet modes"
         )
+    if flash_mode:
+        # positional args are the T sweep (default: the doc fixture Ts)
+        bench_flash([int(a) for a in args] or [2048, 4096, 8192, 16384])
+        return
     if io_mode:
         bench_io(batch, min(scan_k, 10))
         return

@@ -59,9 +59,60 @@ def _pool_pad(in_size: int, k: int, s: int, p: int = 0) -> Tuple[int, int]:
     return p, max(0, (out - 1) * s + k - in_size - p)
 
 
+def _conv_s2d(x, w, py: int, px: int):
+    """Stride-2 conv as space-to-depth + stride-1 conv — mathematically
+    exact (MLPerf-style stem-conv rewrite).
+
+    A stride-2 conv on a low-channel high-resolution input (the 7x7 s2
+    stem of GoogLeNet/ResNet: C_in=3, 224px) im2cols to a GEMM with
+    K = k·k·3 rows read at stride 2 — poor MXU feeding.  Decomposing
+    tap index dy = 2t + a turns it into a stride-1 conv on the 2x2
+    space-to-depth input (half resolution, 4C channels) with the kernel
+    taps regrouped the same way (odd k zero-pads one tap row/col):
+
+        y[oy] = Σ_dy x̃[2·oy+dy]·W[dy] = Σ_{t,a} xs_a[oy+t]·W[2t+a]
+
+    Weights stay (kh, kw, C, O) — checkpoints, updaters, and visitors
+    untouched; the regroup is a reshape/transpose autodiff reverses
+    exactly.  Requires (H+2p) and (W+2p) even.
+    """
+    kh, kw = w.shape[0], w.shape[1]
+    xp = jnp.pad(x, ((0, 0), (py, py), (px, px), (0, 0)))
+    n, hp, wp, c = xp.shape
+    xs = (
+        xp.reshape(n, hp // 2, 2, wp // 2, 2, c)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(n, hp // 2, wp // 2, 4 * c)
+    )
+    k2h, k2w = (kh + 1) // 2, (kw + 1) // 2
+    wpad = jnp.pad(w, ((0, kh % 2), (0, kw % 2), (0, 0), (0, 0)))
+    ws = (
+        wpad.reshape(k2h, 2, k2w, 2, c, w.shape[3])
+        .transpose(0, 2, 1, 3, 4, 5)
+        .reshape(k2h, k2w, 4 * c, w.shape[3])
+    )
+    return lax.conv_general_dilated(
+        xs,
+        ws,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
 @register
 class ConvolutionLayer(Layer):
     type_name = "conv"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.conv_s2d = 0  # opt-in stride-2 space-to-depth rewrite
+
+    def set_param(self, name, val):
+        if name == "conv_s2d":
+            self.conv_s2d = int(val)
+        else:
+            super().set_param(name, val)
 
     def infer_shape(self, in_shapes: Sequence[Shape]) -> List[Shape]:
         self._check_arity(in_shapes, 1)
@@ -101,14 +152,24 @@ class ConvolutionLayer(Layer):
     def apply(self, params, inputs, *, train=False, rng=None, step=None):
         p = self.param
         x = inputs[0]
-        y = lax.conv_general_dilated(
-            x,
-            params["wmat"].astype(x.dtype),
-            window_strides=(p.stride, p.stride),
-            padding=((p.pad_y, p.pad_y), (p.pad_x, p.pad_x)),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=p.num_group,
-        )
+        if (
+            self.conv_s2d
+            and p.stride == 2
+            and p.num_group == 1
+            and (x.shape[1] + 2 * p.pad_y) % 2 == 0
+            and (x.shape[2] + 2 * p.pad_x) % 2 == 0
+        ):
+            y = _conv_s2d(x, params["wmat"].astype(x.dtype), p.pad_y,
+                          p.pad_x)
+        else:
+            y = lax.conv_general_dilated(
+                x,
+                params["wmat"].astype(x.dtype),
+                window_strides=(p.stride, p.stride),
+                padding=((p.pad_y, p.pad_y), (p.pad_x, p.pad_x)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=p.num_group,
+            )
         if "bias" in params:
             y = y + params["bias"].astype(x.dtype)
         return [y]

@@ -211,6 +211,38 @@ def test_maxpool_backward_is_reference_unpool(rng, hw, k, s):
 
 
 @pytest.mark.parametrize(
+    "hw,k,p,cin",
+    [(16, 7, 3, 3), (14, 3, 1, 4), (12, 2, 0, 3), (18, 4, 1, 2),
+     (13, 3, 2, 3)],
+)
+def test_conv_s2d_matches_plain_stride2(rng, hw, k, p, cin):
+    """conv_s2d=1 (space-to-depth stride-2 rewrite) must match the plain
+    stride-2 conv — outputs and weight/input gradients."""
+    x = rng.randn(2, hw, hw + 2, cin).astype(np.float32)
+    base = mk("conv", [("kernel_size", str(k)), ("stride", "2"),
+                       ("pad", str(p)), ("nchannel", "8")])
+    s2d = mk("conv", [("kernel_size", str(k)), ("stride", "2"),
+                      ("pad", str(p)), ("nchannel", "8"),
+                      ("conv_s2d", "1")])
+    params = base.init_params(jax.random.PRNGKey(0), [x.shape])
+    ya = base.apply(params, [jnp.asarray(x)])[0]
+    yb = s2d.apply(params, [jnp.asarray(x)])[0]
+    assert ya.shape == yb.shape
+    np.testing.assert_allclose(np.asarray(ya), np.asarray(yb),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss(lay, pr, v):
+        return (lay.apply(pr, [v])[0] ** 2).sum()
+
+    ga = jax.grad(loss, argnums=(1, 2))(base, params, jnp.asarray(x))
+    gb = jax.grad(loss, argnums=(1, 2))(s2d, params, jnp.asarray(x))
+    for a, b in zip(jax.tree_util.tree_leaves(ga),
+                    jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize(
     "hw,k,s,p",
     [(12, 3, 2, 0), (7, 3, 2, 1), (11, 3, 2, 1), (14, 2, 2, 0),
      (10, 5, 3, 2), (9, 4, 2, 1)],

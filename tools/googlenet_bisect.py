@@ -1,0 +1,94 @@
+"""GoogLeNet step-time bisection (the doc/performance.md discipline,
+tools/resnet_bisect.py analog) — isolates where the post-strided-unpool
+42 ms/step goes.
+
+Run on the TPU host:
+
+    python tools/googlenet_bisect.py [variant ...]
+
+Variants (default: all):
+
+* base      — the bench conf as-is (lrn=xla)
+* lrnmm     — ``lrn_impl = matmul`` on both LRN layers (banded-GEMM
+              window sum, ops/lrn.lrn_matmul): the A/B for flipping the
+              conf default
+* nolrn     — both LRN layers -> relu (~free): the LRN ceiling
+* stem1x1   — the 7x7 s2 stem conv -> 1x1 s2 (pad 0; same 112x112x64
+              output shape): what conv1 costs
+* conv1x1   — EVERY odd-k padded conv -> 1x1 pad 0 (shape-preserving):
+              the all-conv ceiling, leaving pools/LRN/fc
+"""
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+)
+
+
+def _conv_to_1x1(conf: str, only_stem: bool = False) -> str:
+    """Rewrite ``kernel_size = k / pad = (k-1)/2`` conv bodies to 1x1
+    pad 0 (output shapes preserved; stride untouched)."""
+    out = []
+    blocks = conf.split("layer[")
+    for i, blk in enumerate(blocks):
+        if i and re.match(r"[^\]]*\] = conv:", blk):
+            is_stem = "conv:conv1\n" in blk
+            if (not only_stem) or is_stem:
+                blk = re.sub(r"kernel_size = \d+", "kernel_size = 1", blk,
+                             count=1)
+                blk = re.sub(r"pad = \d+", "pad = 0", blk, count=1)
+        out.append(blk)
+    return "layer[".join(out)
+
+
+def variant_conf(name: str, batch: int) -> str:
+    from cxxnet_tpu.models import googlenet_conf
+
+    conf = googlenet_conf(batch_size=batch, input_size=224, synthetic=False,
+                          dev="tpu")
+    if name == "base":
+        return conf
+    if name == "lrnmm":
+        return conf + "lrn_impl = matmul\n"
+    if name == "nolrn":
+        return re.sub(
+            r"= lrn\n(  local_size[^\n]*\n  alpha[^\n]*\n  beta[^\n]*\n"
+            r"  knorm[^\n]*\n)",
+            "= relu\n",
+            conf,
+        )
+    if name == "stem1x1":
+        return _conv_to_1x1(conf, only_stem=True)
+    if name == "conv1x1":
+        return _conv_to_1x1(conf)
+    raise SystemExit(f"unknown variant {name}")
+
+
+def time_variant(name: str, batch: int = 128, scan_k: int = 50) -> float:
+    from bench import _bench_imagenet_conf
+
+    return _bench_imagenet_conf(
+        f"bisect:{name}", name, variant_conf(name, batch), batch, scan_k
+    )
+
+
+def main() -> None:
+    import jax
+
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+    names = sys.argv[1:] or ["base", "lrnmm", "nolrn", "stem1x1", "conv1x1"]
+    for name in names:
+        time_variant(name)
+
+
+if __name__ == "__main__":
+    main()
