@@ -59,45 +59,55 @@ def _pool_pad(in_size: int, k: int, s: int, p: int = 0) -> Tuple[int, int]:
     return p, max(0, (out - 1) * s + k - in_size - p)
 
 
-def _conv_s2d(x, w, py: int, px: int):
-    """Stride-2 conv as space-to-depth + stride-1 conv — mathematically
-    exact (MLPerf-style stem-conv rewrite).
+def _conv_s2d(x, w, s: int, py: int, px: int):
+    """Strided conv as space-to-depth + stride-1 conv — mathematically
+    exact (MLPerf-style stem-conv rewrite, generalized to any stride).
 
-    A stride-2 conv on a low-channel high-resolution input (the 7x7 s2
-    stem of GoogLeNet/ResNet: C_in=3, 224px) im2cols to a GEMM with
-    K = k·k·3 rows read at stride 2 — poor MXU feeding.  Decomposing
-    tap index dy = 2t + a turns it into a stride-1 conv on the 2x2
-    space-to-depth input (half resolution, 4C channels) with the kernel
-    taps regrouped the same way (odd k zero-pads one tap row/col):
+    A strided conv on a low-channel high-resolution input (GoogLeNet/
+    ResNet 7x7 s2, AlexNet 11x11 s4 stems: C_in=3, 224px+) im2cols to a
+    GEMM whose K = k·k·3 rows are read at stride s — poor MXU feeding.
+    Decomposing tap index dy = s·t + a turns it into a stride-1 conv on
+    the s×s space-to-depth input (1/s resolution, s²C channels) with
+    the kernel taps regrouped the same way (k not divisible by s
+    zero-pads the tail tap rows/cols; input extents not divisible by s
+    zero-pad on the right and the junk tail outputs are sliced off):
 
-        y[oy] = Σ_dy x̃[2·oy+dy]·W[dy] = Σ_{t,a} xs_a[oy+t]·W[2t+a]
+        y[oy] = Σ_dy x̃[s·oy+dy]·W[dy] = Σ_{t,a} xs_a[oy+t]·W[s·t+a]
 
     Weights stay (kh, kw, C, O) — checkpoints, updaters, and visitors
     untouched; the regroup is a reshape/transpose autodiff reverses
-    exactly.  Requires (H+2p) and (W+2p) even.
+    exactly.
     """
-    kh, kw = w.shape[0], w.shape[1]
-    xp = jnp.pad(x, ((0, 0), (py, py), (px, px), (0, 0)))
-    n, hp, wp, c = xp.shape
+    kh, kw, c, o = w.shape
+    n, h, wd = x.shape[0], x.shape[1], x.shape[2]
+    oh = (h + 2 * py - kh) // s + 1
+    ow = (wd + 2 * px - kw) // s + 1
+    hp, wp = h + 2 * py, wd + 2 * px
+    eh, ew = (-hp) % s, (-wp) % s
+    xp = jnp.pad(x, ((0, 0), (py, py + eh), (px, px + ew), (0, 0)))
+    hq, wq = (hp + eh) // s, (wp + ew) // s
     xs = (
-        xp.reshape(n, hp // 2, 2, wp // 2, 2, c)
+        xp.reshape(n, hq, s, wq, s, c)
         .transpose(0, 1, 3, 2, 4, 5)
-        .reshape(n, hp // 2, wp // 2, 4 * c)
+        .reshape(n, hq, wq, s * s * c)
     )
-    k2h, k2w = (kh + 1) // 2, (kw + 1) // 2
-    wpad = jnp.pad(w, ((0, kh % 2), (0, kw % 2), (0, 0), (0, 0)))
+    k2h, k2w = -(-kh // s), -(-kw // s)
+    wpad = jnp.pad(w, ((0, k2h * s - kh), (0, k2w * s - kw), (0, 0),
+                       (0, 0)))
     ws = (
-        wpad.reshape(k2h, 2, k2w, 2, c, w.shape[3])
+        wpad.reshape(k2h, s, k2w, s, c, o)
         .transpose(0, 2, 1, 3, 4, 5)
-        .reshape(k2h, k2w, 4 * c, w.shape[3])
+        .reshape(k2h, k2w, s * s * c, o)
     )
-    return lax.conv_general_dilated(
+    assert hq - k2h + 1 >= oh and wq - k2w + 1 >= ow
+    y = lax.conv_general_dilated(
         xs,
         ws,
         window_strides=(1, 1),
         padding="VALID",
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
+    return y[:, :oh, :ow, :]
 
 
 @register
@@ -106,7 +116,7 @@ class ConvolutionLayer(Layer):
 
     def __init__(self) -> None:
         super().__init__()
-        self.conv_s2d = 0  # opt-in stride-2 space-to-depth rewrite
+        self.conv_s2d = 0  # opt-in space-to-depth rewrite (any stride>1)
 
     def set_param(self, name, val):
         if name == "conv_s2d":
@@ -152,15 +162,9 @@ class ConvolutionLayer(Layer):
     def apply(self, params, inputs, *, train=False, rng=None, step=None):
         p = self.param
         x = inputs[0]
-        if (
-            self.conv_s2d
-            and p.stride == 2
-            and p.num_group == 1
-            and (x.shape[1] + 2 * p.pad_y) % 2 == 0
-            and (x.shape[2] + 2 * p.pad_x) % 2 == 0
-        ):
-            y = _conv_s2d(x, params["wmat"].astype(x.dtype), p.pad_y,
-                          p.pad_x)
+        if self.conv_s2d and p.stride > 1 and p.num_group == 1:
+            y = _conv_s2d(x, params["wmat"].astype(x.dtype), p.stride,
+                          p.pad_y, p.pad_x)
         else:
             y = lax.conv_general_dilated(
                 x,

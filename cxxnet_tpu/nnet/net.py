@@ -25,6 +25,7 @@ Semantics preserved:
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -241,17 +242,22 @@ class FunctionalNet:
             for n in spec.nindex_out:
                 writes[n] += 1
         version = [0] * self.graph.num_nodes
-        by_input: Dict[Tuple[int, int], List[int]] = {}
+        by_input: Dict[Tuple[int, int, int], List[int]] = {}
         for i, spec in enumerate(self.graph.layers):
             is_candidate = False
             if spec.type_name != "shared":  # aliased params: plain path
                 lay = self.layer_objs[i]
                 if type(lay) is ConvolutionLayer:
                     p = lay.param
+                    # any shared stride fuses (the key carries it): the
+                    # reference-shaped nets issue stride-2 1x1 sibling
+                    # pairs too — ResNet's stage-boundary blocks read
+                    # one node with both the bottleneck-reduce and the
+                    # projection-shortcut 1x1 s2 convs
                     is_candidate = (
-                        (p.kernel_height, p.kernel_width, p.stride,
+                        (p.kernel_height, p.kernel_width,
                          p.pad_x, p.pad_y, p.num_group)
-                        == (1, 1, 1, 0, 0, 1)
+                        == (1, 1, 0, 0, 1)
                         and len(spec.nindex_in) == 1
                         and len(spec.nindex_out) == 1
                         and spec.nindex_out[0] != spec.nindex_in[0]
@@ -259,7 +265,7 @@ class FunctionalNet:
                     )
             if is_candidate:
                 n = spec.nindex_in[0]
-                by_input.setdefault((n, version[n]), []).append(i)
+                by_input.setdefault((n, version[n], p.stride), []).append(i)
             for n in spec.nindex_out:  # reads above happen before writes
                 version[n] += 1
         groups: Dict[int, List[int]] = {}
@@ -274,14 +280,14 @@ class FunctionalNet:
         return self._fuse_cache
 
     @staticmethod
-    def _apply_fused_1x1(gparams: List[dict], x):
+    def _apply_fused_1x1(stride: int, gparams: List[dict], x):
         """One conv for the whole sibling group; per-member outputs."""
         from jax import lax
 
         ws = [d["wmat"].astype(x.dtype) for d in gparams]
         y = lax.conv_general_dilated(
             x, jnp.concatenate(ws, axis=3),
-            window_strides=(1, 1), padding=((0, 0), (0, 0)),
+            window_strides=(stride, stride), padding=((0, 0), (0, 0)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
         outs = []
@@ -353,9 +359,15 @@ class FunctionalNet:
                 if x is None:
                     raise ValueError(f"layer {i}: unset input node")
                 gparams = [params.get(self.param_key[j], {}) for j in idxs]
+                # stride bound statically (shared by the whole group via
+                # the fusion key); jax.checkpoint must not trace it
+                fused = functools.partial(
+                    self._apply_fused_1x1,
+                    self.layer_objs[i].param.stride,
+                )
                 run_f = (
-                    jax.checkpoint(self._apply_fused_1x1)
-                    if (self.remat and train) else self._apply_fused_1x1
+                    jax.checkpoint(fused)
+                    if (self.remat and train) else fused
                 )
                 for j, out in zip(idxs, run_f(gparams, x)):
                     nodes[g.layers[j].nindex_out[0]] = out

@@ -211,17 +211,19 @@ def test_maxpool_backward_is_reference_unpool(rng, hw, k, s):
 
 
 @pytest.mark.parametrize(
-    "hw,k,p,cin",
-    [(16, 7, 3, 3), (14, 3, 1, 4), (12, 2, 0, 3), (18, 4, 1, 2),
-     (13, 3, 2, 3)],
+    "hw,k,s,p,cin",
+    [(16, 7, 2, 3, 3), (14, 3, 2, 1, 4), (12, 2, 2, 0, 3),
+     (18, 4, 2, 1, 2), (13, 3, 2, 2, 3), (23, 11, 4, 0, 3),
+     (15, 5, 3, 1, 3), (17, 4, 4, 2, 2)],
 )
-def test_conv_s2d_matches_plain_stride2(rng, hw, k, p, cin):
-    """conv_s2d=1 (space-to-depth stride-2 rewrite) must match the plain
-    stride-2 conv — outputs and weight/input gradients."""
+def test_conv_s2d_matches_plain_strided(rng, hw, k, s, p, cin):
+    """conv_s2d=1 (space-to-depth rewrite of strided convs) must match
+    the plain strided conv — outputs and weight/input gradients — for
+    every stride, including extents not divisible by the stride."""
     x = rng.randn(2, hw, hw + 2, cin).astype(np.float32)
-    base = mk("conv", [("kernel_size", str(k)), ("stride", "2"),
+    base = mk("conv", [("kernel_size", str(k)), ("stride", str(s)),
                        ("pad", str(p)), ("nchannel", "8")])
-    s2d = mk("conv", [("kernel_size", str(k)), ("stride", "2"),
+    s2d = mk("conv", [("kernel_size", str(k)), ("stride", str(s)),
                       ("pad", str(p)), ("nchannel", "8"),
                       ("conv_s2d", "1")])
     params = base.init_params(jax.random.PRNGKey(0), [x.shape])

@@ -729,6 +729,94 @@ def test_fuse_1x1_sibling_convs_parity(remat):
     np.testing.assert_allclose(p0, p1, rtol=1e-5, atol=1e-5)
 
 
+RESNET_BOUNDARY_CFG = """
+netconfig=start
+layer[0->stem] = conv:stem
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+  init_sigma = 0.1
+layer[stem->stem] = relu
+layer[stem->a] = conv:reduce
+  kernel_size = 1
+  stride = 2
+  nchannel = 4
+  init_sigma = 0.1
+layer[a->ar] = relu
+layer[ar->b] = conv:mid
+  kernel_size = 3
+  pad = 1
+  nchannel = 4
+  init_sigma = 0.1
+layer[b->c] = conv:expand
+  kernel_size = 1
+  nchannel = 6
+  init_sigma = 0.1
+layer[stem->p] = conv:proj
+  kernel_size = 1
+  stride = 2
+  nchannel = 6
+  init_sigma = 0.1
+layer[p,c->sum] = eltwise_sum
+layer[sum->sum] = relu
+layer[sum->fl] = flatten
+layer[fl->out] = fullc:fc
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,6,6
+batch_size = 16
+eta = 0.1
+momentum = 0.9
+metric = error
+"""
+
+
+def test_fuse_1x1_strided_sibling_pair_parity():
+    """Stride-2 1x1 siblings reading one node (ResNet's stage-boundary
+    reduce + projection convs) fuse into one strided conv; the stride-1
+    expand conv must NOT join their group (different key).  Training +
+    prediction parity vs the unfused graph."""
+    rng = np.random.RandomState(6)
+    x = rng.randn(32, 6, 6, 3).astype(np.float32)
+    y = rng.randint(0, 4, (32, 1)).astype(np.float32)
+
+    def run(fuse):
+        tr = NetTrainer()
+        tr.set_params(C.parse_pairs(
+            RESNET_BOUNDARY_CFG + f"fuse_1x1 = {fuse}\n"
+        ))
+        tr.set_param("seed", "9")
+        tr.init_model()
+        groups, _ = tr.net._sibling_1x1_groups()
+        if fuse:
+            # exactly one group: the two s2 convs (reduce + proj)
+            assert [len(v) for v in groups.values()] == [2]
+            (idxs,) = groups.values()
+            names = {tr.net.graph.layers[j].name for j in idxs}
+            assert names == {"reduce", "proj"}
+        for _ in range(3):
+            for b in batches(x, y):
+                tr.update(b)
+        preds = np.concatenate([tr.predict(b) for b in batches(x, y)])
+        return preds, jax.tree_util.tree_map(np.asarray, tr.params)
+
+    p0, w0 = run(0)
+    p1, w1 = run(1)
+    for k, (a, b) in {
+        k: (a, b)
+        for (k, a), (_, b) in zip(
+            sorted((jax.tree_util.keystr(kp), leaf)
+                   for kp, leaf in jax.tree_util.tree_leaves_with_path(w0)),
+            sorted((jax.tree_util.keystr(kp), leaf)
+                   for kp, leaf in jax.tree_util.tree_leaves_with_path(w1)),
+        )
+    }.items():
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5, err_msg=k)
+    np.testing.assert_allclose(p0, p1, rtol=1e-5, atol=1e-5)
+
+
 def test_fuse_1x1_respects_selfloop_writes():
     """A self-loop layer (relu writing the shared node) between sibling
     1x1 declarations versions the node: siblings across the write must
