@@ -23,9 +23,12 @@ from .builders import (  # noqa: F401
     mnist_conv_conf,
     mnist_mlp_conf,
     resnet50_conf,
+    resnet101_conf,
+    resnet152_conf,
     transformer_conf,
     transformer_lm_conf,
     vgg16_conf,
+    vgg19_conf,
 )
 
 MODEL_BUILDERS = {
@@ -34,7 +37,10 @@ MODEL_BUILDERS = {
     "alexnet": alexnet_conf,
     "googlenet": googlenet_conf,
     "vgg16": vgg16_conf,
+    "vgg19": vgg19_conf,
     "resnet50": resnet50_conf,
+    "resnet101": resnet101_conf,
+    "resnet152": resnet152_conf,
     "kaggle_bowl": kaggle_bowl_conf,
     "transformer": transformer_conf,
     "transformer_lm": transformer_lm_conf,

@@ -437,6 +437,19 @@ def _res_bottleneck(prev: str, name: str, cin: int, cmid: int, cout: int,
     return s
 
 
+_RESNET_BLOCKS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+
+def resnet101_conf(**kw) -> str:
+    """ResNet-101 — the [3, 4, 23, 3] depth of He et al. 2015 table 1."""
+    return resnet50_conf(depth=101, **kw)
+
+
+def resnet152_conf(**kw) -> str:
+    """ResNet-152 — the [3, 8, 36, 3] depth of He et al. 2015 table 1."""
+    return resnet50_conf(depth=152, **kw)
+
+
 def resnet50_conf(
     batch_size: int = 128,
     num_class: int = 1000,
@@ -445,11 +458,13 @@ def resnet50_conf(
     nsample: int = 0,
     dev: str = "tpu",
     compute_dtype: str = "bfloat16",
+    depth: int = 50,
 ) -> str:
-    """ResNet-50 (He et al. 2015, table 1) — bottleneck blocks
-    [3, 4, 6, 3], batch-norm everywhere, projection shortcuts at stage
-    boundaries.  New-scope zoo entry (the reference predates ResNets);
-    built from the paper like the GoogLeNet/VGG entries.
+    """ResNet-50/101/152 (He et al. 2015, table 1) — bottleneck blocks,
+    batch-norm everywhere, projection shortcuts at stage boundaries.
+    New-scope zoo entry (the reference predates ResNets); built from the
+    paper like the GoogLeNet/VGG entries.  ``depth`` picks the stage
+    plan (50: [3,4,6,3], 101: [3,4,23,3], 152: [3,8,36,3]).
     """
     if input_size % 32:
         raise ValueError(
@@ -478,8 +493,14 @@ def resnet50_conf(
         "layer[b1->p1] = max_pooling\n  kernel_size = 3\n  stride = 2\n"
     )
     prev, cin = "p1", 64
-    stages = [(3, 64, 256, 1), (4, 128, 512, 2), (6, 256, 1024, 2),
-              (3, 512, 2048, 2)]
+    if depth not in _RESNET_BLOCKS:
+        raise ValueError(
+            f"resnet depth must be one of {sorted(_RESNET_BLOCKS)}, "
+            f"got {depth}"
+        )
+    b0, b1, b2, b3 = _RESNET_BLOCKS[depth]
+    stages = [(b0, 64, 256, 1), (b1, 128, 512, 2), (b2, 256, 1024, 2),
+              (b3, 512, 2048, 2)]
     for si, (blocks, cmid, cout, stride) in enumerate(stages):
         for bi in range(blocks):
             name = f"s{si}b{bi}"
@@ -511,6 +532,11 @@ def resnet50_conf(
 
 
 # ---------------------------------------------------------------------------
+def vgg19_conf(**kw) -> str:
+    """VGG-19 (configuration E, Simonyan & Zisserman 2014)."""
+    return vgg16_conf(depth=19, **kw)
+
+
 def vgg16_conf(
     batch_size: int = 64,
     num_class: int = 1000,
@@ -519,8 +545,9 @@ def vgg16_conf(
     nsample: int = 0,
     dev: str = "tpu",
     compute_dtype: str = "bfloat16",
+    depth: int = 16,
 ) -> str:
-    """VGG-16 (configuration D, Simonyan & Zisserman 2014)."""
+    """VGG-16/19 (configurations D/E, Simonyan & Zisserman 2014)."""
     shape = f"3,{input_size},{input_size}"
     nsample = nsample or batch_size * 4
     data = (
@@ -532,7 +559,12 @@ def vgg16_conf(
     blocks: List[str] = []
     node = "0"
     idx = 0
-    plan = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+    if depth == 16:
+        plan = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+    elif depth == 19:
+        plan = [(2, 64), (2, 128), (4, 256), (4, 512), (4, 512)]
+    else:
+        raise ValueError(f"vgg depth must be 16 or 19, got {depth}")
     for b, (reps, ch) in enumerate(plan, start=1):
         for r in range(1, reps + 1):
             dst = f"c{b}_{r}"

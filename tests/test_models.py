@@ -35,10 +35,19 @@ def test_model_shapes(name):
     # output layer is softmax over the right class count
     out = shapes[tr.net.out_node_index()]
     expect = {"mnist_mlp": 10, "mnist_conv": 10, "alexnet": 1000,
-              "googlenet": 1000, "vgg16": 1000, "kaggle_bowl": 121,
+              "googlenet": 1000, "vgg16": 1000, "vgg19": 1000,
+              "kaggle_bowl": 121,
               "transformer": 10, "transformer_lm": 256,
-              "resnet50": 1000}[name]
+              "resnet50": 1000, "resnet101": 1000,
+              "resnet152": 1000}[name]
     assert out[-1] == expect
+    if name in ("resnet101", "resnet152", "vgg19"):
+        # depth variants really are deeper than their base model
+        base = {"resnet101": "resnet50", "resnet152": "resnet50",
+                "vgg19": "vgg16"}[name]
+        base_text = MODEL_BUILDERS[base](batch_size=4, dev="cpu",
+                                         nsample=8)
+        assert text.count("= conv:") > base_text.count("= conv:")
 
 
 def test_resnet50_structure():
