@@ -301,28 +301,32 @@ def _bench_imagenet_conf(tag: str, desc: str, conf: str, batch: int,
     return dt
 
 
-def bench_resnet(batch: int, scan_k: int, fuse: bool = True) -> None:
-    """``--resnet`` mode: ResNet-50 training throughput."""
+def bench_resnet(batch: int, scan_k: int, fuse: bool = True,
+                 depth: int = 50) -> None:
+    """``--resnet`` / ``--resnet101`` / ``--resnet152`` modes: ResNet
+    training throughput at the chosen depth."""
     from cxxnet_tpu.models import resnet50_conf
 
     _bench_imagenet_conf(
-        "resnet", "ResNet-50",
+        f"resnet{depth}", f"ResNet-{depth}",
         resnet50_conf(batch_size=batch, input_size=224, synthetic=False,
-                      dev="tpu"),
+                      dev="tpu", depth=depth),
         batch, scan_k, fuse=fuse,
     )
 
 
-def bench_vgg(batch: int, scan_k: int, fuse: bool = True) -> None:
-    """``--vgg`` mode: VGG-16 training throughput.  BASELINE.json's
-    config list names "ImageNet GoogLeNet/VGG-16 DP v5e-8"; this is the
-    single-chip VGG-16 number (doc/performance.md has the batch curve)."""
+def bench_vgg(batch: int, scan_k: int, fuse: bool = True,
+              depth: int = 16) -> None:
+    """``--vgg`` / ``--vgg19`` modes: VGG training throughput.
+    BASELINE.json's config list names "ImageNet GoogLeNet/VGG-16 DP
+    v5e-8"; this is the single-chip number (doc/performance.md has the
+    batch curve)."""
     from cxxnet_tpu.models import vgg16_conf
 
     _bench_imagenet_conf(
-        "vgg", "VGG-16",
+        f"vgg{depth}", f"VGG-{depth}",
         vgg16_conf(batch_size=batch, input_size=224, synthetic=False,
-                   dev="tpu"),
+                   dev="tpu", depth=depth),
         batch, scan_k, fuse=fuse,
     )
 
@@ -370,11 +374,22 @@ def main() -> None:
     args = [a for a in sys.argv[1:] if a not in ("--io", "--lm",
                                                  "--resnet", "--vgg",
                                                  "--alexnet", "--bowl",
+                                                 "--resnet101",
+                                                 "--resnet152", "--vgg19",
                                                  "--flash", "--nofuse")]
     io_mode = "--io" in sys.argv[1:]
     lm_mode = "--lm" in sys.argv[1:]
     resnet_mode = "--resnet" in sys.argv[1:]
+    depth_flags = [f for f in ("--resnet", "--resnet101", "--resnet152",
+                                "--vgg", "--vgg19") if f in sys.argv[1:]]
+    if len(depth_flags) > 1:
+        raise SystemExit(f"pick ONE model mode, got {depth_flags}")
+    resnet_depth = (101 if "--resnet101" in sys.argv[1:]
+                    else 152 if "--resnet152" in sys.argv[1:] else 50)
+    resnet_mode = resnet_mode or resnet_depth != 50
     vgg_mode = "--vgg" in sys.argv[1:]
+    vgg_depth = 19 if "--vgg19" in sys.argv[1:] else 16
+    vgg_mode = vgg_mode or vgg_depth != 16
     alexnet_mode = "--alexnet" in sys.argv[1:]
     bowl_mode = "--bowl" in sys.argv[1:]
     flash_mode = "--flash" in sys.argv[1:]
@@ -403,10 +418,12 @@ def main() -> None:
                  scan_k=min(scan_k, 20))
         return
     if resnet_mode:
-        bench_resnet(batch, min(scan_k, 30), fuse=not nofuse_mode)
+        bench_resnet(batch, min(scan_k, 30), fuse=not nofuse_mode,
+                     depth=resnet_depth)
         return
     if vgg_mode:
-        bench_vgg(batch, min(scan_k, 20), fuse=not nofuse_mode)
+        bench_vgg(batch, min(scan_k, 20), fuse=not nofuse_mode,
+                  depth=vgg_depth)
         return
     if alexnet_mode:
         bench_alexnet(batch=batch if batch_given else 256,
