@@ -67,6 +67,35 @@ def test_lrn_matmul_matches_xla(rng, shape, nsize):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_lrn_layer_matmul_dispatch(rng, monkeypatch):
+    """`lrn_impl = matmul` on the LAYER really routes through lrn_matmul
+    (call-counted via monkeypatch) and matches the default XLA path."""
+    import importlib
+
+    from cxxnet_tpu.layers.base import create_layer
+
+    # NB: the package re-exports the `lrn` FUNCTION as an attribute of
+    # cxxnet_tpu.ops, shadowing the module name — go via importlib
+    lrn_mod = importlib.import_module("cxxnet_tpu.ops.lrn")
+
+    calls = []
+    real = lrn_mod.lrn_matmul
+    monkeypatch.setattr(
+        lrn_mod, "lrn_matmul",
+        lambda *a, **k: (calls.append(1), real(*a, **k))[1],
+    )
+    x = jnp.asarray(rng.randn(2, 4, 4, 32).astype(np.float32))
+    outs = []
+    for impl in ("auto", "matmul"):
+        lay = create_layer("lrn")
+        lay.set_param("local_size", "5")
+        lay.set_param("lrn_impl", impl)
+        outs.append(lay.apply({}, [x])[0])
+    assert len(calls) == 1  # only the matmul-configured layer dispatched
+    np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(outs[1]),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_lrn_pallas_bf16(rng):
     x = jnp.asarray(rng.randn(4, 3, 3, 128).astype(np.float32)).astype(
         jnp.bfloat16
