@@ -17,6 +17,8 @@ Variants (default: all):
               output shape): what conv1 costs
 * conv1x1   — EVERY odd-k padded conv -> 1x1 pad 0 (shape-preserving):
               the all-conv ceiling, leaving pools/LRN/fc
+* stems2d   — the 7x7 s2 stem conv via the space-to-depth rewrite
+              (``conv_s2d = 1``): the stem-conv A/B
 """
 
 import os
@@ -66,6 +68,12 @@ def variant_conf(name: str, batch: int) -> str:
         return _conv_to_1x1(conf, only_stem=True)
     if name == "conv1x1":
         return _conv_to_1x1(conf)
+    if name == "stems2d":
+        # the 7x7 s2 stem via space-to-depth (conv._conv_s2d A/B)
+        return conf.replace(
+            "layer[0->c1] = conv:conv1\n",
+            "layer[0->c1] = conv:conv1\n  conv_s2d = 1\n",
+        )
     raise SystemExit(f"unknown variant {name}")
 
 
@@ -85,7 +93,8 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
-    names = sys.argv[1:] or ["base", "lrnmm", "nolrn", "stem1x1", "conv1x1"]
+    names = sys.argv[1:] or ["base", "lrnmm", "nolrn", "stem1x1",
+                             "conv1x1", "stems2d"]
     for name in names:
         time_variant(name)
 

@@ -6,7 +6,7 @@ did.  Run on the TPU host:
 
     python tools/resnet_bisect.py [variant ...]
 
-Variants: base, onepass, nobn, noavg, nomaxpool (default: all).
+Variants: base, onepass, nobn, noavg, nomaxpool, stems2d (default: all).
 """
 
 import os
@@ -53,6 +53,12 @@ def variant_conf(name: str, batch: int) -> str:
             "layer[b1->p1] = max_pooling\n  kernel_size = 3\n  stride = 2\n",
             "layer[b1->p1] = avg_pooling\n  kernel_size = 3\n  stride = 2\n",
         )
+    if name == "stems2d":
+        # the 7x7 s2 stem via space-to-depth (conv._conv_s2d A/B)
+        return conf.replace(
+            "layer[0->c1] = conv:conv1\n",
+            "layer[0->c1] = conv:conv1\n  conv_s2d = 1\n",
+        )
     raise SystemExit(f"unknown variant {name}")
 
 
@@ -74,7 +80,8 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
-    names = sys.argv[1:] or ["base", "onepass", "nobn", "noavg", "nomaxpool"]
+    names = sys.argv[1:] or ["base", "onepass", "nobn", "noavg",
+                             "nomaxpool", "stems2d"]
     for name in names:
         time_variant(name)
 
