@@ -5,6 +5,8 @@ bowl.conf) to prove the grammar handles every construct they use.
 """
 
 import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
 
 from cxxnet_tpu import config as C
 
@@ -154,3 +156,44 @@ def test_reference_example_confs_parse():
         assert any(n == "netconfig" and v == "start" for n, v in split.global_entries)
         parsed += 1
     assert parsed >= 1, "no reference configs were actually parsed"
+
+
+# --------------------------------------------------------------------------
+# property tests (hypothesis): the tokenizer must round-trip arbitrary
+# well-formed key=value streams — names without separators/comments,
+# values quoted when they carry spaces — and never crash on them.
+
+_name = st.text(
+    alphabet=st.characters(whitelist_categories=("Ll", "Lu", "Nd"),
+                           whitelist_characters="_.:[]-"),
+    min_size=1, max_size=20,
+).filter(lambda s: "=" not in s and "#" not in s and not s.isspace())
+
+_value = st.text(
+    alphabet=st.characters(whitelist_categories=("Ll", "Lu", "Nd"),
+                           whitelist_characters="_./,- "),
+    min_size=1, max_size=30,
+).filter(lambda s: s.strip() == s and s)  # no leading/trailing blanks
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.lists(st.tuples(_name, _value), min_size=1, max_size=20))
+def test_tokenizer_roundtrips_wellformed_pairs(pairs):
+    text = "".join(
+        (f'{n} = "{v}"\n' if " " in v else f"{n} = {v}\n")
+        for n, v in pairs
+    )
+    assert C.parse_pairs(text) == pairs
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.text(max_size=200))
+def test_tokenizer_never_crashes_unexpectedly(text):
+    """Arbitrary input either parses or raises the documented
+    ConfigError — never an internal exception type."""
+    try:
+        out = C.parse_pairs(text)
+    except C.ConfigError:
+        return
+    for name, val in out:
+        assert isinstance(name, str) and isinstance(val, str)

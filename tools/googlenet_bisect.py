@@ -70,10 +70,12 @@ def variant_conf(name: str, batch: int) -> str:
         return _conv_to_1x1(conf)
     if name == "stems2d":
         # the 7x7 s2 stem via space-to-depth (conv._conv_s2d A/B)
-        return conf.replace(
+        out = conf.replace(
             "layer[0->c1] = conv:conv1\n",
             "layer[0->c1] = conv:conv1\n  conv_s2d = 1\n",
         )
+        assert out != conf, "stem line drifted; stems2d would measure base"
+        return out
     raise SystemExit(f"unknown variant {name}")
 
 
