@@ -119,3 +119,15 @@ def test_membuffer_overfit_googlenet():
                        dev="cpu", input_size=64),
         "3,64,64", n_steps=300,
     )
+
+
+def test_membuffer_overfit_resnet50():
+    # exercises BN (one-pass stats), eltwise_sum shortcuts, and the
+    # strided-fused stage-boundary 1x1 pairs on the convergence path
+    from cxxnet_tpu.models import resnet50_conf
+
+    _overfit_one_cached_batch(
+        resnet50_conf(batch_size=8, num_class=10, synthetic=False,
+                      dev="cpu", input_size=32),
+        "3,32,32", n_steps=300,
+    )

@@ -5,8 +5,10 @@ bowl.conf) to prove the grammar handles every construct they use.
 """
 
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from cxxnet_tpu import config as C
 

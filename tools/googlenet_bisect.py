@@ -32,6 +32,14 @@ CACHE_DIR = os.path.join(
 )
 
 
+def _sub(conf: str, old: str, new: str) -> str:
+    """str.replace that refuses to silently no-op: a drifted builder
+    string would otherwise turn an A/B variant into base-vs-base."""
+    out = conf.replace(old, new)
+    assert out != conf or old == new, f"conf drift: {old!r} not found"
+    return out
+
+
 def _conv_to_1x1(conf: str, only_stem: bool = False) -> str:
     """Rewrite ``kernel_size = k / pad = (k-1)/2`` conv bodies to 1x1
     pad 0 (output shapes preserved; stride untouched)."""
@@ -58,23 +66,24 @@ def variant_conf(name: str, batch: int) -> str:
     if name == "lrnmm":
         return conf + "lrn_impl = matmul\n"
     if name == "nolrn":
-        return re.sub(
+        out = re.sub(
             r"= lrn\n(  local_size[^\n]*\n  alpha[^\n]*\n  beta[^\n]*\n"
             r"  knorm[^\n]*\n)",
             "= relu\n",
             conf,
         )
+        assert out != conf, "conf drift: no lrn layers matched"
+        return out
     if name == "stem1x1":
         return _conv_to_1x1(conf, only_stem=True)
     if name == "conv1x1":
         return _conv_to_1x1(conf)
     if name == "stems2d":
         # the 7x7 s2 stem via space-to-depth (conv._conv_s2d A/B)
-        out = conf.replace(
+        out = _sub(conf,
             "layer[0->c1] = conv:conv1\n",
             "layer[0->c1] = conv:conv1\n  conv_s2d = 1\n",
         )
-        assert out != conf, "stem line drifted; stems2d would measure base"
         return out
     raise SystemExit(f"unknown variant {name}")
 

@@ -20,6 +20,14 @@ CACHE_DIR = os.path.join(
 )
 
 
+def _sub(conf: str, old: str, new: str) -> str:
+    """str.replace that refuses to silently no-op: a drifted builder
+    string would otherwise turn an A/B variant into base-vs-base."""
+    out = conf.replace(old, new)
+    assert out != conf or old == new, f"conf drift: {old!r} not found"
+    return out
+
+
 def variant_conf(name: str, batch: int) -> str:
     from cxxnet_tpu.models import resnet50_conf
 
@@ -28,20 +36,24 @@ def variant_conf(name: str, batch: int) -> str:
     # resnet50_conf now emits a global `bn_stats = onepass` (the measured
     # default); the bisect's base/onepass A/B isolates the statistics
     # form, so "base" must restore the twopass control
-    conf = conf.replace("bn_stats = onepass\n", "bn_stats = twopass\n")
+    conf = _sub(conf, "bn_stats = onepass\n", "bn_stats = twopass\n")
     if name == "base":
         return conf
     if name == "onepass":
         # every batch_norm computes E[x^2]-E[x]^2 in one pass
-        return re.sub(r"(= batch_norm:\w+\n)", r"\1  bn_stats = onepass\n",
-                      conf)
+        out = re.sub(r"(= batch_norm:\w+\n)", r"\1  bn_stats = onepass\n",
+                     conf)
+        assert out != conf, "conf drift: no batch_norm layers matched"
+        return out
     if name == "nobn":
         # batch_norm -> relu (fuses into the conv epilogue, ~free):
         # isolates what all 53 BNs cost
-        return re.sub(r"= batch_norm:\w+\n", "= relu\n", conf)
+        out = re.sub(r"= batch_norm:\w+\n", "= relu\n", conf)
+        assert out != conf, "conf drift: no batch_norm layers matched"
+        return out
     if name == "noavg":
         # global avg pool -> stride-7 max slice (cheap): isolates tail
-        return conf.replace(
+        return _sub(conf,
             "layer[s3b2->pool] = avg_pooling\n  kernel_size = 7\n"
             "  stride = 1\n",
             "layer[s3b2->pool] = max_pooling\n  kernel_size = 1\n"
@@ -49,17 +61,16 @@ def variant_conf(name: str, batch: int) -> str:
         )
     if name == "nomaxpool":
         # stem max_pool k3 s2 -> avg (GoogLeNet diag analog)
-        return conf.replace(
+        return _sub(conf,
             "layer[b1->p1] = max_pooling\n  kernel_size = 3\n  stride = 2\n",
             "layer[b1->p1] = avg_pooling\n  kernel_size = 3\n  stride = 2\n",
         )
     if name == "stems2d":
         # the 7x7 s2 stem via space-to-depth (conv._conv_s2d A/B)
-        out = conf.replace(
+        out = _sub(conf,
             "layer[0->c1] = conv:conv1\n",
             "layer[0->c1] = conv:conv1\n  conv_s2d = 1\n",
         )
-        assert out != conf, "stem line drifted; stems2d would measure base"
         return out
     raise SystemExit(f"unknown variant {name}")
 
