@@ -29,7 +29,10 @@ def _tail(
     eta: float = 0.01,
     extra: str = "",
     dev: str = "tpu",
+    scan_steps: int = 8,
 ) -> str:
+    # scan_steps: the CLI runs k batches as ONE device program
+    # (doc/tasks.md); the trainer ignores the key in programmatic use
     return (
         f"input_shape = {input_shape}\n"
         f"batch_size = {batch_size}\n"
@@ -40,6 +43,7 @@ def _tail(
         f"eta = {eta}\n"
         "momentum = 0.9\n"
         "wd = 0.0005\n"
+        f"scan_steps = {scan_steps}\n"
         "metric = error\n"
         "eval_train = 1\n"
         "print_step = 100\n"
