@@ -17,6 +17,18 @@ from cxxnet_tpu.nnet.trainer import NetTrainer
 from cxxnet_tpu.parallel import make_mesh, parse_device
 
 
+def _assert_params_close(ta, tb, what="1- and 8-device runs"):
+    """Per-(layer, tag) weight comparison shared by every parity test."""
+    for key in ta.params:
+        for tag in ta.params[key]:
+            np.testing.assert_allclose(
+                np.asarray(ta.params[key][tag]),
+                np.asarray(tb.params[key][tag]),
+                rtol=2e-4, atol=2e-5,
+                err_msg=f"{key}/{tag} diverged between {what}",
+            )
+
+
 def test_parse_device():
     assert parse_device("tpu") == ("tpu", [0])
     assert parse_device("gpu:0-3") == ("gpu", [0, 1, 2, 3])
@@ -77,14 +89,56 @@ def test_multi_device_matches_single():
     """§4.3 analog: 8-way DP training == single-device training."""
     t1 = _train(1)
     t8 = _train(8)
-    for key in t1.params:
-        for tag in t1.params[key]:
-            np.testing.assert_allclose(
-                np.asarray(t1.params[key][tag]),
-                np.asarray(t8.params[key][tag]),
-                rtol=2e-4, atol=2e-5,
-                err_msg=f"{key}/{tag} diverged between 1- and 8-device runs",
-            )
+    _assert_params_close(t1, t8, "1- and 8-device runs")
+
+
+CONV_S2D_LRN_CFG = [
+    ("dev", "tpu:0-{n}"),
+    ("batch_size", "16"),
+    ("input_shape", "3,10,10"),
+    ("eta", "0.1"),
+    ("momentum", "0.9"),
+    ("netconfig", "start"),
+    ("layer[0->1]", "conv:cv1"),
+    ("kernel_size", "3"),
+    ("stride", "2"),
+    ("pad", "1"),
+    ("nchannel", "8"),
+    ("random_type", "xavier"),
+    ("conv_s2d", "1"),
+    ("layer[1->1]", "relu"),
+    ("layer[1->2]", "lrn"),
+    ("local_size", "5"),
+    ("lrn_impl", "matmul"),
+    ("layer[2->3]", "flatten"),
+    ("layer[3->4]", "fullc:fc"),
+    ("nhidden", "4"),
+    ("random_type", "xavier"),
+    ("layer[4->4]", "softmax"),
+    ("netconfig", "end"),
+]
+
+
+def _train_s2d(ndev: int, steps: int = 4):
+    cfg = [(k, v.format(n=ndev - 1) if k == "dev" else v)
+           for k, v in CONV_S2D_LRN_CFG]
+    tr = NetTrainer()
+    tr.set_params(cfg)
+    tr.init_model()
+    rng = np.random.RandomState(3)
+    data = rng.randn(steps, 16, 10, 10, 3).astype(np.float32)
+    labels = rng.randint(0, 4, size=(steps, 16, 1)).astype(np.float32)
+    for i in range(steps):
+        tr.update_all(data[i], labels[i])
+    return tr
+
+
+def test_conv_s2d_and_matmul_lrn_match_single_under_dp():
+    """The space-to-depth conv rewrite and banded-GEMM LRN partition
+    cleanly under GSPMD: 8-way DP == single device."""
+    t1 = _train_s2d(1)
+    t8 = _train_s2d(8)
+    _assert_params_close(t1, t8, "1- and 8-device runs")
 
 
 def test_step_output_is_sharded():
@@ -131,14 +185,7 @@ def test_tensor_parallel_matches_single():
     t1 = _train(1)
     ttp = _train_tp(8, 4)  # 2-way data x 4-way tensor parallel
     assert ttp.mesh_plan.n_model == 4 and ttp.mesh_plan.n_data == 2
-    for key in t1.params:
-        for tag in t1.params[key]:
-            np.testing.assert_allclose(
-                np.asarray(t1.params[key][tag]),
-                np.asarray(ttp.params[key][tag]),
-                rtol=2e-4, atol=2e-5,
-                err_msg=f"{key}/{tag} diverged between DP and DPxTP runs",
-            )
+    _assert_params_close(t1, ttp, "DP and DPxTP runs")
 
 
 def test_tensor_parallel_weights_are_sharded():
@@ -178,14 +225,7 @@ def test_update_on_server_zero1_state_sharding():
     m = tr.ustates["l0_fc1"]["wmat"]["m"]
     assert m.sharding.spec == P("data", None)
     t1 = _train(1)
-    for key in t1.params:
-        for tag in t1.params[key]:
-            np.testing.assert_allclose(
-                np.asarray(t1.params[key][tag]),
-                np.asarray(tr.params[key][tag]),
-                rtol=2e-4, atol=2e-5,
-                err_msg=f"{key}/{tag} diverged under update_on_server",
-            )
+    _assert_params_close(t1, tr, "update_on_server")
 
 
 def test_tp_step_never_allgathers_weights():
@@ -266,14 +306,7 @@ def test_fsdp_matches_single_device():
     are placement, not math."""
     t1 = _train(1)
     tf = _train_zero(8, "3")
-    for key in t1.params:
-        for tag in t1.params[key]:
-            np.testing.assert_allclose(
-                np.asarray(t1.params[key][tag]),
-                np.asarray(tf.params[key][tag]),
-                rtol=2e-4, atol=2e-5,
-                err_msg=f"{key}/{tag} diverged under zero=3",
-            )
+    _assert_params_close(t1, tf, "zero=3")
 
 
 def test_fsdp_params_really_sharded():
@@ -296,14 +329,7 @@ def test_fsdp_composes_with_tensor_parallel():
     t1 = _train(1)
     tf = _train_zero(8, "3", extra=(("model_parallel", "2"),))
     assert tf.mesh_plan.n_model == 2 and tf.mesh_plan.n_data == 4
-    for key in t1.params:
-        for tag in t1.params[key]:
-            np.testing.assert_allclose(
-                np.asarray(t1.params[key][tag]),
-                np.asarray(tf.params[key][tag]),
-                rtol=2e-4, atol=2e-5,
-                err_msg=f"{key}/{tag} diverged under zero=3 + TP",
-            )
+    _assert_params_close(t1, tf, "zero=3 + TP")
 
 
 def test_zero1_is_update_on_server_alias():
@@ -373,13 +399,7 @@ def test_fuse_1x1_matches_under_mesh(mp):
 
     t0, t1 = train(0), train(1)
     assert t1.net._sibling_1x1_groups()[0]  # groups actually formed
-    for key in t0.params:
-        for tag in t0.params[key]:
-            np.testing.assert_allclose(
-                np.asarray(t0.params[key][tag]),
-                np.asarray(t1.params[key][tag]),
-                rtol=2e-4, atol=2e-5, err_msg=f"{key}/{tag}"
-            )
+    _assert_params_close(t0, t1)
 
 
 def test_check_weight_sync_single_process_multi_device():
