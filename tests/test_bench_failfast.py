@@ -1,0 +1,103 @@
+"""bench.py must NEVER silently hang on a dead TPU relay (round-3
+postmortem: BENCH_r03.json rc=124 with zero output after 25 min).
+
+These tests run bench.py as a subprocess the way the driver does and
+assert the fail-fast contract: dead relay -> parseable diagnostic JSON
+on stdout within seconds, rc 0.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dead_relay_fails_fast_with_diagnostic_json():
+    env = dict(os.environ)
+    # simulate the axon production environment: the site path mentions
+    # axon (so _tpu_expected() is true) and the relay port is dead
+    env["PYTHONPATH"] = REPO + os.pathsep + "/nonexistent/.axon_site"
+    env.pop("JAX_PLATFORMS", None)
+    env["AXON_RELAY_PORT"] = "1"  # nothing listens on port 1
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=60,
+    )
+    assert p.returncode == 0, p.stderr[-500:]
+    lines = [l for l in p.stdout.splitlines() if l.startswith("{")]
+    assert lines, f"no JSON line on stdout: {p.stdout!r}"
+    rec = json.loads(lines[-1])
+    assert rec["value"] is None
+    assert "relay dead" in rec["error"]
+
+
+def test_cpu_env_with_axon_on_path_still_probes():
+    """JAX_PLATFORMS=cpu does NOT disarm the relay dial when .axon_site
+    is on PYTHONPATH (sitecustomize re-registers the axon backend after
+    env processing — tests/conftest.py documents it), so the probe must
+    still fail fast there."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + "/nonexistent/.axon_site"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["AXON_RELAY_PORT"] = "1"
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=60,
+    )
+    assert p.returncode == 0
+    rec = json.loads([l for l in p.stdout.splitlines()
+                      if l.startswith("{")][-1])
+    assert rec["value"] is None and "relay dead" in rec["error"]
+
+
+def test_axon_free_path_skips_probe_and_watchdog_names_stage():
+    """Without .axon_site on the path nothing dials the relay: the probe
+    is skipped and a genuinely slow run hits the watchdog, which names
+    the stuck stage.  (A 3s deadline fires mid-compile — that also
+    proves the probe did not block the run.)"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["AXON_RELAY_PORT"] = "1"
+    env["BENCH_WATCHDOG_SEC"] = "3"
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "4", "2", "1"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    lines = [l for l in p.stdout.splitlines() if l.startswith("{")]
+    assert lines, f"no JSON line on stdout: {p.stdout!r}"
+    rec = json.loads(lines[-1])
+    # watchdog fired mid-compile: diagnostic names the stage, rc 3
+    assert p.returncode == 3
+    assert "watchdog" in rec["error"]
+    assert "relay dead" not in rec.get("error", "")
+
+
+def test_watchdog_reemits_measurement_instead_of_null(capsys):
+    """A watchdog fire AFTER a measurement line exists must re-emit that
+    measurement as the last stdout JSON line (never clobber it with
+    value: null) — last-JSON-line drivers keep the real number."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    bench._STAGE.pop("done", None)
+    bench._emit("provisional", 1234.5, 128)
+    capsys.readouterr()
+    real_exit = os._exit
+    try:
+        os._exit = lambda code: None
+        bench._STAGE["name"] = "timed scans (final)"
+        bench._arm_watchdog(9999)
+        t = bench._STAGE["watchdog"]
+        t.cancel()       # never let it really fire...
+        t.function()     # ...invoke fire() synchronously instead
+    finally:
+        os._exit = real_exit
+        bench._STAGE["done"] = True
+        bench._STAGE.pop("last_emit", None)
+    out = capsys.readouterr().out
+    rec = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
+    assert rec["value"] == 1234.5
+    assert "watchdog" in rec and "stuck at stage" in rec["watchdog"]
