@@ -123,15 +123,17 @@ class FunctionalNet:
 
     @staticmethod
     def _safe_set(lay: Layer, name: str, val: str) -> None:
-        """Global defaults may contain keys a given layer can't parse
-        (e.g. ``dev``); layer set_param ignores unknown keys by design,
-        but value errors for *known* keys must propagate."""
+        """Layer ``set_param`` ignores unknown keys by design (the elif
+        chains fall through silently), so any exception here is a real
+        parse/value error on a key the layer *does* claim — propagate it.
+        A config typo in layer scope must fail loudly, not vanish."""
         try:
             lay.set_param(name, val)
-        except ValueError:
-            raise
-        except Exception:
-            pass
+        except Exception as e:
+            raise ValueError(
+                f"layer {lay.__class__.__name__}: bad value for "
+                f"{name!r} = {val!r}: {e}"
+            ) from e
 
     # ------------------------------------------------------------------
     def input_node_shape(self, batch_size: int) -> Tuple[int, ...]:
