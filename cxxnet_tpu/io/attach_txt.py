@@ -7,6 +7,7 @@ by instance id.  File format: each line ``inst_index v1 v2 ... vk``.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, Optional
 
 import numpy as np
@@ -62,12 +63,10 @@ class AttachTxtIterator(DataIter):
                 row = self._table.get(int(idx))
                 if row is not None:
                     extra[i] = row
-        self._cur = DataBatch(
-            data=b.data,
-            label=b.label,
-            inst_index=b.inst_index,
-            num_batch_padd=b.num_batch_padd,
-            extra_data=b.extra_data + [extra],
+        # replace() keeps every other DataBatch field (incl. the CSR
+        # sparse part) flowing through the wrap
+        self._cur = dataclasses.replace(
+            b, extra_data=b.extra_data + [extra]
         )
         return True
 

@@ -25,17 +25,41 @@ ConfigEntry = Tuple[str, str]
 class DataBatch:
     """One mini-batch. ``num_batch_padd`` trailing instances are padding
     (replicated data to keep shapes static) and must be excluded from
-    evaluation/prediction output (data.h:86-88)."""
+    evaluation/prediction output (data.h:86-88).
+
+    The sparse part mirrors the reference's CSR fields
+    (``data.h:97-101``: ``sparse_row_ptr`` / ``sparse_data``) with the
+    Entry struct-array split into parallel index/value arrays — the
+    layout ``scipy.sparse.csr_matrix`` and XLA gather/segment ops
+    consume directly, instead of an array-of-structs a TPU can't use."""
 
     data: np.ndarray                  # (N, H, W, C) or (N, D)
     label: np.ndarray                 # (N, label_width) float32
     inst_index: Optional[np.ndarray] = None
     num_batch_padd: int = 0
     extra_data: List[np.ndarray] = dataclasses.field(default_factory=list)
+    #: CSR row pointer, shape (N+1,), int64 — None for dense batches
+    sparse_row_ptr: Optional[np.ndarray] = None
+    #: CSR column indices (Entry.findex), shape (nnz,), int32
+    sparse_index: Optional[np.ndarray] = None
+    #: CSR values (Entry.fvalue), shape (nnz,), float32
+    sparse_value: Optional[np.ndarray] = None
 
     @property
     def batch_size(self) -> int:
         return self.data.shape[0]
+
+    def is_sparse(self) -> bool:
+        """Parity: ``DataBatch::is_sparse`` (data.h:166-168)."""
+        return self.sparse_row_ptr is not None
+
+    def get_row_sparse(self, rid: int):
+        """Row ``rid`` as (indices, values) — parity
+        ``DataBatch::GetRowSparse`` (data.h:170-175)."""
+        if not self.is_sparse():
+            raise ValueError("GetRowSparse on a dense batch")
+        lo, hi = self.sparse_row_ptr[rid], self.sparse_row_ptr[rid + 1]
+        return self.sparse_index[lo:hi], self.sparse_value[lo:hi]
 
 
 def shard_rows(n_rows: int, rank: int, nworker: int):
@@ -103,6 +127,7 @@ def create_iterator(cfg: Sequence[ConfigEntry]) -> DataIter:
     from .prefetch import ThreadBufferIterator
     from .synth import SyntheticIterator
     from .attach_txt import AttachTxtIterator
+    from .libsvm import LibSVMIterator
     from .text import TextIterator
 
     it: Optional[DataIter] = None
@@ -132,6 +157,10 @@ def create_iterator(cfg: Sequence[ConfigEntry]) -> DataIter:
                 if it is not None:
                     raise ValueError("text cannot chain over another iterator")
                 it = TextIterator()
+            elif val == "libsvm":
+                if it is not None:
+                    raise ValueError("libsvm cannot chain over another iterator")
+                it = LibSVMIterator()
             elif val == "threadbuffer":
                 if it is None:
                     raise ValueError("must specify input of threadbuffer")
