@@ -646,24 +646,68 @@ class NetTrainer:
         parameter (float64 sum + sum of squares per leaf) and the
         fingerprints are allgathered across the process group.  Replicas
         that drifted (a bad collective, host memory fault, divergent
-        dispatch order) produce differing rows.  Parameters sharded
-        *across* processes (model parallel / ZeRO-3) are skipped —
-        their per-process shards differ by design and their global
-        consistency is XLA's own invariant.
+        dispatch order) produce differing rows.
 
-        Returns the max abs fingerprint deviation across processes
-        (0.0 single-process); raises RuntimeError when it exceeds
-        ``tol``.
+        Parameters sharded across devices (model parallel / ZeRO-3) get
+        the same guard at shard granularity: each device's shard is
+        fingerprinted together with the *logical slice* of the global
+        array it holds (``Shard.index``), and every replica of the same
+        slice — wherever it lives in the mesh — must agree bit-exactly.
+        Slices with a single replica have nothing to compare and
+        contribute nothing, so a pure-TP axis is quiet while TP x DP
+        (the common case) checks the DP replicas of every TP shard.
+
+        Returns the max abs fingerprint deviation across replicas
+        (0.0 single-process single-device); raises RuntimeError when it
+        exceeds ``tol``.
         """
         assert self.params is not None, "init_model/load_model first"
         if jax.process_count() == 1 and len(jax.local_devices()) == 1:
             return 0.0  # nothing to compare; skip the host transfers
+
+        def _slice_key(index) -> tuple:
+            return tuple(
+                (s.start, s.stop, s.step) if isinstance(s, slice) else s
+                for s in index
+            )
+
+        def _check_groups(keys, fps, where: str) -> float:
+            groups: dict = {}
+            for k, fpv in zip(keys, fps):
+                groups.setdefault(k, []).append(fpv)
+            worst = 0.0
+            for k, g in groups.items():
+                if len(g) < 2:
+                    continue
+                g = np.asarray(g, np.float64)
+                d = float(np.abs(g - g[0]).max())
+                worst = max(worst, d)
+                if d > tol:
+                    name, idx = k
+                    raise RuntimeError(
+                        f"weight-sync check failed: parameter {name} "
+                        f"slice {idx} differs across {where} replicas "
+                        f"by {d:g} (tol {tol:g}) — sharded weights have "
+                        "diverged"
+                    )
+            return worst
+
         rows = []
+        shard_rows: list = []   # per (sharded leaf, local device) fingerprints
+        shard_keys: list = []   # matching (leaf, slice) group keys
+        shard_leaves: list = []  # (name, sharding, shape) in traversal order
         for key in sorted(self.params):
             for tag in sorted(self.params[key]):
                 arr = self.params[key][tag]
                 sh = getattr(arr, "sharding", None)
                 if sh is not None and not sh.is_fully_replicated:
+                    for s in sorted(getattr(arr, "addressable_shards", []),
+                                    key=lambda s: s.device.id):
+                        local = np.asarray(s.data, dtype=np.float64)
+                        shard_rows.append([local.sum(), (local * local).sum()])
+                        shard_keys.append((f"{key}/{tag}",
+                                           _slice_key(s.index)))
+                    shard_leaves.append((f"{key}/{tag}", sh, arr.shape))
                     continue
                 shards = getattr(arr, "addressable_shards", None)
                 if not shards:
@@ -688,10 +732,45 @@ class NetTrainer:
                         f"(tol {tol:g}) — an on-device replica is corrupt"
                     )
                 rows.append(fps[0])
+
+        # sharded leaves, intra-process: local replicas of the same slice
+        dev_sharded = _check_groups(shard_keys, shard_rows, "local-device")
+
         fp = np.asarray(rows, np.float64).reshape(-1)
         if jax.process_count() == 1:
-            return 0.0
+            return dev_sharded
+
+        # sharded leaves, cross-process: every process holds the same
+        # number of shard rows (uniform local device counts over one
+        # mesh), so the fingerprints allgather as a dense block; the
+        # matching keys are recomputed per peer from the sharding's
+        # global device->slice map (devices_indices_map is deterministic
+        # and identical on every process).
         from jax.experimental import multihost_utils
+
+        if shard_rows:
+            sfp = np.ascontiguousarray(
+                np.asarray(shard_rows, np.float64).reshape(-1)
+            ).view(np.uint32)
+            all_sfp = np.asarray(
+                multihost_utils.process_allgather(sfp)
+            ).view(np.float64).reshape(-1, 2)
+            all_keys = []
+            for p in range(jax.process_count()):
+                for name, sh, shape in shard_leaves:
+                    imap = sh.devices_indices_map(shape)
+                    for d in sorted(
+                        (d for d in imap if d.process_index == p),
+                        key=lambda d: d.id,
+                    ):
+                        all_keys.append((name, _slice_key(imap[d])))
+            assert len(all_keys) == all_sfp.shape[0], (
+                "shard fingerprint/key count mismatch across processes"
+            )
+            dev_sharded = max(
+                dev_sharded,
+                _check_groups(all_keys, list(all_sfp), "cross-process"),
+            )
 
         # gather the f64 fingerprints as uint32 words: process_allgather
         # round-trips through jax.device_put, which (x64 mode off — the
@@ -709,7 +788,7 @@ class NetTrainer:
                 f"{dev:g} across {jax.process_count()} processes "
                 f"(tol {tol:g}) — replicated weights have diverged"
             )
-        return dev
+        return max(dev, dev_sharded)
 
     def _next_rng(self) -> jax.Array:
         self._rng_key, sub = jax.random.split(self._rng_key)
