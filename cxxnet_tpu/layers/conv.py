@@ -31,6 +31,7 @@ from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .base import Layer, Params, Shape, register
@@ -110,6 +111,103 @@ def _conv_s2d(x, w, s: int, py: int, px: int):
     return y[:, :oh, :ow, :]
 
 
+# --- Winograd F(4x4, 3x3) (Lavin & Gray 2015) ---------------------------
+#
+# The transform matrices, f32.  B^T/A^T entries are small integers (bf16-
+# exact products); G carries the 1/6, 1/12, 1/24 fractions, so U = GwG^T
+# is computed in f32 and cast once.
+
+_WG_BT = np.array(
+    [
+        [4, 0, -5, 0, 1, 0],
+        [0, -4, -4, 1, 1, 0],
+        [0, 4, -4, -1, 1, 0],
+        [0, -2, -1, 2, 1, 0],
+        [0, 2, -1, -2, 1, 0],
+        [0, 4, 0, -5, 0, 1],
+    ],
+    np.float32,
+)
+_WG_G = np.array(
+    [
+        [1 / 4, 0, 0],
+        [-1 / 6, -1 / 6, -1 / 6],
+        [-1 / 6, 1 / 6, -1 / 6],
+        [1 / 24, 1 / 12, 1 / 6],
+        [1 / 24, -1 / 12, 1 / 6],
+        [0, 0, 1],
+    ],
+    np.float32,
+)
+_WG_AT = np.array(
+    [
+        [1, 1, 1, 1, 1, 0],
+        [0, 1, -1, 2, -2, 0],
+        [0, 1, 1, 4, 4, 0],
+        [0, 1, -1, 8, -8, 1],
+    ],
+    np.float32,
+)
+
+
+def _conv_winograd3(x, w, py: int, px: int):
+    """3x3 stride-1 conv via Winograd F(4x4, 3x3) — 2.25x fewer MACs
+    per output than direct (36 taps per 16 outputs vs 81), i.e. 4x
+    fewer than the 9-tap im2col GEMM XLA:TPU lowers to (no Winograd
+    rewrite in XLA; the cuDNN fast path the reference gets for free,
+    ``cudnn_convolution_layer-inl.hpp``, re-derived as pure XLA ops).
+
+    Everything is jnp — tile extraction as strided slices, the two
+    small 6x6 transforms as f32 einsums (VPU work, fused by XLA), and
+    the one heavy contraction as a 36-way batched GEMM in the input
+    dtype with f32 accumulation — so XLA keeps fusing around it; no
+    custom-call fence (the round-3 Pallas-pool lesson,
+    doc/performance.md "Isolated-kernel wins do not survive fusion").
+
+    Numerics: input/inverse transforms in f32 (B^T/A^T are small-int
+    matrices but 6-term sums lose bf16 bits), GEMM operands cast back
+    to ``x.dtype``.  Autodiff reverses the whole pipeline, so the
+    backward is Winograd too (the transposed transforms).
+    """
+    n, h, wd, c = x.shape
+    o = w.shape[3]
+    oh, ow = h + 2 * py - 2, wd + 2 * px - 2
+    th, tw = -(-oh // 4), -(-ow // 4)
+    # padded extent must cover the last tile: 4*(t-1) + 6
+    xp = jnp.pad(
+        x,
+        ((0, 0), (py, 4 * th + 2 - h - py), (px, 4 * tw + 2 - wd - px),
+         (0, 0)),
+    )
+    # d[n, t, u, c, i, j] = xp[n, 4t+i, 4u+j, c]: 36 strided slices
+    d = jnp.stack(
+        [
+            jnp.stack(
+                [xp[:, i:i + 4 * th:4, j:j + 4 * tw:4, :] for j in range(6)],
+                axis=-1,
+            )
+            for i in range(6)
+        ],
+        axis=-2,
+    )  # (N, th, tw, C, 6i, 6j)
+    v = jnp.einsum(
+        "ai,ntucij,bj->abntuc",
+        _WG_BT, d.astype(jnp.float32), _WG_BT,
+    ).astype(x.dtype)
+    u = jnp.einsum(
+        "ak,klco,bl->abco",
+        _WG_G, w.astype(jnp.float32), _WG_G,
+    ).astype(x.dtype)
+    # the MXU part: 36 batched (N*th*tw, C) x (C, O) GEMMs
+    m = jnp.einsum(
+        "abntuc,abco->abntuo", v, u,
+        preferred_element_type=jnp.float32,
+    )
+    y = jnp.einsum("pa,abntuo,qb->ntupqo", _WG_AT, m, _WG_AT)
+    y = y.transpose(0, 1, 3, 2, 4, 5).reshape(n, 4 * th, 4 * tw, o)
+    return y[:, :oh, :ow, :].astype(x.dtype)
+
+
 @register
 class ConvolutionLayer(Layer):
     type_name = "conv"
@@ -117,10 +215,13 @@ class ConvolutionLayer(Layer):
     def __init__(self) -> None:
         super().__init__()
         self.conv_s2d = 0  # opt-in space-to-depth rewrite (any stride>1)
+        self.conv_wino = 0  # opt-in Winograd F(4x4,3x3) for 3x3 s1 convs
 
     def set_param(self, name, val):
         if name == "conv_s2d":
             self.conv_s2d = int(val)
+        elif name == "conv_wino":
+            self.conv_wino = int(val)
         else:
             super().set_param(name, val)
 
@@ -162,7 +263,14 @@ class ConvolutionLayer(Layer):
     def apply(self, params, inputs, *, train=False, rng=None, step=None):
         p = self.param
         x = inputs[0]
-        if self.conv_s2d and p.stride > 1 and p.num_group == 1:
+        if (self.conv_wino and p.stride == 1 and p.num_group == 1
+                and p.kernel_height == 3 and p.kernel_width == 3
+                and x.shape[3] >= 8):
+            # cin < 8 (e.g. a VGG conv1_1 RGB input) keeps the direct
+            # path: the Winograd GEMM contracts over K = cin, and K=3
+            # starves the MXU worse than the 9-tap im2col's K=27
+            y = _conv_winograd3(x, params["wmat"], p.pad_y, p.pad_x)
+        elif self.conv_s2d and p.stride > 1 and p.num_group == 1:
             y = _conv_s2d(x, params["wmat"].astype(x.dtype), p.stride,
                           p.pad_y, p.pad_x)
         else:

@@ -519,3 +519,56 @@ def test_init_distributions():
     p.num_hidden = 50
     w = p.rand_init_weight(key, (200, 200), 0, 0)
     assert abs(float(jnp.std(w)) - math.sqrt(2.0 / 50)) < 0.02
+
+
+@pytest.mark.parametrize(
+    "hw,p,cin,cout",
+    [(14, 1, 12, 8),   # VGG-shaped: pad 1, extent not a multiple of 4
+     (16, 1, 16, 8),   # oh=16: exact tile multiple
+     (9, 0, 9, 4),     # VALID pad, odd extent, odd cin
+     (12, 1, 8, 8),    # cin exactly at the >=8 rewrite gate
+     (7, 1, 10, 6)],   # tiny: single partial tile row
+)
+def test_conv_winograd_matches_direct(rng, hw, p, cin, cout):
+    """conv_wino=1 (Winograd F(4x4,3x3), pure-XLA) must match the direct
+    3x3 s1 conv — outputs and weight/input gradients — over tile-exact
+    and tile-ragged extents.  f32 tolerance covers the transform's
+    mild error amplification (A^T rows reach |.|=8)."""
+    x = rng.randn(2, hw, hw + 3, cin).astype(np.float32)
+    base = mk("conv", [("kernel_size", "3"), ("stride", "1"),
+                       ("pad", str(p)), ("nchannel", str(cout))])
+    wino = mk("conv", [("kernel_size", "3"), ("stride", "1"),
+                       ("pad", str(p)), ("nchannel", str(cout)),
+                       ("conv_wino", "1")])
+    params = base.init_params(jax.random.PRNGKey(0), [x.shape])
+    ya = base.apply(params, [jnp.asarray(x)])[0]
+    yb = wino.apply(params, [jnp.asarray(x)])[0]
+    assert ya.shape == yb.shape
+    np.testing.assert_allclose(np.asarray(ya), np.asarray(yb),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss(lay, pr, v):
+        return (lay.apply(pr, [v])[0] ** 2).sum()
+
+    ga = jax.grad(loss, argnums=(1, 2))(base, params, jnp.asarray(x))
+    gb = jax.grad(loss, argnums=(1, 2))(wino, params, jnp.asarray(x))
+    for a, b in zip(jax.tree_util.tree_leaves(ga),
+                    jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_conv_winograd_ignored_off_domain(rng):
+    """conv_wino on a strided / non-3x3 / grouped conv silently keeps
+    the direct path (the knob is a 3x3-s1-only rewrite)."""
+    x = rng.randn(2, 12, 12, 4).astype(np.float32)
+    # cin=4 < 8: even a 3x3 s1 conv keeps the direct path (MXU K gate)
+    for extra in ([("kernel_size", "3"), ("stride", "2"), ("pad", "1")],
+                  [("kernel_size", "3"), ("stride", "1"), ("pad", "1")],
+                  [("kernel_size", "5"), ("stride", "1"), ("pad", "2")]):
+        base = mk("conv", extra + [("nchannel", "8")])
+        wino = mk("conv", extra + [("nchannel", "8"), ("conv_wino", "1")])
+        params = base.init_params(jax.random.PRNGKey(1), [x.shape])
+        np.testing.assert_array_equal(
+            np.asarray(base.apply(params, [jnp.asarray(x)])[0]),
+            np.asarray(wino.apply(params, [jnp.asarray(x)])[0]))

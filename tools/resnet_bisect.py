@@ -72,6 +72,9 @@ def variant_conf(name: str, batch: int) -> str:
             "layer[0->c1] = conv:conv1\n  conv_s2d = 1\n",
         )
         return out
+    if name == "wino":
+        # every 3x3 s1 conv via Winograd F(4x4,3x3) (layers/conv.py)
+        return conf + "conv_wino = 1\n"
     raise SystemExit(f"unknown variant {name}")
 
 
@@ -94,7 +97,7 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
     names = sys.argv[1:] or ["base", "onepass", "nobn", "noavg",
-                             "nomaxpool", "stems2d"]
+                             "nomaxpool", "stems2d", "wino"]
     for name in names:
         time_variant(name)
 
