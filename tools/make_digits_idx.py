@@ -35,16 +35,16 @@ def write_digits_idx(outdir: str, n_test: int = 297) -> None:
     imgs, labels = imgs[perm], labels[perm]
     os.makedirs(outdir, exist_ok=True)
     write_idx_images(
-        os.path.join(outdir, "train-images-idx3-ubyte"), imgs[n_test:]
+        os.path.join(outdir, "digits-train-images-idx3-ubyte"), imgs[n_test:]
     )
     write_idx_labels(
-        os.path.join(outdir, "train-labels-idx1-ubyte"), labels[n_test:]
+        os.path.join(outdir, "digits-train-labels-idx1-ubyte"), labels[n_test:]
     )
     write_idx_images(
-        os.path.join(outdir, "t10k-images-idx3-ubyte"), imgs[:n_test]
+        os.path.join(outdir, "digits-t10k-images-idx3-ubyte"), imgs[:n_test]
     )
     write_idx_labels(
-        os.path.join(outdir, "t10k-labels-idx1-ubyte"), labels[:n_test]
+        os.path.join(outdir, "digits-t10k-labels-idx1-ubyte"), labels[:n_test]
     )
     print(
         f"wrote {len(labels) - n_test} train / {n_test} test real "
