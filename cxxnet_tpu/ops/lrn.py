@@ -148,6 +148,13 @@ def lrn_matmul(x, nsize: int = 3, alpha: float = 0.001, beta: float = 0.75,
                knorm: float = 1.0):
     """LRN whose channel-window sum is a banded C×C matmul — MXU work.
 
+    Scope: targets the *small-C* LRN layers the zoo actually has
+    (GoogLeNet/AlexNet, C ≤ 192), where reduce_window's shifted adds
+    are VPU-bound and the dense band is tiny.  The band costs O(C²)
+    FLOPs and a C×C operand per call vs reduce_window's O(C·nsize) —
+    at C ≥ 1024 the matmul form is a large FLOP regression; keep the
+    default `lrn_impl = xla` there.
+
     The window sum ``win[c] = sum_{c-half <= j < c-half+nsize} x²[j]``
     is ``x² @ B`` with ``B[j, c] = 1`` on the band (same clipped-edge
     semantics as ``lrn_xla``'s reduce_window padding).  Flattened to
