@@ -111,100 +111,141 @@ def _conv_s2d(x, w, s: int, py: int, px: int):
     return y[:, :oh, :ow, :]
 
 
-# --- Winograd F(4x4, 3x3) (Lavin & Gray 2015) ---------------------------
+# --- Winograd F(m x m, 3x3) (Lavin & Gray 2015) -------------------------
 #
-# The transform matrices, f32.  B^T/A^T entries are small integers (bf16-
-# exact products); G carries the 1/6, 1/12, 1/24 fractions, so U = GwG^T
-# is computed in f32 and cast once.
+# Two tile sizes, selected by ``conv_wino``:
+#
+# * 1 -> F(4x4): 36 taps per 16 outputs = 2.25 MACs/output vs direct's
+#   9 (the max FLOP win), transform constants up to |8| — bf16 GEMM
+#   operands cost ~1e-2 relative error (the known fp16-Winograd
+#   tradeoff; cuDNN's fp16 winograd has the same profile);
+# * 2 -> F(2x2): 16 taps per 4 outputs = 4 MACs/output (a 2.25x
+#   reduction), transform constants in {0, +-1, 1/2} — error within
+#   ~2x of the direct bf16 conv.  The numerics escape hatch.
+#
+# B^T/A^T products are bf16-exact or near-exact; G carries fractions,
+# so U = GwG^T is computed in f32 and cast once.
 
-_WG_BT = np.array(
-    [
-        [4, 0, -5, 0, 1, 0],
-        [0, -4, -4, 1, 1, 0],
-        [0, 4, -4, -1, 1, 0],
-        [0, -2, -1, 2, 1, 0],
-        [0, 2, -1, -2, 1, 0],
-        [0, 4, 0, -5, 0, 1],
-    ],
-    np.float32,
+_WG_F4 = (
+    4,
+    np.array(
+        [
+            [4, 0, -5, 0, 1, 0],
+            [0, -4, -4, 1, 1, 0],
+            [0, 4, -4, -1, 1, 0],
+            [0, -2, -1, 2, 1, 0],
+            [0, 2, -1, -2, 1, 0],
+            [0, 4, 0, -5, 0, 1],
+        ],
+        np.float32,
+    ),
+    np.array(
+        [
+            [1 / 4, 0, 0],
+            [-1 / 6, -1 / 6, -1 / 6],
+            [-1 / 6, 1 / 6, -1 / 6],
+            [1 / 24, 1 / 12, 1 / 6],
+            [1 / 24, -1 / 12, 1 / 6],
+            [0, 0, 1],
+        ],
+        np.float32,
+    ),
+    np.array(
+        [
+            [1, 1, 1, 1, 1, 0],
+            [0, 1, -1, 2, -2, 0],
+            [0, 1, 1, 4, 4, 0],
+            [0, 1, -1, 8, -8, 1],
+        ],
+        np.float32,
+    ),
 )
-_WG_G = np.array(
-    [
-        [1 / 4, 0, 0],
-        [-1 / 6, -1 / 6, -1 / 6],
-        [-1 / 6, 1 / 6, -1 / 6],
-        [1 / 24, 1 / 12, 1 / 6],
-        [1 / 24, -1 / 12, 1 / 6],
-        [0, 0, 1],
-    ],
-    np.float32,
-)
-_WG_AT = np.array(
-    [
-        [1, 1, 1, 1, 1, 0],
-        [0, 1, -1, 2, -2, 0],
-        [0, 1, 1, 4, 4, 0],
-        [0, 1, -1, 8, -8, 1],
-    ],
-    np.float32,
+_WG_F2 = (
+    2,
+    np.array(
+        [
+            [1, 0, -1, 0],
+            [0, 1, 1, 0],
+            [0, -1, 1, 0],
+            [0, 1, 0, -1],
+        ],
+        np.float32,
+    ),
+    np.array(
+        [
+            [1, 0, 0],
+            [1 / 2, 1 / 2, 1 / 2],
+            [1 / 2, -1 / 2, 1 / 2],
+            [0, 0, 1],
+        ],
+        np.float32,
+    ),
+    np.array(
+        [
+            [1, 1, 1, 0],
+            [0, 1, -1, -1],
+        ],
+        np.float32,
+    ),
 )
 
 
-def _conv_winograd3(x, w, py: int, px: int):
-    """3x3 stride-1 conv via Winograd F(4x4, 3x3) — 2.25x fewer MACs
-    per output than direct (36 taps per 16 outputs vs 81), i.e. 4x
-    fewer than the 9-tap im2col GEMM XLA:TPU lowers to (no Winograd
+def _conv_winograd3(x, w, py: int, px: int, variant: int = 1):
+    """3x3 stride-1 conv via Winograd F(mxm, 3x3) — fewer MACs per
+    output than the 9-tap im2col GEMM XLA:TPU lowers to (no Winograd
     rewrite in XLA; the cuDNN fast path the reference gets for free,
     ``cudnn_convolution_layer-inl.hpp``, re-derived as pure XLA ops).
 
     Everything is jnp — tile extraction as strided slices, the two
-    small 6x6 transforms as f32 einsums (VPU work, fused by XLA), and
-    the one heavy contraction as a 36-way batched GEMM in the input
-    dtype with f32 accumulation — so XLA keeps fusing around it; no
-    custom-call fence (the round-3 Pallas-pool lesson,
+    small (m+2)x(m+2) transforms as f32 einsums (VPU work, fused by
+    XLA), and the one heavy contraction as an (m+2)²-way batched GEMM
+    in the input dtype with f32 accumulation — so XLA keeps fusing
+    around it; no custom-call fence (the round-3 Pallas-pool lesson,
     doc/performance.md "Isolated-kernel wins do not survive fusion").
 
-    Numerics: input/inverse transforms in f32 (B^T/A^T are small-int
-    matrices but 6-term sums lose bf16 bits), GEMM operands cast back
-    to ``x.dtype``.  Autodiff reverses the whole pipeline, so the
-    backward is Winograd too (the transposed transforms).
+    Numerics: input/inverse transforms in f32, GEMM operands cast back
+    to ``x.dtype`` (see the tile-size tradeoff at the matrices above).
+    Autodiff reverses the whole pipeline, so the backward is Winograd
+    too (the transposed transforms).
     """
+    m, bt, g, at = _WG_F2 if variant == 2 else _WG_F4
+    a = m + 2  # input tile edge
     n, h, wd, c = x.shape
     o = w.shape[3]
     oh, ow = h + 2 * py - 2, wd + 2 * px - 2
-    th, tw = -(-oh // 4), -(-ow // 4)
-    # padded extent must cover the last tile: 4*(t-1) + 6
+    th, tw = -(-oh // m), -(-ow // m)
+    # padded extent must cover the last tile: m*(t-1) + a
     xp = jnp.pad(
         x,
-        ((0, 0), (py, 4 * th + 2 - h - py), (px, 4 * tw + 2 - wd - px),
+        ((0, 0), (py, m * th + 2 - h - py), (px, m * tw + 2 - wd - px),
          (0, 0)),
     )
-    # d[n, t, u, c, i, j] = xp[n, 4t+i, 4u+j, c]: 36 strided slices
+    # d[n, t, u, c, i, j] = xp[n, m*t+i, m*u+j, c]: a*a strided slices
     d = jnp.stack(
         [
             jnp.stack(
-                [xp[:, i:i + 4 * th:4, j:j + 4 * tw:4, :] for j in range(6)],
+                [xp[:, i:i + m * th:m, j:j + m * tw:m, :] for j in range(a)],
                 axis=-1,
             )
-            for i in range(6)
+            for i in range(a)
         ],
         axis=-2,
-    )  # (N, th, tw, C, 6i, 6j)
+    )  # (N, th, tw, C, a_i, a_j)
     v = jnp.einsum(
         "ai,ntucij,bj->abntuc",
-        _WG_BT, d.astype(jnp.float32), _WG_BT,
+        bt, d.astype(jnp.float32), bt,
     ).astype(x.dtype)
     u = jnp.einsum(
         "ak,klco,bl->abco",
-        _WG_G, w.astype(jnp.float32), _WG_G,
+        g, w.astype(jnp.float32), g,
     ).astype(x.dtype)
-    # the MXU part: 36 batched (N*th*tw, C) x (C, O) GEMMs
-    m = jnp.einsum(
+    # the MXU part: a² batched (N*th*tw, C) x (C, O) GEMMs
+    mm = jnp.einsum(
         "abntuc,abco->abntuo", v, u,
         preferred_element_type=jnp.float32,
     )
-    y = jnp.einsum("pa,abntuo,qb->ntupqo", _WG_AT, m, _WG_AT)
-    y = y.transpose(0, 1, 3, 2, 4, 5).reshape(n, 4 * th, 4 * tw, o)
+    y = jnp.einsum("pa,abntuo,qb->ntupqo", at, mm, at)
+    y = y.transpose(0, 1, 3, 2, 4, 5).reshape(n, m * th, m * tw, o)
     return y[:, :oh, :ow, :].astype(x.dtype)
 
 
@@ -215,12 +256,18 @@ class ConvolutionLayer(Layer):
     def __init__(self) -> None:
         super().__init__()
         self.conv_s2d = 0  # opt-in space-to-depth rewrite (any stride>1)
-        self.conv_wino = 0  # opt-in Winograd F(4x4,3x3) for 3x3 s1 convs
+        # opt-in Winograd for 3x3 s1 convs: 1 = F(4x4), 2 = F(2x2)
+        self.conv_wino = 0
 
     def set_param(self, name, val):
         if name == "conv_s2d":
             self.conv_s2d = int(val)
         elif name == "conv_wino":
+            if val not in ("0", "1", "2"):
+                raise ValueError(
+                    f"conv_wino must be 0 (off), 1 (F4x4) or 2 (F2x2), "
+                    f"got {val!r}"
+                )
             self.conv_wino = int(val)
         else:
             super().set_param(name, val)
@@ -269,7 +316,8 @@ class ConvolutionLayer(Layer):
             # cin < 8 (e.g. a VGG conv1_1 RGB input) keeps the direct
             # path: the Winograd GEMM contracts over K = cin, and K=3
             # starves the MXU worse than the 9-tap im2col's K=27
-            y = _conv_winograd3(x, params["wmat"], p.pad_y, p.pad_x)
+            y = _conv_winograd3(x, params["wmat"], p.pad_y, p.pad_x,
+                                variant=self.conv_wino)
         elif self.conv_s2d and p.stride > 1 and p.num_group == 1:
             y = _conv_s2d(x, params["wmat"].astype(x.dtype), p.stride,
                           p.pad_y, p.pad_x)

@@ -449,3 +449,50 @@ def test_check_weight_sync_covers_sharded_params():
     tr.params["zz_corrupt"] = {"wmat": bad}
     with pytest.raises(RuntimeError, match="sharded weights have diverged"):
         tr.check_weight_sync()
+
+
+WINO_CFG = [
+    ("dev", "tpu:0-{n}"),
+    ("batch_size", "16"),
+    ("input_shape", "8,12,12"),
+    ("eta", "0.1"),
+    ("momentum", "0.9"),
+    ("netconfig", "start"),
+    ("layer[0->1]", "conv:cv1"),
+    ("kernel_size", "3"),
+    ("stride", "1"),
+    ("pad", "1"),
+    ("nchannel", "8"),
+    ("random_type", "xavier"),
+    ("conv_wino", "1"),
+    ("layer[1->1]", "relu"),
+    ("layer[1->2]", "flatten"),
+    ("layer[2->3]", "fullc:fc"),
+    ("nhidden", "4"),
+    ("random_type", "xavier"),
+    ("layer[3->3]", "softmax"),
+    ("netconfig", "end"),
+]
+
+
+@pytest.mark.parametrize("mp", [1, 2])
+def test_winograd_conv_matches_single_under_mesh(mp):
+    """conv_wino composes with DP (and DP x TP) sharding: training over
+    the 8-device mesh equals the 1-device run, same discipline as the
+    conv_s2d/matmul-LRN SPMD parity test."""
+    def train(ndev):
+        cfg = [(k, v.format(n=ndev - 1) if k == "dev" else v)
+               for k, v in WINO_CFG]
+        tr = NetTrainer()
+        tr.set_params(cfg + ([("model_parallel", str(mp))]
+                             if ndev > 1 else []))
+        tr.init_model()
+        rng = np.random.RandomState(5)
+        for _ in range(3):
+            tr.update_all(rng.randn(16, 12, 12, 8).astype(np.float32),
+                          rng.randint(0, 4, (16, 1)).astype(np.float32))
+        return tr
+
+    t1, t8 = train(1), train(8)
+    assert t8.net.layer_objs[0].conv_wino == 1
+    _assert_params_close(t1, t8, "1- and 8-device winograd runs")

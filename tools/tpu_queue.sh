@@ -38,7 +38,7 @@ run() {
   run 1500 python tools/resnet_bisect.py base stems2d wino
   run 1500 python bench.py --resnet
   run 1500 python bench.py --vgg
-  run 1500 python bench.py --vgg --wino
+  run 3000 python tools/vgg_bisect.py wino wino2 wino345 wino45
   run 1800 python bench.py --flash
   run 1500 python bench.py --alexnet
   # the one integration never yet exercised on chip: CLI train with the
