@@ -121,7 +121,8 @@ def _conv_s2d(x, w, s: int, py: int, px: int):
 #   tradeoff; cuDNN's fp16 winograd has the same profile);
 # * 2 -> F(2x2): 16 taps per 4 outputs = 4 MACs/output (a 2.25x
 #   reduction), transform constants in {0, +-1, 1/2} — error within
-#   ~2x of the direct bf16 conv.  The numerics escape hatch.
+#   ~3x of the direct bf16 conv (the tested bound).  The numerics
+#   escape hatch.
 #
 # B^T/A^T products are bf16-exact or near-exact; G carries fractions,
 # so U = GwG^T is computed in f32 and cast once.

@@ -450,3 +450,92 @@ def test_two_process_sharded_eval_matches_single(tmp_path):
         assert p.returncode == 0, (so + se).decode()
     lines = [eval_line(se) for _, se in outs]
     assert lines[0] == lines[1] == single, (lines, single)
+
+
+WORKER_SHARDED = textwrap.dedent(
+    """
+    import os, sys
+    import numpy as np
+    rank = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+    os.environ["CXN_COORDINATOR"] = f"localhost:{port}"
+    os.environ["CXN_NUM_PROC"] = str(nproc)
+    os.environ["CXN_PROC_ID"] = str(rank)
+    from cxxnet_tpu.parallel import maybe_init_distributed
+    assert maybe_init_distributed([])
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.io.data import DataBatch
+    ndev = len(jax.local_devices())
+    cfg = [("dev", f"cpu:0-{nproc*ndev-1}"), ("batch_size", "16"),
+           ("input_shape", "1,1,10"), ("seed", "7"), ("eta", "0.1"),
+           ("model_parallel", "2"),
+           ("netconfig", "start"), ("layer[0->1]", "fullc:fc1"),
+           ("nhidden", "8"), ("layer[1->2]", "softmax"),
+           ("netconfig", "end")]
+    tr = NetTrainer(); tr.set_params(cfg); tr.init_model()
+    rng = np.random.RandomState(100 + rank)
+    x = rng.randn(16 // nproc, 10).astype(np.float32)
+    y = rng.randint(0, 8, size=(16 // nproc, 1)).astype(np.float32)
+    tr.update(DataBatch(data=x, label=y))
+    sharded = [l for l in jax.tree_util.tree_leaves(tr.params)
+               if not l.sharding.is_fully_replicated]
+    assert sharded, "expected TP-sharded leaves in this config"
+    # healthy: every replica of every logical slice agrees, everywhere
+    assert tr.check_weight_sync() == 0.0
+    # corrupt rank 1's local replica of ONE model-axis shard; the
+    # allgathered slice-keyed fingerprints must diverge on EVERY process
+    mesh = tr.mesh_plan.mesh
+    sh = NamedSharding(mesh, P("model", None))
+    shape = (8, 4)
+    base = np.arange(32, dtype=np.float32).reshape(shape)
+    bufs = []
+    items = sorted(
+        ((d, idx) for d, idx in sh.devices_indices_map(shape).items()
+         if d.process_index == jax.process_index()),
+        key=lambda kv: kv[0].id,
+    )
+    for k, (d, idx) in enumerate(items):
+        local = base[idx].copy()
+        if rank == 1 and k == 0:
+            local[0, 0] += 0.5
+        bufs.append(jax.device_put(local, d))
+    tr.params["zz_corrupt"] = {
+        "wmat": jax.make_array_from_single_device_arrays(shape, sh, bufs)
+    }
+    try:
+        tr.check_weight_sync()
+        raise SystemExit("sharded divergence not detected")
+    except RuntimeError as e:
+        assert "sharded weights have diverged" in str(e), str(e)
+    print("rank", rank, "ok")
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_sharded_weight_sync(tmp_path):
+    """The cross-process branch of the shard-granular sync check: a
+    2x2 (data x model) mesh over 2 processes puts replicas of the same
+    TP shard on DIFFERENT processes; the check passes healthy and
+    detects a single corrupted remote replica on every rank."""
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER_SHARDED)
+    port = _free_port()
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(r), "2", str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for r in range(2)
+    ]
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, o.decode()
+        assert b"ok" in o

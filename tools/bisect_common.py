@@ -1,0 +1,53 @@
+"""Shared scaffold for the step-time bisection tools.
+
+One place for what used to be three verbatim copies (googlenet/resnet/
+vgg): the persistent-cache config, the bench-harness timing loop, and —
+critically — the same fail-fast discipline as ``bench.py`` itself
+(relay probe before jax init, watchdog thread), so a mid-queue relay
+death produces a stage-named diagnostic in seconds instead of burning
+the entry's full timeout budget at 0% CPU (the round-3 rc=124 mode).
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CACHE_DIR = os.path.join(REPO, ".jax_cache")
+
+
+def run_bisect(variant_conf, default_names, batch: int = 128,
+               scan_k: int = 30) -> None:
+    """Probe/arm, configure the cache, and time each requested variant
+    with the bench harness (so bisect numbers stay comparable to
+    ``bench.py`` numbers)."""
+    import bench
+
+    if bench._tpu_expected() and not bench._probe_relay():
+        bench._emit_error(
+            "relay dead: refusing to dial the TPU tunnel from a bisect tool"
+        )
+        raise SystemExit(0)
+    bench._arm_watchdog()
+    try:
+        import jax
+
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+        from bench import _bench_imagenet_conf
+
+        for name in sys.argv[1:] or default_names:
+            bench._set_stage(f"bisect:{name}")
+            _bench_imagenet_conf(
+                f"bisect:{name}", name, variant_conf(name, batch),
+                batch, scan_k,
+            )
+    finally:
+        bench._STAGE["done"] = True
+        wd = bench._STAGE.get("watchdog")
+        if wd is not None:
+            wd.cancel()

@@ -20,7 +20,7 @@ Variants (default: all):
 * stems2d   — the 7x7 s2 stem conv via the space-to-depth rewrite
               (``conv_s2d = 1``): the stem-conv A/B
 * wino      — every 3x3 s1 conv via Winograd F(4x4,3x3)
-              (``conv_wino = 1`` global): 2.25x fewer MACs on the
+              (``conv_wino = 1`` global): 4x fewer MACs on the
               inception 3x3 branches
 """
 
@@ -29,10 +29,6 @@ import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-CACHE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
-)
 
 
 def _sub(conf: str, old: str, new: str) -> str:
@@ -95,27 +91,10 @@ def variant_conf(name: str, batch: int) -> str:
     raise SystemExit(f"unknown variant {name}")
 
 
-def time_variant(name: str, batch: int = 128, scan_k: int = 50) -> float:
-    from bench import _bench_imagenet_conf
-
-    return _bench_imagenet_conf(
-        f"bisect:{name}", name, variant_conf(name, batch), batch, scan_k
-    )
-
-
-def main() -> None:
-    import jax
-
-    os.makedirs(CACHE_DIR, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-
-    names = sys.argv[1:] or ["base", "lrnmm", "nolrn", "stem1x1",
-                             "conv1x1", "stems2d", "wino"]
-    for name in names:
-        time_variant(name)
-
-
 if __name__ == "__main__":
-    main()
+    from bisect_common import run_bisect
+
+    run_bisect(variant_conf,
+               ["base", "lrnmm", "nolrn", "stem1x1", "conv1x1",
+                "stems2d", "wino"],
+               scan_k=50)

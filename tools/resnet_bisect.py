@@ -15,10 +15,6 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-CACHE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
-)
-
 
 def _sub(conf: str, old: str, new: str) -> str:
     """str.replace that refuses to silently no-op: a drifted builder
@@ -78,29 +74,10 @@ def variant_conf(name: str, batch: int) -> str:
     raise SystemExit(f"unknown variant {name}")
 
 
-def time_variant(name: str, batch: int = 128, scan_k: int = 30) -> float:
-    # the bench harness itself, so variant numbers stay comparable to
-    # `bench.py --resnet`
-    from bench import _bench_imagenet_conf
-
-    return _bench_imagenet_conf(
-        f"bisect:{name}", name, variant_conf(name, batch), batch, scan_k
-    )
-
-
-def main() -> None:
-    import jax
-
-    os.makedirs(CACHE_DIR, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-
-    names = sys.argv[1:] or ["base", "onepass", "nobn", "noavg",
-                             "nomaxpool", "stems2d", "wino"]
-    for name in names:
-        time_variant(name)
-
-
 if __name__ == "__main__":
-    main()
+    from bisect_common import run_bisect
+
+    run_bisect(variant_conf,
+               ["base", "onepass", "nobn", "noavg", "nomaxpool",
+                "stems2d", "wino"],
+               scan_k=30)
