@@ -535,7 +535,12 @@ def test_two_process_sharded_weight_sync(tmp_path):
         )
         for r in range(2)
     ]
-    outs = [p.communicate(timeout=180)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=180)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for p, o in zip(procs, outs):
         assert p.returncode == 0, o.decode()
         assert b"ok" in o

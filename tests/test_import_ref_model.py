@@ -1,0 +1,180 @@
+"""Importing reference cxxnet binary checkpoints (tools/import_ref_model).
+
+The fixture writer below re-implements the reference's serialization
+independently from the parser, straight from the cited sources
+(cxxnet_main.cpp:173-181, nnet_config.h:126-145, utils/io.h:43-74,
+layer SaveModel overrides), so parser bugs can't cancel out.
+"""
+
+import os
+import struct
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from import_ref_model import install, parse_ref_model  # noqa: E402
+
+CONF = """
+netconfig = start
+layer[0->1] = conv:c1
+  kernel_size = 3
+  pad = 1
+  nchannel = 4
+layer[1->2] = batch_norm:bn1
+layer[2->3] = prelu:pr1
+layer[3->4] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[4->5] = flatten
+layer[5->6] = fullc:fc1
+  nhidden = 6
+layer[6->6] = softmax
+netconfig = end
+input_shape = 3,8,8
+batch_size = 4
+dev = cpu
+"""
+
+
+def _s(b: bytes) -> bytes:
+    return struct.pack("<Q", len(b)) + b
+
+
+def _vec_i32(v) -> bytes:
+    return struct.pack("<Q", len(v)) + struct.pack(f"<{len(v)}i", *v)
+
+
+def _layer_param(**kw) -> bytes:
+    """LayerParam per param.h field order (0-based positions):
+    0 num_hidden, 1 init_sigma(f), 2 init_sparse, 3 init_uniform(f),
+    4 init_bias(f), 5 num_channel, 6 random_type, 7 num_group,
+    8 kernel_height, 9 kernel_width, 10 stride, 11 pad_y, 12 pad_x,
+    13 no_bias, 14 temp_col_max, 15 silent, 16 num_input_channel,
+    17 num_input_node, then 64 reserved."""
+    full = [0] * 82
+    full[0] = kw.get("num_hidden", 0)
+    full[5] = kw.get("num_channel", 0)
+    full[7] = kw.get("num_group", 1)
+    full[8] = kw.get("kernel_height", 0)
+    full[9] = kw.get("kernel_width", 0)
+    full[13] = kw.get("no_bias", 0)
+    full[17] = kw.get("num_input_node", 0)
+    return struct.pack("<82i", *full)
+
+
+def _tensor(arr: np.ndarray, with_stride: bool) -> bytes:
+    out = struct.pack(f"<{arr.ndim}I", *arr.shape)
+    if with_stride:
+        out += struct.pack("<I", arr.shape[-1])
+    return out + arr.astype("<f4").tobytes()
+
+
+def _write_model(path, with_stride: bool, seed=0):
+    rng = np.random.RandomState(seed)
+    w = {
+        "c1_w": rng.randn(1, 4, 3 * 3 * 3).astype(np.float32),
+        "c1_b": rng.randn(4).astype(np.float32),
+        "bn_s": rng.randn(4).astype(np.float32),
+        "bn_b": rng.randn(4).astype(np.float32),
+        "pr_s": rng.randn(4).astype(np.float32),
+        "fc_w": rng.randn(6, 64).astype(np.float32),
+        "fc_b": rng.randn(6).astype(np.float32),
+    }
+    # blob: layers in order; only SaveModel-overriders contribute
+    blob = b""
+    blob += _layer_param(num_channel=4, num_group=1, kernel_height=3,
+                         kernel_width=3)
+    blob += _tensor(w["c1_w"], with_stride) + _tensor(w["c1_b"], with_stride)
+    blob += _tensor(w["bn_s"], with_stride) + _tensor(w["bn_b"], with_stride)
+    blob += _tensor(w["pr_s"], with_stride)
+    blob += _layer_param(num_hidden=6, num_input_node=64)
+    blob += _tensor(w["fc_w"], with_stride) + _tensor(w["fc_b"], with_stride)
+
+    layers = [
+        (10, "c1"), (30, "bn1"), (29, "pr1"), (11, ""), (7, ""),
+        (1, "fc1"), (2, ""),
+    ]
+    out = struct.pack("<i", 0)                      # net_type
+    out += struct.pack("<4i", 8, len(layers), 1, 0)  # NetParam head
+    out += b"\0" * (31 * 4)                          # reserved
+    for k in range(8):
+        out += _s(f"node{k}".encode())
+    for k, (tid, name) in enumerate(layers):
+        out += struct.pack("<ii", tid, -1)
+        out += _s(name.encode())
+        out += _vec_i32([k]) + _vec_i32([k + 1])
+    out += struct.pack("<q", 42)                     # epoch_counter
+    out += _s(blob)
+    with open(path, "wb") as f:
+        f.write(out)
+    return w
+
+
+def _build_trainer():
+    from cxxnet_tpu import config as cfgmod
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    tr = NetTrainer()
+    tr.set_params(cfgmod.parse_pairs(CONF))
+    tr.init_model()
+    return tr
+
+
+@pytest.mark.parametrize("with_stride", [False, True])
+def test_import_roundtrip(tmp_path, with_stride):
+    """Both mshadow Shape encodings parse (auto-detected), and every
+    weighted layer lands bit-exactly in the conf-built trainer."""
+    path = str(tmp_path / "ref.model")
+    w = _write_model(path, with_stride)
+    net_type, _nodes, infos, epoch, weights = parse_ref_model(path)
+    assert net_type == 0 and epoch == 42
+    assert [i["type_name"] for i in infos] == [
+        "conv", "batch_norm", "prelu", "max_pooling", "flatten",
+        "fullc", "softmax"]
+
+    tr = _build_trainer()
+    assert install(tr, infos, weights) == 4  # c1, bn1, pr1, fc1
+    np.testing.assert_array_equal(
+        tr.get_weight("c1", "wmat"), w["c1_w"].reshape(4, 27))
+    np.testing.assert_array_equal(tr.get_weight("c1", "bias"),
+                                  w["c1_b"][None, :])
+    np.testing.assert_array_equal(tr.get_weight("bn1", "wmat"),
+                                  w["bn_s"][None, :])
+    np.testing.assert_array_equal(tr.get_weight("bn1", "bias"),
+                                  w["bn_b"][None, :])
+    np.testing.assert_array_equal(tr.get_weight("pr1", "bias"),
+                                  w["pr_s"][None, :])
+    np.testing.assert_array_equal(tr.get_weight("fc1", "wmat"), w["fc_w"])
+    # and the installed model saves/loads as a native checkpoint
+    out = str(tmp_path / "out.model")
+    tr.save_model(out)
+    tr2 = _build_trainer()
+    tr2.load_model(out)
+    np.testing.assert_array_equal(tr2.get_weight("fc1", "wmat"), w["fc_w"])
+
+
+def test_import_type_mismatch_rejected(tmp_path):
+    """A conf whose layer type disagrees with the binary is refused."""
+    path = str(tmp_path / "ref.model")
+    _write_model(path, with_stride=False)
+    _, _, infos, _, weights = parse_ref_model(path)
+    from cxxnet_tpu import config as cfgmod
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    bad = CONF.replace("batch_norm:bn1", "xelu:bn1")
+    tr = NetTrainer()
+    tr.set_params(cfgmod.parse_pairs(bad))
+    tr.init_model()
+    with pytest.raises(ValueError, match="conf says"):
+        install(tr, infos, weights)
+
+
+def test_import_garbage_rejected(tmp_path):
+    path = tmp_path / "junk.model"
+    path.write_bytes(b"\xff" * 64)
+    with pytest.raises(ValueError):
+        parse_ref_model(str(path))

@@ -29,7 +29,10 @@ def run_bisect(variant_conf, default_names, batch: int = 128,
             "relay dead: refusing to dial the TPU tunnel from a bisect tool"
         )
         raise SystemExit(0)
-    bench._arm_watchdog()
+    names = sys.argv[1:] or default_names
+    # one single-run deadline per variant: a healthy multi-variant sweep
+    # must never be killed by the single-run default
+    bench._arm_watchdog(len(names) * bench.WATCHDOG_SEC)
     try:
         import jax
 
@@ -40,7 +43,7 @@ def run_bisect(variant_conf, default_names, batch: int = 128,
 
         from bench import _bench_imagenet_conf
 
-        for name in sys.argv[1:] or default_names:
+        for name in names:
             bench._set_stage(f"bisect:{name}")
             _bench_imagenet_conf(
                 f"bisect:{name}", name, variant_conf(name, batch),

@@ -1,0 +1,289 @@
+"""Import a reference cxxnet binary ``.model`` checkpoint.
+
+Migration path for reference users: the original ``.conf`` (which the
+reference itself also requires at load time — ``LoadNet`` restores only
+structure + weights, per-layer configs come from the conf,
+``/root/reference/src/cxxnet_main.cpp:159-170``) plus the binary
+``.model`` produce a cxxnet-tpu checkpoint with identical weights.
+
+    python tools/import_ref_model.py <conf> <ref.model> <out.model>
+
+Binary layout (all little-endian; cited from the reference sources):
+
+* ``int32 net_type``                       (cxxnet_main.cpp:177)
+* ``NetConfig::NetParam`` — 4 int32 fields (num_nodes, num_layers,
+  init_end, extra_data_num) + 31 reserved int32 (nnet_config.h:28-41)
+* if extra_data_num: ``vector<int> extra_shape`` (uint64 count +
+  int32s, utils/io.h:43-48)
+* ``num_nodes`` x string (uint64 len + bytes, utils/io.h:69-74)
+* ``num_layers`` x { int32 LayerType, int32 primary_layer_index,
+  string name, vector<int32> nindex_in, vector<int32> nindex_out }
+  (nnet_config.h:126-145)
+* ``int64 epoch_counter``                  (nnet_impl-inl.hpp:85,420)
+* ``string model_blob`` — concatenated per-layer payloads, only for
+  layers that override SaveModel (layer sources):
+  - fullc:      LayerParam + wmat(2d) + bias(1d)   (fullc_layer:46-50)
+  - conv:       LayerParam + wmat(3d) + bias(1d)   (convolution_layer)
+  - bias:       LayerParam + bias(1d)              (bias_layer)
+  - batch_norm: slope(1d) + bias(1d)               (batch_norm_layer)
+  - prelu:      slope(1d)                          (prelu_layer)
+  LayerParam = 18 int32/float32 fields + 64 reserved int32 = 328 bytes
+  (layer/param.h:15-53).
+
+mshadow ``SaveBinary`` writes ``Shape<dim>`` then the row-contiguous
+f32 data.  Depending on the mshadow revision the reference was built
+against, ``sizeof(Shape<dim>)`` is either ``dim`` uint32s (shape only)
+or ``dim+1`` (a trailing ``stride_``); the parser tries the shape-only
+encoding first and falls back — each layer's expected element count is
+derivable from its LayerParam, so a wrong hypothesis fails loudly
+instead of misreading.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# reference layer.h:284-313
+LAYER_TYPES = {
+    0: "shared", 1: "fullc", 2: "softmax", 3: "relu", 4: "sigmoid",
+    5: "tanh", 6: "softplus", 7: "flatten", 8: "dropout", 10: "conv",
+    11: "max_pooling", 12: "sum_pooling", 13: "avg_pooling", 15: "lrn",
+    17: "bias", 18: "concat", 19: "xelu", 20: "caffe",
+    21: "relu_max_pooling", 23: "split", 24: "insanity",
+    25: "insanity_max_pooling", 26: "l2_loss", 27: "multi_logistic",
+    28: "ch_concat", 29: "prelu", 30: "batch_norm", 31: "fixconn",
+}
+PAIRTEST_GAP = 1024  # layer.h:315
+
+LAYER_PARAM_BYTES = (18 + 64) * 4  # param.h:15-53
+
+
+class Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.at = 0
+
+    def raw(self, n: int) -> bytes:
+        if self.at + n > len(self.data):
+            raise ValueError(
+                f"truncated reference model: need {n} bytes at "
+                f"offset {self.at}, have {len(self.data) - self.at}"
+            )
+        out = self.data[self.at:self.at + n]
+        self.at += n
+        return out
+
+    def i32(self) -> int:
+        return struct.unpack("<i", self.raw(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack("<q", self.raw(8))[0]
+
+    def u32s(self, n: int) -> Tuple[int, ...]:
+        return struct.unpack(f"<{n}I", self.raw(4 * n))
+
+    def string(self) -> bytes:
+        (n,) = struct.unpack("<Q", self.raw(8))
+        return self.raw(n)
+
+    def vec_i32(self) -> List[int]:
+        (n,) = struct.unpack("<Q", self.raw(8))
+        return list(struct.unpack(f"<{n}i", self.raw(4 * n)))
+
+    def f32_array(self, count: int) -> np.ndarray:
+        return np.frombuffer(self.raw(4 * count), "<f4").copy()
+
+    def done(self) -> bool:
+        return self.at == len(self.data)
+
+
+def _read_layer_param(r: Reader) -> Dict[str, int]:
+    """The handful of LayerParam fields the importer needs (param.h
+    field order; floats skipped positionally)."""
+    raw = r.raw(LAYER_PARAM_BYTES)
+    ints = struct.unpack("<82i", raw)
+    return {
+        "num_hidden": ints[0], "num_channel": ints[5],
+        "num_group": ints[7], "kernel_height": ints[8],
+        "kernel_width": ints[9], "no_bias": ints[13],
+        "num_input_node": ints[17],
+    }
+
+
+def _read_tensor(r: Reader, dim: int, with_stride: bool,
+                 expect: Optional[Tuple[int, ...]] = None) -> np.ndarray:
+    shape = r.u32s(dim)
+    if with_stride:
+        r.u32s(1)  # Shape<dim>::stride_ — not needed, rows are contiguous
+    if expect is not None and tuple(shape) != tuple(expect):
+        raise ValueError(
+            f"tensor shape {shape} != expected {expect} "
+            "(wrong mshadow Shape encoding?)"
+        )
+    if any(s <= 0 or s > 1 << 28 for s in shape):
+        raise ValueError(f"implausible tensor shape {shape}")
+    n = int(np.prod(shape))
+    return r.f32_array(n).reshape(shape)
+
+
+def parse_ref_model(path: str, with_stride: Optional[bool] = None):
+    """-> (net_type, layer_infos, epoch, weights) where layer_infos is
+    [{type_id, type_name, primary, name, nin, nout}] and weights is
+    {layer_name: {tag: np.ndarray}} in the reference's native layouts."""
+    blob = open(path, "rb").read()
+    r = Reader(blob)
+    net_type = r.i32()
+    num_nodes, num_layers, _init_end, extra_data_num = (
+        r.i32(), r.i32(), r.i32(), r.i32())
+    r.raw(31 * 4)  # NetParam.reserved
+    if not (0 < num_nodes < 1 << 20 and 0 < num_layers < 1 << 20):
+        raise ValueError(f"{path}: not a reference cxxnet model "
+                         f"(nodes={num_nodes}, layers={num_layers})")
+    if extra_data_num:
+        r.vec_i32()
+    node_names = [r.string().decode() for _ in range(num_nodes)]
+    infos = []
+    for _ in range(num_layers):
+        tid = r.i32()
+        primary = r.i32()
+        name = r.string().decode()
+        nin = r.vec_i32()
+        nout = r.vec_i32()
+        base = tid - PAIRTEST_GAP if tid >= PAIRTEST_GAP else tid
+        if base not in LAYER_TYPES:
+            raise ValueError(f"unknown reference LayerType {tid}")
+        infos.append({
+            "type_id": tid, "type_name": LAYER_TYPES[base],
+            "primary": primary, "name": name, "nin": nin, "nout": nout,
+        })
+    epoch = r.i64()
+    model_blob = r.string()
+
+    if with_stride is None:
+        # disambiguate the mshadow Shape encoding on the actual payload
+        try:
+            weights = _parse_blob(model_blob, infos, with_stride=False)
+        except ValueError:
+            weights = _parse_blob(model_blob, infos, with_stride=True)
+    else:
+        weights = _parse_blob(model_blob, infos, with_stride)
+    return net_type, node_names, infos, epoch, weights
+
+
+def _parse_blob(blob: bytes, infos, with_stride: bool):
+    r = Reader(blob)
+    weights: Dict[str, Dict[str, np.ndarray]] = {}
+    for li, info in enumerate(infos):
+        t = info["type_name"]
+        if info["type_id"] >= PAIRTEST_GAP:
+            raise ValueError("pairtest checkpoints are not importable "
+                             "(debug-only composition)")
+        key = info["name"] or f"layer{li}"
+        if t == "fullc":
+            p = _read_layer_param(r)
+            w = _read_tensor(r, 2, with_stride,
+                             (p["num_hidden"], p["num_input_node"]))
+            b = _read_tensor(r, 1, with_stride, (p["num_hidden"],))
+            weights[key] = {"wmat": w, "bias": b, "_param": p}
+        elif t == "conv":
+            p = _read_layer_param(r)
+            g = max(1, p["num_group"])
+            cout_g = p["num_channel"] // g
+            w = _read_tensor(r, 3, with_stride)
+            if w.shape[0] != g or w.shape[1] != cout_g:
+                raise ValueError(
+                    f"conv {key}: wmat shape {w.shape} inconsistent with "
+                    f"LayerParam (g={g}, cout_g={cout_g})"
+                )
+            b = _read_tensor(r, 1, with_stride, (p["num_channel"],))
+            weights[key] = {"wmat": w, "bias": b, "_param": p}
+        elif t == "bias":
+            p = _read_layer_param(r)
+            weights[key] = {
+                "bias": _read_tensor(r, 1, with_stride), "_param": p}
+        elif t == "batch_norm":
+            s = _read_tensor(r, 1, with_stride)
+            b = _read_tensor(r, 1, with_stride, tuple(s.shape))
+            weights[key] = {"wmat": s, "bias": b}
+        elif t == "prelu":
+            weights[key] = {"bias": _read_tensor(r, 1, with_stride)}
+        # every other type saves nothing (layer.h:273 default)
+    if not r.done():
+        raise ValueError(
+            f"model blob has {len(blob) - r.at} unconsumed bytes — "
+            "wrong Shape encoding or unsupported layer payload"
+        )
+    return weights
+
+
+def install(tr, infos, weights) -> int:
+    """Install parsed reference weights into a conf-built NetTrainer,
+    checking the binary's graph against the conf's."""
+    g = tr.graph
+    ref_named = {i["name"]: i for i in infos if i["name"]}
+    n_set = 0
+    for i, spec in enumerate(g.layers):
+        if not spec.name or spec.name not in ref_named:
+            continue
+        info = ref_named[spec.name]
+        if info["type_name"] != spec.type_name and spec.type_name != "shared":
+            raise ValueError(
+                f"layer {spec.name}: conf says {spec.type_name}, "
+                f"reference model says {info['type_name']}"
+            )
+        w = weights.get(spec.name)
+        if not w:
+            continue
+        if spec.type_name == "conv":
+            p = w["_param"]
+            gg = max(1, p["num_group"])
+            # (g, cout_g, cin_g*kh*kw) -> the visitor's (cout, cin_g*kh*kw)
+            tr.set_weight(w["wmat"].reshape(gg * w["wmat"].shape[1], -1),
+                          spec.name, "wmat")
+            if not p["no_bias"]:
+                tr.set_weight(w["bias"], spec.name, "bias")
+        else:
+            for tag in ("wmat", "bias"):
+                if tag in w:
+                    tr.set_weight(w[tag], spec.name, tag)
+        n_set += 1
+    if n_set == 0:
+        raise ValueError(
+            "no layer of the conf matched a weighted layer in the "
+            "reference model — check that conf and model belong together"
+        )
+    return n_set
+
+
+def main() -> None:
+    if len(sys.argv) != 4:
+        raise SystemExit(
+            "usage: python tools/import_ref_model.py "
+            "<conf> <ref.model> <out.model>"
+        )
+    conf_path, ref_path, out_path = sys.argv[1:]
+    from cxxnet_tpu import config as cfgmod
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    net_type, _nodes, infos, epoch, weights = parse_ref_model(ref_path)
+    print(f"reference model: net_type={net_type}, {len(infos)} layers, "
+          f"{len(weights)} weighted, epoch_counter={epoch}")
+    entries = cfgmod.parse_file(conf_path)
+    sections = cfgmod.split_sections(entries)
+    tr = NetTrainer()
+    tr.set_params(sections.global_entries)
+    tr.init_model()
+    n = install(tr, infos, weights)
+    tr.save_model(out_path)
+    print(f"installed {n} weighted layers -> {out_path}")
+
+
+if __name__ == "__main__":
+    main()
