@@ -178,3 +178,31 @@ def test_import_garbage_rejected(tmp_path):
     path.write_bytes(b"\xff" * 64)
     with pytest.raises(ValueError):
         parse_ref_model(str(path))
+
+
+@pytest.mark.parametrize("with_stride", [False, True])
+def test_export_roundtrip(tmp_path, with_stride):
+    """export_ref_model is install's inverse: a conf-built trainer
+    exports to the reference binary layout (either mshadow Shape
+    encoding), a fresh trainer imports it, and every weighted layer
+    matches bit-exactly; epoch_counter rides along."""
+    from import_ref_model import export_ref_model
+
+    tr = _build_trainer()
+    tr.epoch_counter = 7000
+    path = str(tmp_path / "exported.model")
+    assert export_ref_model(tr, path, with_stride=with_stride) == 4
+    net_type, _nodes, infos, epoch, weights = parse_ref_model(path)
+    assert epoch == 7000
+    assert [i["type_name"] for i in infos] == [
+        "conv", "batch_norm", "prelu", "max_pooling", "flatten",
+        "fullc", "softmax"]
+    tr2 = _build_trainer()
+    # fresh init differs from tr (different PRNG fold) until installed
+    assert install(tr2, infos, weights) == 4
+    for name, tag in [("c1", "wmat"), ("c1", "bias"), ("bn1", "wmat"),
+                      ("bn1", "bias"), ("pr1", "bias"), ("fc1", "wmat"),
+                      ("fc1", "bias")]:
+        np.testing.assert_array_equal(tr.get_weight(name, tag),
+                                      tr2.get_weight(name, tag),
+                                      err_msg=f"{name}/{tag}")

@@ -263,14 +263,34 @@ def install(tr, infos, weights) -> int:
 
 
 def main() -> None:
-    if len(sys.argv) != 4:
+    argv = sys.argv[1:]
+    export = "--export" in argv
+    stride = "--stride" in argv
+    argv = [a for a in argv if a not in ("--export", "--stride")]
+    if len(argv) != 3:
         raise SystemExit(
             "usage: python tools/import_ref_model.py "
-            "<conf> <ref.model> <out.model>"
+            "<conf> <ref.model> <out.model>\n"
+            "       python tools/import_ref_model.py --export [--stride] "
+            "<conf> <native.model> <out_ref.model>\n"
+            "(--stride: write the mshadow Shape-with-stride encoding for "
+            "reference builds against that mshadow revision)"
         )
-    conf_path, ref_path, out_path = sys.argv[1:]
+    conf_path, ref_path, out_path = argv
     from cxxnet_tpu import config as cfgmod
     from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    if export:
+        entries = cfgmod.parse_file(conf_path)
+        tr = NetTrainer()
+        tr.set_params(cfgmod.split_sections(entries).global_entries)
+        tr.init_model()
+        tr.load_model(ref_path)
+        n = export_ref_model(tr, out_path, with_stride=stride)
+        print(f"exported {n} weighted layers -> {out_path} "
+              "(reference binary format"
+              f"{', stride Shape encoding' if stride else ''})")
+        return
 
     net_type, _nodes, infos, epoch, weights = parse_ref_model(ref_path)
     print(f"reference model: net_type={net_type}, {len(infos)} layers, "
@@ -281,8 +301,144 @@ def main() -> None:
     tr.set_params(sections.global_entries)
     tr.init_model()
     n = install(tr, infos, weights)
+    # carry the training position: the reference's updaters key their
+    # LR schedules off epoch_counter, so a resumed/finetuned run must
+    # not restart from step 0
+    tr.epoch_counter = int(epoch)
     tr.save_model(out_path)
     print(f"installed {n} weighted layers -> {out_path}")
+
+
+
+
+# --- exporter: native checkpoint -> reference binary format -------------
+
+TYPE_IDS = {v: k for k, v in LAYER_TYPES.items()}
+
+
+def _pack_str(b: bytes) -> bytes:
+    return struct.pack("<Q", len(b)) + b
+
+
+def _pack_vec_i32(v) -> bytes:
+    return struct.pack("<Q", len(v)) + struct.pack(f"<{len(v)}i", *v)
+
+
+# convolution_layer-inl.hpp InitTemp: nstep_ derives from
+# temp_col_max/colunit; the reference default keeps convs chunked —
+# exporting 0 would silently force nstep_=1 (one sample at a time)
+REF_TEMP_COL_MAX = 64 << 18  # param.h default
+
+
+def _pack_layer_param(**kw) -> bytes:
+    full = [0] * 82  # param.h field order; float init fields stay zero
+    full[0] = kw.get("num_hidden", 0)
+    full[5] = kw.get("num_channel", 0)
+    full[7] = kw.get("num_group", 1)
+    full[8] = kw.get("kernel_height", 0)
+    full[9] = kw.get("kernel_width", 0)
+    full[10] = kw.get("stride", 1)
+    full[11] = kw.get("pad_y", 0)
+    full[12] = kw.get("pad_x", 0)
+    full[13] = kw.get("no_bias", 0)
+    full[14] = kw.get("temp_col_max", REF_TEMP_COL_MAX)
+    full[16] = kw.get("num_input_channel", 0)
+    full[17] = kw.get("num_input_node", 0)
+    return struct.pack("<82i", *full)
+
+
+def _pack_tensor(arr: np.ndarray, with_stride: bool = False) -> bytes:
+    """mshadow SaveBinary.  ``with_stride`` must match the mshadow
+    revision of the consuming reference build: shape-only (default) or
+    the revision whose ``Shape<dim>`` carries a trailing ``stride_``
+    (pass ``--stride`` at the CLI) — a mismatch shifts every subsequent
+    read on the reference side."""
+    out = struct.pack(f"<{arr.ndim}I", *arr.shape)
+    if with_stride:
+        out += struct.pack("<I", arr.shape[-1])  # contiguous rows
+    return out + np.ascontiguousarray(arr, "<f4").tobytes()
+
+
+def export_ref_model(tr, path: str, net_type: int = 0,
+                     with_stride: bool = False) -> int:
+    """Write a conf-built (or checkpoint-loaded) trainer's graph +
+    weights in the reference's binary .model layout; returns the count
+    of weighted layers written.  The inverse of :func:`install` —
+    weights come back out through the same 2-D visitor views they went
+    in by.  Weights and structure are exact; LayerParam init/temp
+    fields are regenerated (init values only matter before training)."""
+    g = tr.graph
+    blob: list = []
+    n_weighted = 0
+    infos: list = []
+
+    def tensor(arr):
+        blob.append(_pack_tensor(arr, with_stride))
+
+    for i, spec in enumerate(g.layers):
+        t = spec.type_name
+        if t == "shared":
+            # reference encoding: kSharedLayer with primary index
+            tid, primary = 0, spec.primary
+        elif t in TYPE_IDS:
+            tid, primary = TYPE_IDS[t], -1
+        else:
+            raise ValueError(
+                f"layer {spec.name or i} ({t}) has no reference LayerType "
+                "- the net is outside the reference's format"
+            )
+        infos.append(struct.pack("<ii", tid, primary))
+        infos.append(_pack_str(spec.name.encode()))
+        infos.append(_pack_vec_i32(spec.nindex_in))
+        infos.append(_pack_vec_i32(spec.nindex_out))
+        if t not in ("fullc", "conv", "bias", "batch_norm", "prelu"):
+            continue
+        lay = tr.net.layer_objs[i]
+        w2 = tr.get_weight(spec.name, "wmat")
+        b2 = tr.get_weight(spec.name, "bias")
+        if t == "fullc":
+            blob.append(_pack_layer_param(num_hidden=w2.shape[0],
+                                          num_input_node=w2.shape[1]))
+            tensor(w2)
+            tensor(b2.reshape(-1))
+        elif t == "conv":
+            p = lay.param
+            gg = max(1, p.num_group)
+            cout = p.num_channel
+            blob.append(_pack_layer_param(
+                num_channel=cout, num_group=gg,
+                kernel_height=p.kernel_height, kernel_width=p.kernel_width,
+                stride=p.stride, pad_y=p.pad_y, pad_x=p.pad_x,
+                no_bias=p.no_bias, num_input_channel=p.num_input_channel,
+            ))
+            tensor(w2.reshape(gg, cout // gg, -1))
+            tensor(b2.reshape(-1) if b2.size
+                   else np.zeros((cout,), np.float32))
+        elif t == "bias":
+            blob.append(_pack_layer_param(num_channel=b2.size))
+            tensor(b2.reshape(-1))
+        elif t == "batch_norm":
+            tensor(w2.reshape(-1))
+            tensor(b2.reshape(-1))
+        elif t == "prelu":
+            tensor(b2.reshape(-1))
+        n_weighted += 1
+    extra_num = getattr(g, "extra_data_num", 0)
+    out = [struct.pack("<i", net_type),
+           struct.pack("<4i", g.num_nodes, len(g.layers), 1, extra_num),
+           b"\0" * (31 * 4)]
+    if extra_num:
+        # reference extra_shape: flattened c,h,w per extra input
+        flat = [d for shp in g.extra_shape for d in shp]
+        out.append(_pack_vec_i32(flat))
+    for name in g.node_names:
+        out.append(_pack_str(name.encode()))
+    out.extend(infos)
+    out.append(struct.pack("<q", int(tr.epoch_counter)))
+    out.append(_pack_str(b"".join(blob)))
+    with open(path, "wb") as f:
+        f.write(b"".join(out))
+    return n_weighted
 
 
 if __name__ == "__main__":
