@@ -47,3 +47,27 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running tests"
     )
+
+
+def run_cli(args, cwd, timeout=300, module=True):
+    """Shared subprocess harness for driving the CLI (or a tool script,
+    module=False with args[0] an absolute script path) in tests.
+
+    The env override is load-bearing: PYTHONPATH=REPO drops
+    /root/.axon_site, whose sitecustomize would otherwise dial the
+    fragile single-client axon TPU relay from every test subprocess.
+    """
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo
+    cmd = ([sys.executable, "-m", "cxxnet_tpu", *args] if module
+           else [sys.executable, *args])
+    return subprocess.run(
+        cmd, capture_output=True, text=True, cwd=cwd, env=env,
+        timeout=timeout,
+    )

@@ -67,19 +67,7 @@ print_step = 100
     return str(path)
 
 
-def run_cli(args, cwd):
-    """Run the CLI in-process-like via subprocess with the test env."""
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO  # drops /root/.axon_site → pure CPU jax
-    return subprocess.run(
-        [sys.executable, "-m", "cxxnet_tpu", *args],
-        capture_output=True,
-        text=True,
-        cwd=cwd,
-        env=env,
-        timeout=300,
-    )
+from conftest import run_cli  # noqa: E402 - shared CLI harness
 
 
 def test_train_task_end_to_end(tmp_path):
