@@ -442,6 +442,56 @@ def bench_alexnet(batch: int, scan_k: int, fuse: bool = True,
     )
 
 
+def bench_pred(batch: int, scan_k: int, fuse: bool = True,
+               wino: bool = False) -> None:
+    """``--pred`` mode: GoogLeNet INFERENCE throughput (stderr only —
+    the stdout JSON stays the training metric).  The reference's
+    deployment path (``task=pred``, ``cxxnet_main.cpp:405-441``) runs
+    batch-at-a-time; here K staged batches run as ONE device program
+    (``lax.map`` over the eval forward), the same dispatch-amortizing
+    design as the training scan."""
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _build_googlenet
+
+    tr = _build_googlenet(batch_size=batch, input_size=224, dev="tpu")
+    if not fuse:
+        tr.net.fuse_1x1 = 0
+    if wino:
+        for lay in tr.net.layer_objs:
+            if hasattr(lay, "conv_wino"):
+                lay.conv_wino = 1
+    net = tr.net
+    out_idx = net.out_node_index()
+
+    def chunk(params, aux, data):
+        def one(d):
+            nodes, _ = net.forward(params, d, train=False, aux=aux)
+            return jnp.argmax(nodes[out_idx], axis=-1)
+
+        return jax.lax.map(one, data)
+
+    fwd = jax.jit(chunk)
+    rng = np.random.RandomState(0)
+    data = jax.device_put(
+        rng.randn(scan_k, batch, 224, 224, 3).astype(np.float32)
+    )
+    for _ in range(2):
+        jax.block_until_ready(fwd(tr.params, tr.aux, data))
+    t0 = time.perf_counter()
+    n_scans = 3
+    for _ in range(n_scans):
+        out = fwd(tr.params, tr.aux, data)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n_scans / scan_k
+    print(
+        f"# bench[pred]: GoogLeNet b{batch} bf16 inference: "
+        f"{dt*1e3:.2f} ms/batch = {batch/dt:.0f} img/s/chip",
+        file=sys.stderr, flush=True,
+    )
+
+
 def bench_bowl(batch: int, scan_k: int) -> None:
     """``--bowl`` mode: Kaggle NDSB plankton convnet throughput.  The
     reference's one semi-quantitative claim is ~5 min for 100 rounds at
@@ -498,7 +548,7 @@ def _run() -> None:
                                                  "--resnet101",
                                                  "--resnet152", "--vgg19",
                                                  "--flash", "--nofuse",
-                                                 "--wino")]
+                                                 "--wino", "--pred")]
     io_mode = "--io" in sys.argv[1:]
     lm_mode = "--lm" in sys.argv[1:]
     resnet_mode = "--resnet" in sys.argv[1:]
@@ -515,6 +565,7 @@ def _run() -> None:
     alexnet_mode = "--alexnet" in sys.argv[1:]
     bowl_mode = "--bowl" in sys.argv[1:]
     flash_mode = "--flash" in sys.argv[1:]
+    pred_mode = "--pred" in sys.argv[1:]
     if "--fuse" in sys.argv[1:]:
         raise SystemExit("--fuse is now the default; use --nofuse for the A/B")
     nofuse_mode = "--nofuse" in sys.argv[1:]  # fuse_1x1=0 A/B on image modes
@@ -532,6 +583,10 @@ def _run() -> None:
     if flash_mode:
         # positional args are the T sweep (default: the doc fixture Ts)
         bench_flash([int(a) for a in args] or [2048, 4096, 8192, 16384])
+        return
+    if pred_mode:
+        bench_pred(batch, min(scan_k, 20), fuse=not nofuse_mode,
+                   wino=wino_mode)
         return
     if io_mode:
         bench_io(batch, min(scan_k, 10))

@@ -46,6 +46,7 @@ run() {
   run 3000 python tools/vgg_bisect.py wino wino2 wino345 wino45
   run 1800 python bench.py --flash
   run 1500 python bench.py --alexnet
+  run 1200 python bench.py --pred
   # the one integration never yet exercised on chip: CLI train with the
   # real decode->augment->scan pipeline in-path (log goes to example/)
   echo "=== tpu_train_e2e ==="
