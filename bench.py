@@ -97,6 +97,32 @@ def _probe_relay(port: int = RELAY_PORT, tries: int = 3,
     return False
 
 
+TPU_LOCK_PATH = "/tmp/tpu_relay.lock"
+
+
+def _acquire_tpu_lock() -> bool:
+    """Take the single-client TPU lock (the one tools/tpu_queue.sh
+    serializes every on-chip run under) for this process's lifetime.
+
+    Returns False if another TPU client holds it — the caller must NOT
+    dial (two concurrent dialers wedged the relay for ~8h in round 3).
+    Re-entrant under the queue: the queue holds the flock for the whole
+    sweep and marks its children via TPU_QUEUE_LOCK_HELD.
+    """
+    if os.environ.get("TPU_QUEUE_LOCK_HELD") == "1":
+        return True
+    import fcntl
+
+    fd = os.open(TPU_LOCK_PATH, os.O_CREAT | os.O_WRONLY, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        os.close(fd)
+        return False
+    _STAGE["tpu_lock_fd"] = fd  # held until process exit
+    return True
+
+
 def _arm_watchdog(deadline_sec: float = WATCHDOG_SEC) -> None:
     """Emit a diagnostic and hard-exit before the driver's own timeout
     can strike.  A completed run (any mode) sets ``_STAGE['done']`` on
@@ -139,19 +165,28 @@ def _emit(tag: str, img_s: float, batch: int) -> None:
 
 
 def _time_scans(tr, data, labels, scan_k: int, n_scans: int = 3,
-                per_step_data: bool = False) -> float:
+                per_step_data: bool = False, step=None) -> float:
     """Warm twice, time n_scans device-side scans, return sec/step —
-    the shared measurement harness of every bench mode."""
+    the shared measurement harness of every bench mode.  ``step``
+    overrides the dispatched program (default: the training
+    ``update_scan``); its return value is what gets block-waited."""
     import jax
 
-    kw = {} if per_step_data else {"n_steps": scan_k}
+    if step is None:
+        kw = {} if per_step_data else {"n_steps": scan_k}
+
+        def step():
+            tr.update_scan(data, labels, **kw)
+            return tr.params
+
+    last = None
     for _ in range(2):
-        tr.update_scan(data, labels, **kw)
-    jax.block_until_ready(tr.params)
+        last = step()
+    jax.block_until_ready(last)
     t0 = time.perf_counter()
     for _ in range(n_scans):
-        tr.update_scan(data, labels, **kw)
-    jax.block_until_ready(tr.params)
+        last = step()
+    jax.block_until_ready(last)
     return (time.perf_counter() - t0) / n_scans / scan_k
 
 
@@ -466,25 +501,26 @@ def bench_pred(batch: int, scan_k: int, fuse: bool = True,
     out_idx = net.out_node_index()
 
     def chunk(params, aux, data):
+        # K distinct batches (a loop body that ignored its iterate
+        # would invite XLA to hoist the invariant forward out of the
+        # loop and fake a Kx number)
         def one(d):
-            nodes, _ = net.forward(params, d, train=False, aux=aux)
+            nodes, _aux = net.forward(params, d, train=False, aux=aux)
             return jnp.argmax(nodes[out_idx], axis=-1)
 
         return jax.lax.map(one, data)
 
     fwd = jax.jit(chunk)
     rng = np.random.RandomState(0)
-    data = jax.device_put(
-        rng.randn(scan_k, batch, 224, 224, 3).astype(np.float32)
-    )
-    for _ in range(2):
-        jax.block_until_ready(fwd(tr.params, tr.aux, data))
-    t0 = time.perf_counter()
-    n_scans = 3
-    for _ in range(n_scans):
-        out = fwd(tr.params, tr.aux, data)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / n_scans / scan_k
+    # fill f32 batch-by-batch: a single randn(K,...) call would make a
+    # ~4x float64 transient (~3 GB at the default K=20, b128)
+    host = np.empty((scan_k, batch, 224, 224, 3), np.float32)
+    for k in range(scan_k):
+        host[k] = rng.randn(batch, 224, 224, 3)
+    data = jax.device_put(host)
+    del host
+    dt = _time_scans(tr, None, None, scan_k,
+                     step=lambda: fwd(tr.params, tr.aux, data))
     print(
         f"# bench[pred]: GoogLeNet b{batch} bf16 inference: "
         f"{dt*1e3:.2f} ms/batch = {batch/dt:.0f} img/s/chip",
@@ -513,15 +549,23 @@ def bench_bowl(batch: int, scan_k: int) -> None:
 
 
 def main() -> None:
-    if _tpu_expected() and not _probe_relay():
-        _emit_error(
-            f"relay dead: nothing listening on 127.0.0.1:{RELAY_PORT}; "
-            "refusing to dial the TPU tunnel (it would hang, round-3 mode). "
-            "For a CPU sanity pass drop .axon_site from PYTHONPATH "
-            "(JAX_PLATFORMS=cpu alone is NOT enough — sitecustomize "
-            "re-registers the axon backend)."
-        )
-        raise SystemExit(0)  # rc 0 + parseable diagnostic beats rc 124
+    if _tpu_expected():
+        if not _probe_relay():
+            _emit_error(
+                f"relay dead: nothing listening on 127.0.0.1:{RELAY_PORT}; "
+                "refusing to dial the TPU tunnel (it would hang, round-3 "
+                "mode). For a CPU sanity pass drop .axon_site from "
+                "PYTHONPATH (JAX_PLATFORMS=cpu alone is NOT enough — "
+                "sitecustomize re-registers the axon backend)."
+            )
+            raise SystemExit(0)  # rc 0 + parseable diagnostic beats rc 124
+        if not _acquire_tpu_lock():
+            _emit_error(
+                f"another TPU client holds {TPU_LOCK_PATH}; refusing to "
+                "double-dial the single-client relay (round-3 wedge mode). "
+                "Wait for the running tpu_queue.sh/bench to finish."
+            )
+            raise SystemExit(0)
     _arm_watchdog()
     try:
         _run()

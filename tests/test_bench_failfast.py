@@ -101,3 +101,52 @@ def test_watchdog_reemits_measurement_instead_of_null(capsys):
     rec = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
     assert rec["value"] == 1234.5
     assert "watchdog" in rec and "stuck at stage" in rec["watchdog"]
+
+
+def test_lock_contention_fails_fast(tmp_path):
+    """A second TPU-dialing bench while another client holds the relay
+    flock must emit the diagnostic and exit 0 — never double-dial the
+    single-client relay (the round-3 wedge)."""
+    import fcntl
+
+    # a relay stand-in so the probe passes and the LOCK is the decider
+    import socket
+    import threading
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(5)
+    port = srv.getsockname()[1]
+    t = threading.Thread(target=lambda: [srv.accept() for _ in range(9)],
+                         daemon=True)
+    t.start()
+
+    fd = os.open("/tmp/tpu_relay.lock", os.O_CREAT | os.O_WRONLY, 0o644)
+    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    try:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + "/nonexistent/.axon_site"
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("TPU_QUEUE_LOCK_HELD", None)
+        env["AXON_RELAY_PORT"] = str(port)
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert p.returncode == 0, p.stderr[-400:]
+        rec = json.loads([l for l in p.stdout.splitlines()
+                          if l.startswith("{")][-1])
+        assert rec["value"] is None and "holds" in rec["error"]
+        # ...and with the queue's re-entrancy marker the lock is waived
+        # (the process then proceeds toward jax; kill it via watchdog)
+        env["TPU_QUEUE_LOCK_HELD"] = "1"
+        env["BENCH_WATCHDOG_SEC"] = "3"
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        out = [l for l in p.stdout.splitlines() if l.startswith("{")]
+        assert out and "holds" not in json.loads(out[-1]).get("error", "")
+    finally:
+        os.close(fd)
+        srv.close()

@@ -24,11 +24,19 @@ def run_bisect(variant_conf, default_names, batch: int = 128,
     ``bench.py`` numbers)."""
     import bench
 
-    if bench._tpu_expected() and not bench._probe_relay():
-        bench._emit_error(
-            "relay dead: refusing to dial the TPU tunnel from a bisect tool"
-        )
-        raise SystemExit(0)
+    if bench._tpu_expected():
+        if not bench._probe_relay():
+            bench._emit_error(
+                "relay dead: refusing to dial the TPU tunnel from a "
+                "bisect tool"
+            )
+            raise SystemExit(0)
+        if not bench._acquire_tpu_lock():
+            bench._emit_error(
+                "another TPU client holds the relay lock; refusing to "
+                "double-dial from a bisect tool"
+            )
+            raise SystemExit(0)
     names = sys.argv[1:] or default_names
     # one single-run deadline per variant: a healthy multi-variant sweep
     # must never be killed by the single-run default

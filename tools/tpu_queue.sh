@@ -16,6 +16,8 @@ if ! flock -n 9; then
   echo "another TPU run holds $LOCK; refusing to double-dial" >&2
   exit 1
 fi
+# children (bench.py/bisect tools) must not re-acquire the flock we hold
+export TPU_QUEUE_LOCK_HELD=1
 
 if ! timeout 3 bash -c 'echo > /dev/tcp/127.0.0.1/8082' 2>/dev/null; then
   echo "relay dead (port 8082 refused); not dialing" >&2
