@@ -99,12 +99,19 @@ class LibSVMIterator(DataIter):
         take = min(self.batch_size, n - self._at)
         rows = list(range(self._at, self._at + take))
         padd = 0
-        if take < self.batch_size and self.round_batch:
-            # wrap to the front, mark the pad count (data.h:86-88
-            # contract); modulo keeps wrapping when the whole file is
-            # smaller than one batch
+        if take < self.batch_size:
+            # the batch is ALWAYS emitted full-size with num_batch_padd
+            # marking the pad rows (data.h:86-88; iter_batch_proc-inl.hpp
+            # round_batch=0 branch pads in place) — a shape-varying last
+            # batch would break static-shape jit consumers.  round_batch=1
+            # wraps to the front (modulo keeps wrapping when the whole
+            # file is smaller than one batch); round_batch=0 replicates
+            # in-range rows, which consumers must ignore via the padd count
             padd = self.batch_size - take
-            rows += [i % n for i in range(padd)]
+            if self.round_batch:
+                rows += [i % n for i in range(padd)]
+            else:
+                rows += [rows[-1]] * padd
         self._at += take
         self._batch = self._slice(rows, padd)
         return True

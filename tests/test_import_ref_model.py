@@ -99,7 +99,11 @@ def _write_model(path, with_stride: bool, seed=0):
         (1, "fc1"), (2, ""),
     ]
     out = struct.pack("<i", 0)                      # net_type
-    out += struct.pack("<4i", 8, len(layers), 1, 0)  # NetParam head
+    out += struct.pack("<2i", 8, len(layers))        # num_nodes, num_layers
+    out += struct.pack("<3I", 3, 8, 8)               # NetParam.input_shape
+    if with_stride:
+        out += struct.pack("<I", 8)                  # Shape<3>::stride_
+    out += struct.pack("<2i", 1, 0)                  # init_end, extra_data_num
     out += b"\0" * (31 * 4)                          # reserved
     for k in range(8):
         out += _s(f"node{k}".encode())
@@ -130,8 +134,9 @@ def test_import_roundtrip(tmp_path, with_stride):
     weighted layer lands bit-exactly in the conf-built trainer."""
     path = str(tmp_path / "ref.model")
     w = _write_model(path, with_stride)
-    net_type, _nodes, infos, epoch, weights = parse_ref_model(path)
+    net_type, _nodes, infos, epoch, weights, ishape = parse_ref_model(path)
     assert net_type == 0 and epoch == 42
+    assert ishape == (3, 8, 8)
     assert [i["type_name"] for i in infos] == [
         "conv", "batch_norm", "prelu", "max_pooling", "flatten",
         "fullc", "softmax"]
@@ -161,7 +166,7 @@ def test_import_type_mismatch_rejected(tmp_path):
     """A conf whose layer type disagrees with the binary is refused."""
     path = str(tmp_path / "ref.model")
     _write_model(path, with_stride=False)
-    _, _, infos, _, weights = parse_ref_model(path)
+    _, _, infos, _, weights, _ = parse_ref_model(path)
     from cxxnet_tpu import config as cfgmod
     from cxxnet_tpu.nnet.trainer import NetTrainer
 
@@ -192,8 +197,11 @@ def test_export_roundtrip(tmp_path, with_stride):
     tr.epoch_counter = 7000
     path = str(tmp_path / "exported.model")
     assert export_ref_model(tr, path, with_stride=with_stride) == 4
-    net_type, _nodes, infos, epoch, weights = parse_ref_model(path)
+    net_type, _nodes, infos, epoch, weights, ishape = parse_ref_model(path)
     assert epoch == 7000
+    # NetParam.input_shape must ride through export (the reference's
+    # InitNet shapes node 0 from it, neural_net-inl.hpp:218-220)
+    assert ishape == (3, 8, 8)
     assert [i["type_name"] for i in infos] == [
         "conv", "batch_norm", "prelu", "max_pooling", "flatten",
         "fullc", "softmax"]

@@ -734,6 +734,26 @@ def test_libsvm_round_batch_pads_and_marks(tmp_path):
     assert idx.tolist() == [2] and val.tolist() == [3.0]
 
 
+def test_libsvm_no_round_batch_still_full_size(tmp_path):
+    """round_batch=0 must ALSO emit a full-size final batch with
+    num_batch_padd set (iter_batch_proc-inl.hpp round_batch=0 branch:
+    the batch buffer stays batch_size-shaped, only the padd count
+    marks the dead rows) — a shape-varying last batch breaks
+    static-shape jit consumers (advisor r4 finding)."""
+    path = _write_libsvm(tmp_path, [
+        "1 0:1.0", "0 1:2.0", "1 2:3.0",
+    ])
+    it = _libsvm_iter(path, batch_size=2, round_batch=0, num_feature=4)
+    assert it.next() and it.value().num_batch_padd == 0
+    assert it.next()
+    b = it.value()
+    assert b.batch_size == 2              # full-size, NOT take-size
+    assert b.data.shape == (2, 4)
+    assert b.num_batch_padd == 1
+    assert b.inst_index.tolist() == [2, 2]  # replicated, not wrapped
+    assert not it.next()
+
+
 def test_libsvm_dense_batch_rejects_sparse_api(tmp_path):
     import pytest
 

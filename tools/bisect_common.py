@@ -38,9 +38,13 @@ def run_bisect(variant_conf, default_names, batch: int = 128,
             )
             raise SystemExit(0)
     names = sys.argv[1:] or default_names
-    # one single-run deadline per variant: a healthy multi-variant sweep
-    # must never be killed by the single-run default
-    bench._arm_watchdog(len(names) * bench.WATCHDOG_SEC)
+    # arm for startup (jax import + cache config), then RE-arm one
+    # single-run deadline at each variant: any single hang fires within
+    # WATCHDOG_SEC — inside tpu_queue.sh's external `timeout` budget —
+    # while a healthy multi-variant sweep is never killed by the
+    # single-run default.  (One deadline scaled by len(names) could
+    # exceed the external budget and reproduce the rc=124 mode.)
+    bench._arm_watchdog(bench.WATCHDOG_SEC)
     try:
         import jax
 
@@ -52,6 +56,10 @@ def run_bisect(variant_conf, default_names, batch: int = 128,
         from bench import _bench_imagenet_conf
 
         for name in names:
+            wd = bench._STAGE.get("watchdog")
+            if wd is not None:
+                wd.cancel()
+            bench._arm_watchdog(bench.WATCHDOG_SEC)
             bench._set_stage(f"bisect:{name}")
             _bench_imagenet_conf(
                 f"bisect:{name}", name, variant_conf(name, batch),

@@ -11,8 +11,11 @@ structure + weights, per-layer configs come from the conf,
 Binary layout (all little-endian; cited from the reference sources):
 
 * ``int32 net_type``                       (cxxnet_main.cpp:177)
-* ``NetConfig::NetParam`` — 4 int32 fields (num_nodes, num_layers,
-  init_end, extra_data_num) + 31 reserved int32 (nnet_config.h:28-41)
+* ``NetConfig::NetParam`` — num_nodes, num_layers (int32),
+  ``mshadow::Shape<3> input_shape`` (3 index_t words, or 4 under the
+  stride-bearing mshadow revision), init_end, extra_data_num (int32)
+  + 31 reserved int32 (nnet_config.h:28-50; SaveNet dumps the whole
+  struct, nnet_config.h:127)
 * if extra_data_num: ``vector<int> extra_shape`` (uint64 count +
   int32s, utils/io.h:43-48)
 * ``num_nodes`` x string (uint64 len + bytes, utils/io.h:69-74)
@@ -134,18 +137,47 @@ def _read_tensor(r: Reader, dim: int, with_stride: bool,
 
 
 def parse_ref_model(path: str, with_stride: Optional[bool] = None):
-    """-> (net_type, layer_infos, epoch, weights) where layer_infos is
-    [{type_id, type_name, primary, name, nin, nout}] and weights is
-    {layer_name: {tag: np.ndarray}} in the reference's native layouts."""
+    """-> (net_type, node_names, layer_infos, epoch, weights,
+    input_shape) where layer_infos is [{type_id, type_name, primary,
+    name, nin, nout}], weights is {layer_name: {tag: np.ndarray}} in
+    the reference's native layouts, and input_shape is the NetParam's
+    (C, H, W).
+
+    ``with_stride`` selects the mshadow revision (it affects BOTH the
+    ``Shape<3> input_shape`` embedded in the NetParam header and every
+    tensor's SaveBinary shape); ``None`` auto-detects by attempting a
+    complete parse under each hypothesis — a wrong hypothesis
+    misaligns the stream and fails loudly (shape/consumption checks).
+    """
     blob = open(path, "rb").read()
+    if with_stride is not None:
+        return _parse_file(path, blob, with_stride)
+    try:
+        return _parse_file(path, blob, with_stride=False)
+    except ValueError:
+        return _parse_file(path, blob, with_stride=True)
+
+
+def _parse_file(path: str, blob: bytes, with_stride: bool):
     r = Reader(blob)
     net_type = r.i32()
-    num_nodes, num_layers, _init_end, extra_data_num = (
-        r.i32(), r.i32(), r.i32(), r.i32())
+    num_nodes, num_layers = r.i32(), r.i32()
+    # NetParam.input_shape: mshadow::Shape<3> written inline with the
+    # struct (nnet_config.h:34, SaveNet nnet_config.h:127) — 3 index_t
+    # dims, +1 trailing stride_ word under the old-mshadow revision
+    input_shape = r.u32s(3)
+    if with_stride:
+        r.u32s(1)
+    init_end, extra_data_num = r.i32(), r.i32()
     r.raw(31 * 4)  # NetParam.reserved
     if not (0 < num_nodes < 1 << 20 and 0 < num_layers < 1 << 20):
         raise ValueError(f"{path}: not a reference cxxnet model "
                          f"(nodes={num_nodes}, layers={num_layers})")
+    if init_end not in (0, 1) or not 0 <= extra_data_num < 1 << 10:
+        raise ValueError(
+            f"{path}: implausible NetParam (init_end={init_end}, "
+            f"extra_data_num={extra_data_num}) — wrong Shape encoding?"
+        )
     if extra_data_num:
         r.vec_i32()
     node_names = [r.string().decode() for _ in range(num_nodes)]
@@ -165,16 +197,9 @@ def parse_ref_model(path: str, with_stride: Optional[bool] = None):
         })
     epoch = r.i64()
     model_blob = r.string()
-
-    if with_stride is None:
-        # disambiguate the mshadow Shape encoding on the actual payload
-        try:
-            weights = _parse_blob(model_blob, infos, with_stride=False)
-        except ValueError:
-            weights = _parse_blob(model_blob, infos, with_stride=True)
-    else:
-        weights = _parse_blob(model_blob, infos, with_stride)
-    return net_type, node_names, infos, epoch, weights
+    weights = _parse_blob(model_blob, infos, with_stride)
+    return (net_type, node_names, infos, epoch, weights,
+            tuple(int(d) for d in input_shape))
 
 
 def _parse_blob(blob: bytes, infos, with_stride: bool):
@@ -292,9 +317,10 @@ def main() -> None:
               f"{', stride Shape encoding' if stride else ''})")
         return
 
-    net_type, _nodes, infos, epoch, weights = parse_ref_model(ref_path)
+    net_type, _nodes, infos, epoch, weights, ishape = parse_ref_model(ref_path)
     print(f"reference model: net_type={net_type}, {len(infos)} layers, "
-          f"{len(weights)} weighted, epoch_counter={epoch}")
+          f"{len(weights)} weighted, epoch_counter={epoch}, "
+          f"input_shape={ishape}")
     entries = cfgmod.parse_file(conf_path)
     sections = cfgmod.split_sections(entries)
     tr = NetTrainer()
@@ -424,9 +450,16 @@ def export_ref_model(tr, path: str, net_type: int = 0,
             tensor(b2.reshape(-1))
         n_weighted += 1
     extra_num = getattr(g, "extra_data_num", 0)
+    # NetParam.input_shape (C,H,W — nnet_config.h:252 Shape3(z,y,x),
+    # consumed as s[0]=C by InitNet, neural_net-inl.hpp:218-220)
+    ishape = tuple(int(d) for d in getattr(g, "input_shape", (0, 0, 0)))
     out = [struct.pack("<i", net_type),
-           struct.pack("<4i", g.num_nodes, len(g.layers), 1, extra_num),
-           b"\0" * (31 * 4)]
+           struct.pack("<2i", g.num_nodes, len(g.layers)),
+           struct.pack("<3I", *ishape)]
+    if with_stride:
+        out.append(struct.pack("<I", ishape[-1]))  # Shape<3>::stride_
+    out.append(struct.pack("<2i", 1, extra_num))
+    out.append(b"\0" * (31 * 4))
     if extra_num:
         # reference extra_shape: flattened c,h,w per extra input
         flat = [d for shp in g.extra_shape for d in shp]

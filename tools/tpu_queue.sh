@@ -19,8 +19,9 @@ fi
 # children (bench.py/bisect tools) must not re-acquire the flock we hold
 export TPU_QUEUE_LOCK_HELD=1
 
-if ! timeout 3 bash -c 'echo > /dev/tcp/127.0.0.1/8082' 2>/dev/null; then
-  echo "relay dead (port 8082 refused); not dialing" >&2
+PORT=${AXON_RELAY_PORT:-8082}
+if ! timeout 3 bash -c "echo > /dev/tcp/127.0.0.1/$PORT" 2>/dev/null; then
+  echo "relay dead (port $PORT refused); not dialing" >&2
   exit 2
 fi
 
@@ -33,6 +34,26 @@ run() {
     timeout "$budget" "$@" 2>&1 | grep -E "bench\[|stage\[|\"metric\"" || true
 }
 
+sweep() {
+  # sweep <per_variant_budget> python tools/X_bisect.py v1 v2 ...
+  # The bisect tools re-arm their watchdog at EACH variant with
+  # BENCH_WATCHDOG_SEC, so the external budget must scale with the
+  # variant count: per*(n+1) — the +1 covers startup (jax import + TPU
+  # dial, which gets its own watchdog arming) — guarantees every
+  # per-variant watchdog (per-120) fires before the external `timeout`,
+  # never the round-3 rc=124 mode.  Variants MUST be listed explicitly
+  # (n=0 would make `timeout 0` disable the backstop entirely).
+  local per=$1; shift
+  local n=$(($# - 2))   # args after "python <script>"
+  if [ "$n" -lt 1 ]; then
+    echo "sweep: list variants explicitly (got: $*)" >&2
+    return 1
+  fi
+  echo "=== $* (n=$n, per=$per) ==="
+  BENCH_WATCHDOG_SEC=$((per - 120)) \
+    timeout $((per * (n + 1))) "$@" 2>&1 | grep -E "bench\[|stage\[|\"metric\"" || true
+}
+
 {
   date
   # headline FIRST: if the relay window is short, the round's most
@@ -41,11 +62,11 @@ run() {
   # else spends the window
   run 1800 python bench.py
   # round-3 stranded A/Bs (VERDICT r3 #2), then the round-4 wino
-  run 2400 python tools/googlenet_bisect.py base lrnmm stems2d wino
-  run 1500 python tools/resnet_bisect.py base stems2d wino
+  sweep 900 python tools/googlenet_bisect.py base lrnmm stems2d wino
+  sweep 900 python tools/resnet_bisect.py base stems2d wino
   run 1500 python bench.py --resnet
   run 1500 python bench.py --vgg
-  run 3000 python tools/vgg_bisect.py wino wino2 wino345 wino45
+  sweep 900 python tools/vgg_bisect.py wino wino2 wino345 wino45
   run 1800 python bench.py --flash
   run 1500 python bench.py --alexnet
   run 1200 python bench.py --pred
