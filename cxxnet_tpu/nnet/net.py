@@ -52,6 +52,13 @@ class FunctionalNet:
         # b128 on the v5e chip; `fuse_1x1 = 0` opts out
         self.fuse_1x1 = 1
         self._fuse_cache = None
+        # branch-embedding fusion is OPT-IN (doc/performance.md "Conv
+        # efficiency"): merge sibling odd-k stride-1 SAME convs (the
+        # inception 3x3/5x5 branches) into ONE block-kernel conv — an
+        # adequately-shaped GEMM for ~3.6x more MACs.  Exact; promoted
+        # only on a measured win (tools/googlenet_bisect.py bembed)
+        self.conv_branch_embed = 0
+        self._embed_cache = None
         # instantiate layers (shared layers alias the primary instance)
         self.layer_objs: List[Layer] = []
         self.param_key: List[Optional[str]] = []  # params pytree key per layer
@@ -103,6 +110,8 @@ class FunctionalNet:
                 # execute sibling 1x1 convs on one input node as ONE
                 # concatenated conv (see _sibling_1x1_groups)
                 self.fuse_1x1 = int(val)
+            elif name == "conv_branch_embed":
+                self.conv_branch_embed = int(val)
             elif name == "compute_dtype":
                 if val in ("bfloat16", "bf16"):
                     self.compute_dtype = jnp.bfloat16
@@ -210,6 +219,28 @@ class FunctionalNet:
         return params
 
     # ------------------------------------------------------------------
+    def _graph_versions(self):
+        """Declaration-order dataflow scan shared by the fusion
+        planners: per-node write counts, per-layer read keys
+        ``(node, version-at-read)``, and ``writers[n][v]`` = the layer
+        whose write created version ``v+1`` (version 0 = graph input).
+        One implementation so the two planners can never disagree
+        about graph provenance."""
+        g = self.graph
+        writes = [0] * g.num_nodes
+        for spec in g.layers:
+            for n in spec.nindex_out:
+                writes[n] += 1
+        version = [0] * g.num_nodes
+        writers: Dict[int, List[int]] = {}
+        in_keys: List[List[Tuple[int, int]]] = []
+        for i, spec in enumerate(g.layers):
+            in_keys.append([(n, version[n]) for n in spec.nindex_in])
+            for n in spec.nindex_out:  # reads happen before writes
+                writers.setdefault(n, []).append(i)
+                version[n] += 1
+        return writes, in_keys, writers
+
     def _sibling_1x1_groups(self):
         """Groups of distinct 1x1/s1/p0/ungrouped conv layers sharing one
         input node, to be executed as ONE concatenated conv.
@@ -239,11 +270,7 @@ class FunctionalNet:
         # EARLY (at the leader's position), so a member must be the sole
         # writer of its output node — otherwise the declaration-order
         # overwrite sequence changes
-        writes = [0] * self.graph.num_nodes
-        for spec in self.graph.layers:
-            for n in spec.nindex_out:
-                writes[n] += 1
-        version = [0] * self.graph.num_nodes
+        writes, in_keys, _writers = self._graph_versions()
         by_input: Dict[Tuple[int, int, int], List[int]] = {}
         for i, spec in enumerate(self.graph.layers):
             is_candidate = False
@@ -266,10 +293,8 @@ class FunctionalNet:
                         and writes[spec.nindex_out[0]] == 1
                     )
             if is_candidate:
-                n = spec.nindex_in[0]
-                by_input.setdefault((n, version[n], p.stride), []).append(i)
-            for n in spec.nindex_out:  # reads above happen before writes
-                version[n] += 1
+                n, v = in_keys[i][0]
+                by_input.setdefault((n, v, p.stride), []).append(i)
         groups: Dict[int, List[int]] = {}
         member: Dict[int, int] = {}
         for idxs in by_input.values():
@@ -297,6 +322,199 @@ class FunctionalNet:
         for d, w in zip(gparams, ws):
             part = lax.slice_in_dim(y, off, off + w.shape[3], axis=3)
             off += w.shape[3]
+            if "bias" in d:
+                part = part + d["bias"].astype(x.dtype)
+            outs.append(part)
+        return outs
+
+    # ------------------------------------------------------------------
+    # branch-embedding fusion (doc/performance.md "Conv efficiency"):
+    # inception-style sibling branch convs (3x3 + 5x5, stride 1, SAME
+    # padding) become ONE conv whose block kernel holds each member's
+    # kernel center-embedded in its own (cin, cout) slice, zeros in the
+    # cross-slices.  Exact: with SAME padding and stride 1, the k_max
+    # conv of a center-embedded smaller kernel equals the smaller conv.
+    # The MXU trades ~3.6x more MACs for one adequately-shaped GEMM per
+    # module (K = k_max^2 * sum(cin), N = sum(cout)) — the cuDNN-style
+    # algorithmic-rewrite analog, opt-in pending the on-chip A/B.
+
+    # elementwise single-in/single-out layers a provenance walk may
+    # step through: they preserve spatial dims, so two convs whose
+    # walks meet at one (node, version) see identical (H, W)
+    _EMBED_WALK_TYPES = frozenset({
+        "relu", "sigmoid", "tanh", "softplus", "xelu", "insanity",
+        "prelu", "bias", "batch_norm", "dropout",
+    })
+
+    def _branch_embed_plan(self):
+        """Compute ``(items, groups)``: an execution plan for forward()
+        — ``items`` is a list of ``("L", layer_idx)`` / ``("E",
+        leader_idx)`` — plus ``leader -> member idxs``.
+
+        Members of a group are odd-k (3/5/7) stride-1 SAME convs whose
+        inputs trace back, through elementwise layers and 1x1/s1/p0
+        convs, to the SAME (node, write-version) — the inception
+        branch shape.  Because declaration order interleaves the
+        branches (the 5x5 reduce sits between the 3x3 conv and the 5x5
+        conv), the group executes at the LAST member's position and
+        layers that consume member outputs inside that window are
+        deferred to after the group; the reorder is only applied when
+        every node written in the window is single-writer, which makes
+        any dependency-respecting order equivalent."""
+        if self._embed_cache is not None:
+            return self._embed_cache
+        from ..layers.conv import ConvolutionLayer
+
+        g = self.graph
+        L = len(g.layers)
+        writes, in_keys, writers = self._graph_versions()
+
+        def walkable(p: int) -> bool:
+            ps = g.layers[p]
+            if len(ps.nindex_in) != 1 or len(ps.nindex_out) != 1:
+                return False
+            if ps.type_name in self._EMBED_WALK_TYPES:
+                return True
+            if ps.type_name == "conv":
+                lp = self.layer_objs[p].param
+                return ((lp.kernel_height, lp.kernel_width, lp.stride,
+                         lp.pad_y, lp.pad_x, lp.num_group)
+                        == (1, 1, 1, 0, 0, 1))
+            return False
+
+        def root_of(i: int) -> Tuple[int, int]:
+            n, v = in_keys[i][0]
+            while v > 0:
+                p = writers[n][v - 1]
+                if not walkable(p):
+                    break
+                n, v = in_keys[p][0]
+            return n, v
+
+        by_root: Dict[Tuple[int, int], List[int]] = {}
+        for i, spec in enumerate(g.layers):
+            if spec.type_name == "shared":
+                continue
+            lay = self.layer_objs[i]
+            if type(lay) is not ConvolutionLayer:
+                continue
+            p = lay.param
+            if not (p.stride == 1 and p.num_group == 1
+                    and p.kernel_height == p.kernel_width
+                    and p.kernel_height in (3, 5, 7)
+                    and p.pad_y == (p.kernel_height - 1) // 2
+                    and p.pad_x == (p.kernel_width - 1) // 2
+                    and len(spec.nindex_in) == 1
+                    and len(spec.nindex_out) == 1
+                    and spec.nindex_out[0] != spec.nindex_in[0]
+                    and writes[spec.nindex_out[0]] == 1):
+                continue
+            by_root.setdefault(root_of(i), []).append(i)
+
+        fuse_groups, _fuse_member = (
+            self._sibling_1x1_groups() if self.fuse_1x1 else ({}, {})
+        )
+        key_counts: Dict[Optional[str], int] = {}
+        for k in self.param_key:
+            key_counts[k] = key_counts.get(k, 0) + 1
+        groups: List[Tuple[List[int], List[int]]] = []  # (idxs, moved)
+        for idxs in by_root.values():
+            if len(idxs) < 2:
+                continue
+            idxs = sorted(idxs)
+            first, last = idxs[0], idxs[-1]
+            iset = set(idxs)
+            dep_nodes: set = set()
+            moved: List[int] = []
+            for j in range(first, last + 1):
+                sj = g.layers[j]
+                if j in iset:
+                    dep_nodes.update(sj.nindex_out)
+                elif any(n in dep_nodes for n in sj.nindex_in):
+                    moved.append(j)
+                    dep_nodes.update(sj.nindex_out)
+            ok = all(
+                writes[n] == 1
+                for j in range(first, last + 1)
+                for n in g.layers[j].nindex_out
+            ) and all(writes[in_keys[j][0][0]] <= 1 for j in idxs)
+            # (<= 1 above: a member may read the never-written graph
+            # input node directly — trivially stable under deferral)
+            # a deferred 1x1-fuse leader would shift its whole sibling
+            # group past consumers of the other members — skip
+            ok = ok and not any(j in fuse_groups for j in moved)
+            # a deferred SHARED STATEFUL layer (e.g. a shared batch_norm
+            # chaining running stats) would execute after a later
+            # occurrence of itself, reversing the documented state-chain
+            # order — node dataflow alone can't see aux-state edges
+            ok = ok and not any(
+                hasattr(self.layer_objs[j], "apply_stateful")
+                and key_counts[self.param_key[j]] > 1
+                for j in moved
+            )
+            if ok:
+                groups.append((idxs, moved))
+        groups.sort(key=lambda t: t[0][0])
+
+        if not groups:
+            self._embed_cache = (None, {})
+            return self._embed_cache
+        items: List[Tuple[str, int]] = []
+        gmap: Dict[int, List[int]] = {}
+        pos = 0
+        for idxs, moved in groups:
+            first, last = idxs[0], idxs[-1]
+            if first < pos:       # overlapping window: drop this group
+                continue
+            iset = set(idxs)
+            mset = set(moved)
+            items.extend(("L", j) for j in range(pos, first))
+            items.extend(
+                ("L", j) for j in range(first, last + 1)
+                if j not in iset and j not in mset
+            )
+            items.append(("E", idxs[0]))
+            items.extend(("L", j) for j in moved)
+            gmap[idxs[0]] = idxs
+            pos = last + 1
+        items.extend(("L", j) for j in range(pos, L))
+        self._embed_cache = (items, gmap)
+        return self._embed_cache
+
+    @staticmethod
+    def _apply_branch_embed(gparams: List[dict], xs):
+        """One block-kernel conv for the whole branch group; per-member
+        outputs.  Member kernel/channel geometry comes from each
+        ``wmat`` (HWIO) — static under trace."""
+        from jax import lax
+
+        assert all(xi.shape[:3] == xs[0].shape[:3] for xi in xs), \
+            "branch-embed members must share input spatial dims"
+        ws = [d["wmat"].astype(xs[0].dtype) for d in gparams]
+        kmax = max(w.shape[0] for w in ws)
+        pad = (kmax - 1) // 2
+        x = jnp.concatenate(xs, axis=3)
+        C = sum(w.shape[2] for w in ws)
+        O = sum(w.shape[3] for w in ws)
+        wk = jnp.zeros((kmax, kmax, C, O), x.dtype)
+        coff = ooff = 0
+        for w in ws:
+            k, _, cin, cout = w.shape
+            d0 = (kmax - k) // 2
+            wk = wk.at[d0:d0 + k, d0:d0 + k,
+                       coff:coff + cin, ooff:ooff + cout].set(w)
+            coff += cin
+            ooff += cout
+        y = lax.conv_general_dilated(
+            x, wk, window_strides=(1, 1),
+            padding=((pad, pad), (pad, pad)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        outs = []
+        ooff = 0
+        for w, d in zip(ws, gparams):
+            part = lax.slice_in_dim(y, ooff, ooff + w.shape[3], axis=3)
+            ooff += w.shape[3]
             if "bias" in d:
                 part = part + d["bias"].astype(x.dtype)
             outs.append(part)
@@ -352,7 +570,28 @@ class FunctionalNet:
         fuse_groups, fuse_member = (
             self._sibling_1x1_groups() if self.fuse_1x1 else ({}, {})
         )
-        for i, spec in enumerate(g.layers):
+        embed_items, embed_groups = (
+            self._branch_embed_plan() if self.conv_branch_embed
+            else (None, {})
+        )
+        items = (embed_items if embed_items is not None
+                 else [("L", i) for i in range(len(g.layers))])
+        for kind, i in items:
+            spec = g.layers[i]
+            if kind == "E":
+                idxs = embed_groups[i]
+                xs = [nodes[g.layers[j].nindex_in[0]] for j in idxs]
+                if any(v is None for v in xs):
+                    raise ValueError(
+                        f"branch-embed group at layer {i}: unset input node")
+                gparams = [params.get(self.param_key[j], {}) for j in idxs]
+                run_f = (
+                    jax.checkpoint(self._apply_branch_embed)
+                    if (self.remat and train) else self._apply_branch_embed
+                )
+                for j, out in zip(idxs, run_f(gparams, xs)):
+                    nodes[g.layers[j].nindex_out[0]] = out
+                continue
             if i in fuse_member:
                 if fuse_member[i] != i:
                     continue  # output produced by its group leader below

@@ -22,6 +22,12 @@ Variants (default: all):
 * wino      — every 3x3 s1 conv via Winograd F(4x4,3x3)
               (``conv_wino = 1`` global): 4x fewer MACs on the
               inception 3x3 branches
+* bembed    — branch-embedding fusion (``conv_branch_embed = 1``):
+              each inception (3x3, 5x5) branch pair as ONE
+              block-kernel conv — ~3.6x MACs for an adequately-shaped
+              GEMM per module
+* bembed_lrnmm — bembed + ``lrn_impl = matmul`` (the promotion
+              candidate if both win)
 """
 
 import os
@@ -88,6 +94,14 @@ def variant_conf(name: str, batch: int) -> str:
         # global default: conv layers pick it up, 3x3-s1 only (others
         # keep the direct path), non-conv layers ignore the key
         return conf + "conv_wino = 1\n"
+    if name == "bembed":
+        # branch-embedding fusion: every inception (3x3, 5x5) branch
+        # pair as ONE block-kernel conv (net._branch_embed_plan) —
+        # ~3.6x MACs for an adequately-shaped GEMM per module
+        return conf + "conv_branch_embed = 1\n"
+    if name == "bembed_lrnmm":
+        # the likely promotion candidate: branch GEMMs + MXU LRN
+        return conf + "conv_branch_embed = 1\nlrn_impl = matmul\n"
     raise SystemExit(f"unknown variant {name}")
 
 
@@ -96,5 +110,5 @@ if __name__ == "__main__":
 
     run_bisect(variant_conf,
                ["base", "lrnmm", "nolrn", "stem1x1", "conv1x1",
-                "stems2d", "wino"],
+                "stems2d", "wino", "bembed", "bembed_lrnmm"],
                scan_k=50)

@@ -62,7 +62,7 @@ sweep() {
   # else spends the window
   run 1800 python bench.py
   # round-3 stranded A/Bs (VERDICT r3 #2), then the round-4 wino
-  sweep 900 python tools/googlenet_bisect.py base lrnmm stems2d wino
+  sweep 900 python tools/googlenet_bisect.py base lrnmm stems2d wino bembed bembed_lrnmm
   sweep 900 python tools/resnet_bisect.py base stems2d wino
   run 1500 python bench.py --resnet
   run 1500 python bench.py --vgg
