@@ -92,33 +92,54 @@ def test_distributed_spec_parsing():
 
 
 @pytest.mark.slow
-def test_two_process_training_weights_identical(tmp_path):
-    """2 procs x 2 CPU devices: same weights everywhere after training."""
+def _run_workers(script_text, tmp_path, nproc, ndev, extra_args=(),
+                 timeout=240):
+    """Launch nproc copies of a worker script over a fresh coordinator
+    port (ndev CPU devices each), assert success, return stdouts."""
     script = tmp_path / "worker.py"
-    script.write_text(WORKER)
+    script.write_text(script_text)
     port = _free_port()
     env = {
         **os.environ,
         "PYTHONPATH": REPO,
         "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={ndev}",
     }
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(r), "2", str(port),
-             str(tmp_path)],
+            [sys.executable, str(script), str(r), str(nproc), str(port)]
+            + [str(a) for a in extra_args],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
-        for r in range(2)
+        for r in range(nproc)
     ]
-    outs = [p.communicate(timeout=180)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:
+        for p in procs:  # bound the damage when a rank hangs/fails
+            if p.poll() is None:
+                p.kill()
     for p, o in zip(procs, outs):
         assert p.returncode == 0, o.decode()
-    w0 = np.load(tmp_path / "w0.npy")
-    w1 = np.load(tmp_path / "w1.npy")
-    np.testing.assert_allclose(w0, w1, rtol=0, atol=0)
+    return outs
+
+
+@pytest.mark.parametrize("nproc,ndev", [
+    (2, 2),
+    pytest.param(4, 1, marks=pytest.mark.slow),
+])
+def test_training_weights_identical_across_processes(tmp_path, nproc, ndev):
+    """nproc procs x ndev CPU devices, different local data per
+    process: weights bit-identical everywhere after training, and
+    check_weight_sync detects a single diverged rank.  The 4-process
+    row exercises the protocol beyond the pairwise case (VERDICT r4
+    #7)."""
+    _run_workers(WORKER, tmp_path, nproc, ndev, extra_args=[tmp_path])
+    ws = [np.load(tmp_path / f"w{r}.npy") for r in range(nproc)]
+    for r in range(1, nproc):
+        np.testing.assert_allclose(ws[0], ws[r], rtol=0, atol=0)
     # and training actually moved the weights
-    assert np.abs(w0).max() > 0
+    assert np.abs(ws[0]).max() > 0
 
 
 def _run_cli_dist(tmp_path, conf, port, nproc=2, ndev=2, timeout=300):
@@ -514,33 +535,13 @@ WORKER_SHARDED = textwrap.dedent(
 
 
 @pytest.mark.slow
-def test_two_process_sharded_weight_sync(tmp_path):
+@pytest.mark.parametrize("nproc,ndev", [(2, 2), (4, 1)])
+def test_sharded_weight_sync_across_processes(tmp_path, nproc, ndev):
     """The cross-process branch of the shard-granular sync check: a
-    2x2 (data x model) mesh over 2 processes puts replicas of the same
-    TP shard on DIFFERENT processes; the check passes healthy and
-    detects a single corrupted remote replica on every rank."""
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER_SHARDED)
-    port = _free_port()
-    env = {
-        **os.environ,
-        "PYTHONPATH": REPO,
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-    }
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(r), "2", str(port)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        )
-        for r in range(2)
-    ]
-    try:
-        outs = [p.communicate(timeout=180)[0] for p in procs]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for p, o in zip(procs, outs):
-        assert p.returncode == 0, o.decode()
+    2x2 (data x model) mesh over nproc processes puts replicas of the
+    same TP shard on DIFFERENT processes (at 4 processes every replica
+    pair spans two); the check passes healthy and detects a single
+    corrupted remote replica on every rank (VERDICT r4 #7)."""
+    outs = _run_workers(WORKER_SHARDED, tmp_path, nproc, ndev)
+    for o in outs:
         assert b"ok" in o
