@@ -216,6 +216,81 @@ def test_export_roundtrip(tmp_path, with_stride):
                                       err_msg=f"{name}/{tag}")
 
 
+def test_export_import_fuzz_roundtrip(tmp_path):
+    """Property sweep over random weighted-layer stacks and both Shape
+    encodings: export -> auto-detected parse must return the graph and
+    every tensor bit-exactly.  Guards the byte-layout code (which has
+    already had one silent field-omission bug — the advisor-r4
+    input_shape finding) against layout drift for ANY layer mix, not
+    just the one fixture."""
+    import numpy as np
+
+    from import_ref_model import export_ref_model
+
+    rng = np.random.RandomState(7)
+    weighted = ["conv", "fullc", "batch_norm", "prelu"]
+    for trial in range(6):
+        with_stride = bool(trial % 2)
+        n_ch = int(rng.randint(2, 7))
+        picks = [weighted[int(rng.randint(4))] for _ in range(3)]
+        lines = ["netconfig = start"]
+        node = 0
+        for k, t in enumerate(picks):
+            name = f"L{k}"
+            if t == "conv":
+                lines += [f"layer[{node}->{node + 1}] = conv:{name}",
+                          "  kernel_size = 3", "  pad = 1",
+                          f"  nchannel = {n_ch}"]
+            elif t == "fullc":
+                lines += [f"layer[{node}->{node + 1}] = flatten"]
+                node += 1
+                lines += [f"layer[{node}->{node + 1}] = fullc:{name}",
+                          f"  nhidden = {n_ch}"]
+            elif t == "batch_norm":
+                lines += [f"layer[{node}->{node + 1}] = batch_norm:{name}"]
+            else:
+                lines += [f"layer[{node}->{node + 1}] = prelu:{name}"]
+            node += 1
+            # fullc flattens: everything after stays flat
+            if t == "fullc":
+                break
+        lines += [f"layer[{node}->{node + 1}] = flatten",
+                  f"layer[{node + 1}->{node + 2}] = fullc:out",
+                  "  nhidden = 4",
+                  f"layer[{node + 2}->{node + 2}] = softmax",
+                  "netconfig = end",
+                  "input_shape = 3,6,6", "batch_size = 2", "dev = cpu"]
+        conf = "\n".join(lines)
+        from cxxnet_tpu import config as cfgmod
+        from cxxnet_tpu.nnet.trainer import NetTrainer
+
+        tr = NetTrainer()
+        tr.set_params(cfgmod.parse_pairs(conf))
+        tr.init_model()
+        tr.epoch_counter = 100 + trial
+        path = str(tmp_path / f"fuzz{trial}.model")
+        n = export_ref_model(tr, path, with_stride=with_stride)
+        assert n >= 2
+        _nt, _nodes, infos, epoch, weights, ishape = parse_ref_model(path)
+        assert epoch == 100 + trial
+        assert ishape == (3, 6, 6)
+        assert len(infos) == len(tr.graph.layers)
+        tr2 = NetTrainer()
+        tr2.set_params(cfgmod.parse_pairs(conf))
+        tr2.init_model()
+        assert install(tr2, infos, weights) == n
+        for i, spec in enumerate(tr.graph.layers):
+            if not spec.name:
+                continue
+            for tag in ("wmat", "bias"):
+                a = tr.get_weight(spec.name, tag)
+                if a is None or a.size == 0:
+                    continue
+                np.testing.assert_array_equal(
+                    a, tr2.get_weight(spec.name, tag),
+                    err_msg=f"trial {trial} {spec.name}/{tag}")
+
+
 def test_parser_survives_truncation_everywhere(tmp_path):
     """Every truncation of a valid model raises ValueError (never a
     hang, struct.error leak, or silent partial parse)."""

@@ -17,7 +17,12 @@ REAL fused train step (the same ``NetTrainer._fused_step_fn`` program
 Usage (CPU works for structure; run on TPU for the real backend's
 fusion decisions):
 
-    python tools/hlo_inspect.py [googlenet|resnet|vgg|alexnet] [batch]
+    python tools/hlo_inspect.py [googlenet|resnet|vgg|alexnet] [batch] [k=v ...]
+
+Trailing ``k=v`` pairs are appended to the conf — e.g.
+``conv_branch_embed=1`` shows the branch-embedding rewrite collapsing
+the 18 inception branch convs into 9 block-kernel convs (compare the
+convolution/dot count against the base run).
 """
 
 import collections
@@ -28,7 +33,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_trainer(model: str, batch: int):
+def build_trainer(model: str, batch: int, overrides=()):
     from cxxnet_tpu import config as cfgmod
     from cxxnet_tpu.models import (alexnet_conf, googlenet_conf,
                                    resnet50_conf, vgg16_conf)
@@ -40,6 +45,7 @@ def build_trainer(model: str, batch: int):
         "vgg": vgg16_conf,
         "alexnet": alexnet_conf,
     }[model](batch_size=batch, synthetic=False, dev="tpu")
+    conf += "".join(f"{k} = {v}\n" for k, v in overrides)
     tr = NetTrainer()
     tr.set_params(cfgmod.parse_pairs(conf))
     tr.eval_train = 0
@@ -108,10 +114,13 @@ def summarize(hlo: str) -> None:
 
 
 def main() -> None:
-    model = sys.argv[1] if len(sys.argv) > 1 else "googlenet"
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    args = sys.argv[1:]
+    overrides = [tuple(a.split("=", 1)) for a in args if "=" in a]
+    args = [a for a in args if "=" not in a]
+    model = args[0] if args else "googlenet"
+    batch = int(args[1]) if len(args) > 1 else 16
     size = 227 if model == "alexnet" else 224
-    tr = build_trainer(model, batch)
+    tr = build_trainer(model, batch, overrides)
     hlo = optimized_hlo(tr, batch, size)
     out = f"/tmp/hlo_{model}.txt"
     with open(out, "w") as f:
