@@ -228,6 +228,50 @@ def test_branch_embed_off_domain_no_group():
         assert gmap == {} and items is None
 
 
+def test_branch_embed_checkpoint_interchange(tmp_path):
+    """Parameters stay per-layer under the fusion: a checkpoint saved
+    from a bembed-trained net loads into a plain net (and back) with
+    identical predictions — the fusion is execution-only state."""
+    ta = _build(1)
+    rng = np.random.RandomState(9)
+    x = rng.randn(16, 12, 12, 8).astype(np.float32)
+    y = rng.randint(0, 4, (16, 1)).astype(np.float32)
+    ta.update_all(x, y)
+    p = str(tmp_path / "be.model")
+    ta.save_model(p)
+    tb = _build(0)
+    tb.load_model(p)
+    xa = jnp.asarray(x)
+    na, _ = ta.net.forward(ta.params, xa, train=False)
+    nb, _ = tb.net.forward(tb.params, xa, train=False)
+    np.testing.assert_allclose(
+        np.asarray(na[ta.net.out_node_index()]),
+        np.asarray(nb[tb.net.out_node_index()]), rtol=2e-4, atol=2e-5)
+
+
+def test_branch_embed_update_scan():
+    """The device-side scanned step (update_scan) runs the same
+    forward; a scanned round with the fusion on matches per-step
+    updates with it off within the SPMD-parity tolerance."""
+    ta, tb = _build(1), _build(0)
+    rng = np.random.RandomState(13)
+    xs = rng.randn(4, 16, 12, 12, 8).astype(np.float32)
+    ys = rng.randint(0, 4, (4, 16, 1)).astype(np.float32)
+    ta.update_scan(xs, ys)
+    from cxxnet_tpu.io.data import DataBatch
+
+    for k in range(4):
+        tb.update(DataBatch(data=xs[k], label=ys[k]))
+    for key in ta.params:
+        for tag in ta.params[key]:
+            np.testing.assert_allclose(
+                np.asarray(ta.params[key][tag]),
+                np.asarray(tb.params[key][tag]),
+                rtol=2e-3, atol=2e-4,
+                err_msg=f"{key}/{tag} diverged (scan+embed vs plain)",
+            )
+
+
 def test_branch_embed_with_remat_and_bf16():
     """Smoke: composes with jax.checkpoint and compute_dtype=bfloat16
     (the two knobs most likely to interact with a custom apply path)."""

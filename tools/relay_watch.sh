@@ -9,14 +9,28 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=${1:-/tmp/relay_watch.log}
 PORT=${AXON_RELAY_PORT:-8082}
+# WATCH_DEADLINE_EPOCH (optional): stop watching past this time and
+# export it to the queue as its hard deadline, so a late relay window
+# never leaves the flock held into the driver's own bench run
+DEADLINE=${WATCH_DEADLINE_EPOCH:-}
 {
-  echo "[relay_watch] start $(date -u +%FT%TZ) port=$PORT"
+  echo "[relay_watch] start $(date -u +%FT%TZ) port=$PORT deadline=${DEADLINE:-none}"
   while :; do
+    # checked here (not only in the wait loop) so the rc!=0 retry path
+    # can never fire the queue past the deadline either
+    if [ -n "$DEADLINE" ] && [ "$(date +%s)" -ge "$DEADLINE" ]; then
+      echo "[relay_watch] deadline passed; exiting"
+      exit 0
+    fi
     until timeout 3 bash -c "echo > /dev/tcp/127.0.0.1/$PORT" 2>/dev/null; do
+      if [ -n "$DEADLINE" ] && [ "$(date +%s)" -ge "$DEADLINE" ]; then
+        echo "[relay_watch] deadline passed while waiting; exiting"
+        exit 0
+      fi
       sleep "${RELAY_WATCH_INTERVAL:-120}"
     done
     echo "[relay_watch] relay UP $(date -u +%FT%TZ) — firing tpu_queue"
-    bash tools/tpu_queue.sh /tmp/tpu_queue.log
+    QUEUE_HARD_DEADLINE_EPOCH="$DEADLINE" bash tools/tpu_queue.sh /tmp/tpu_queue.log
     rc=$?
     echo "[relay_watch] queue done rc=$rc $(date -u +%FT%TZ)"
     # rc=1 (flock held by a manual run) or rc=2 (relay died between the
