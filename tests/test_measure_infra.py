@@ -1,0 +1,82 @@
+"""The measurement-infrastructure shell tools (tools/tpu_queue.sh,
+tools/relay_watch.sh) — the pieces whose failure modes burned rounds
+3-4 (rc=124 with no diagnostic, missed relay windows, a held flock in
+the driver's bench window).  Pure-bash behavior, testable without a
+relay.
+"""
+
+import os
+import subprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+QUEUE = os.path.join(REPO, "tools", "tpu_queue.sh")
+WATCH = os.path.join(REPO, "tools", "relay_watch.sh")
+
+
+def _bash(script: str, **env) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        ["bash", "-c", script], capture_output=True, text=True,
+        env={**os.environ, **{k: str(v) for k, v in env.items()}},
+    )
+
+
+def test_queue_refuses_when_relay_dead(tmp_path):
+    """Dead relay -> rc=2 refusal in seconds, never a dial attempt.
+    (Points the probe at a port nothing listens on.)"""
+    r = _bash(f"bash {QUEUE} {tmp_path}/q.log", AXON_RELAY_PORT="1",
+              TPU_RELAY_LOCK=str(tmp_path / "lock"))
+    assert r.returncode == 2
+    assert "relay dead" in r.stderr
+
+
+def test_queue_deadline_gate():
+    """run/sweep skip entries whose budget cannot finish before
+    QUEUE_HARD_DEADLINE_EPOCH — the guard that keeps a late-window
+    queue from holding the relay flock into the driver's own bench."""
+    script = f"""
+source /dev/stdin <<EOF
+$(sed -n '/^fits_deadline/,/^}}/p; /^run()/,/^}}/p; /^sweep()/,/^}}/p' {QUEUE})
+EOF
+export QUEUE_HARD_DEADLINE_EPOCH=$(( $(date +%s) + 300 ))
+run 1800 echo LONG
+run 60 echo SHORT
+sweep 900 python tools/x.py a b || true
+sweep 30 true tools/y.py v1 v2 v3 || true
+"""
+    r = _bash(script)
+    out = r.stdout
+    assert "SKIP (deadline): echo LONG" in out
+    assert "=== echo SHORT ===" in out
+    # 900*(2+1) > 300s away -> skipped; 30*(3+1) fits -> runs
+    assert "SKIP (deadline): python tools/x.py a b" in out
+    assert "(n=3, per=30)" in out
+
+
+def test_sweep_requires_explicit_variants():
+    """n=0 would make `timeout 0` disable the external backstop
+    entirely (GNU semantics) — sweep refuses instead."""
+    script = f"""
+source /dev/stdin <<EOF
+$(sed -n '/^fits_deadline/,/^}}/p; /^sweep()/,/^}}/p' {QUEUE})
+EOF
+sweep 900 python tools/x_bisect.py && echo UNEXPECTED || echo REFUSED
+"""
+    r = _bash(script)
+    assert "REFUSED" in r.stdout
+    assert "list variants explicitly" in r.stderr
+
+
+def test_watcher_exits_at_deadline(tmp_path):
+    """A watcher started past its deadline exits without firing the
+    queue (both the wait path and the outer loop check it)."""
+    log = tmp_path / "w.log"
+    r = _bash(
+        f"bash {WATCH} {log}",
+        WATCH_DEADLINE_EPOCH=1,       # 1970: always past
+        AXON_RELAY_PORT="1",          # and the relay looks dead
+        RELAY_WATCH_INTERVAL="1",
+    )
+    assert r.returncode == 0
+    text = log.read_text()
+    assert "deadline passed" in text
+    assert "firing tpu_queue" not in text

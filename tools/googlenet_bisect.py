@@ -102,6 +102,14 @@ def variant_conf(name: str, batch: int) -> str:
     if name == "bembed_lrnmm":
         # the likely promotion candidate: branch GEMMs + MXU LRN
         return conf + "conv_branch_embed = 1\nlrn_impl = matmul\n"
+    if name == "best":
+        # every opt-in lever at once (stem s2d + MXU LRN + branch
+        # embedding): the upper bound a combined promotion could reach
+        out = _sub(conf,
+            "layer[0->c1] = conv:conv1\n",
+            "layer[0->c1] = conv:conv1\n  conv_s2d = 1\n",
+        )
+        return out + "conv_branch_embed = 1\nlrn_impl = matmul\n"
     raise SystemExit(f"unknown variant {name}")
 
 
@@ -110,5 +118,5 @@ if __name__ == "__main__":
 
     run_bisect(variant_conf,
                ["base", "lrnmm", "nolrn", "stem1x1", "conv1x1",
-                "stems2d", "wino", "bembed", "bembed_lrnmm"],
+                "stems2d", "wino", "bembed", "bembed_lrnmm", "best"],
                scan_k=50)

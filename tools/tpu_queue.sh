@@ -9,7 +9,7 @@
 set -u
 cd "$(dirname "$0")/.."
 LOG=${1:-/tmp/tpu_queue.log}
-LOCK=/tmp/tpu_relay.lock
+LOCK=${TPU_RELAY_LOCK:-/tmp/tpu_relay.lock}
 
 exec 9>"$LOCK"
 if ! flock -n 9; then
@@ -80,7 +80,7 @@ sweep() {
   # else spends the window
   run 1800 python bench.py
   # round-3 stranded A/Bs (VERDICT r3 #2), then the round-4 wino
-  sweep 900 python tools/googlenet_bisect.py base lrnmm stems2d wino bembed bembed_lrnmm
+  sweep 900 python tools/googlenet_bisect.py base lrnmm stems2d wino bembed bembed_lrnmm best
   sweep 900 python tools/resnet_bisect.py base stems2d wino
   run 1500 python bench.py --resnet
   run 1500 python bench.py --vgg
