@@ -41,6 +41,10 @@ class LearnTask:
         self.start_counter = 0
         self.continue_training = 0
         self.save_period = 1
+        self.keep_latest = 0  # retention: 0 keeps every checkpoint
+        self.divergence_policy = ""  # "" off | abort | rollback
+        self.divergence_lr_backoff = 0.5
+        self.divergence_max_retries = 3
         self.name_model_in = "NULL"
         self.name_pred = "pred.txt"
         self.print_step = 100
@@ -66,6 +70,14 @@ class LearnTask:
             self.continue_training = int(val)
         elif name == "save_model":
             self.save_period = int(val)
+        elif name == "keep_latest":
+            self.keep_latest = int(val)
+        elif name == "divergence_policy":
+            self.divergence_policy = "" if val == "off" else val
+        elif name == "divergence_lr_backoff":
+            self.divergence_lr_backoff = float(val)
+        elif name == "divergence_max_retries":
+            self.divergence_max_retries = int(val)
         elif name == "start_counter":
             self.start_counter = int(val)
         elif name == "model_in":
@@ -172,19 +184,93 @@ class LearnTask:
             self._load_model()
         self._create_iterators()
 
+    def _net_fingerprint(self) -> Optional[str]:
+        """Fingerprint of the conf's netconfig (manifest cross-check on
+        resume); None when the conf has no parseable netconfig."""
+        from .nnet.graph import NetGraph
+        from .utils import checkpoint as ckpt
+
+        try:
+            g = NetGraph()
+            g.configure(self.cfg)
+            return ckpt.net_fingerprint(g.structure_to_json())
+        except Exception:
+            return None
+
+    def _locate_agreed_checkpoint(self, before=None):
+        """THE distributed resume/rollback discovery protocol — one copy
+        so every caller issues the identical collective sequence (a
+        divergent copy would deadlock multi-process runs).
+
+        Collective: finds the newest locally-valid checkpoint, agrees on
+        the newest round EVERY process holds (``agree_on_round``),
+        validates the agreed round when it is older than the local
+        newest (``find_latest_valid`` only vouched for the newest —
+        consensus must not launder a corrupt/pruned file past the
+        integrity checks), and agrees on the usable/unusable verdict
+        (``any_process_flag`` — a lone local abort would strand the
+        peers at their next collective).
+
+        Returns ``(round_, path, reason)``: ``round_ == -1`` when no
+        process has any valid checkpoint; ``reason`` is not None (or
+        path unusable on a peer, reason None with path set) when the
+        agreed round failed validation somewhere — the caller decides
+        raise vs bail."""
+        from .parallel.distributed import agree_on_round, any_process_flag
+        from .utils import checkpoint as ckpt
+
+        net_fp = self._net_fingerprint()
+        found = ckpt.find_latest_valid(
+            self.name_model_dir, net_fp=net_fp, silent=bool(self.silent),
+            before=before,
+        )
+        local_round = found[0] if found else -1
+        round_ = agree_on_round(local_round)
+        if round_ < 0:
+            return -1, None, None
+        if round_ == local_round:
+            path, reason = found[1], None
+        else:
+            if not self.silent:
+                print(f"resume: agreed on round {round_} across processes "
+                      f"(local newest was {local_round})")
+            path = os.path.join(self.name_model_dir, f"{round_:04d}.model")
+            reason = ckpt.validate_checkpoint(path, net_fp=net_fp)
+        if any_process_flag(reason is not None):
+            return round_, path, reason or "unusable on a peer process"
+        return round_, path, None
+
+    def _load_trainer(self, path: str) -> NetTrainer:
+        """Fresh trainer with ``path`` loaded, retrying transient I/O."""
+        from .utils import checkpoint as ckpt
+
+        tr = self._create_trainer()
+        ckpt.retry_io(lambda: tr.load_model(path),
+                      what=f"loading {path}", silent=bool(self.silent))
+        return tr
+
     def _sync_latest_model(self) -> bool:
-        s = self.start_counter
-        last = None
-        while True:
-            path = os.path.join(self.name_model_dir, f"{s:04d}.model")
-            if not os.path.exists(path):
-                break
-            last, s = path, s + 1
-        if last is None:
+        """Resume from the newest VALID checkpoint in ``model_dir``.
+
+        Globs all ``NNNN.model`` files (the old consecutive scan stopped
+        at the first gap, so ``save_model > 1`` or ``keep_latest``
+        pruning made resume find nothing), validates each against its
+        manifest (CRC32 + size + net fingerprint), and falls back past
+        corrupt/truncated ones — a kill mid-write never bricks resume.
+        Multi-process runs agree on the newest round EVERY process can
+        see before anyone loads."""
+        from .utils import checkpoint as ckpt
+
+        round_, path, reason = self._locate_agreed_checkpoint()
+        if round_ < 0:
             return False
-        self.net_trainer = self._create_trainer()
-        self.net_trainer.load_model(last)
-        self.start_counter = s
+        if reason is not None:
+            raise ckpt.CheckpointError(
+                f"resume: processes agreed on round {round_} but "
+                f"{path} is unusable: {reason}"
+            )
+        self.net_trainer = self._load_trainer(path)
+        self.start_counter = round_ + 1
         return True
 
     def _load_model(self) -> None:
@@ -201,13 +287,60 @@ class LearnTask:
         self.net_trainer.load_model(self.name_model_in)
         self.start_counter += 1
 
-    def _save_model(self) -> None:
-        path = os.path.join(self.name_model_dir, f"{self.start_counter:04d}.model")
+    def _save_model(self, force: bool = False) -> bool:
+        """Checkpoint the current state as ``NNNN.model`` + manifest.
+
+        Fault-tolerant write discipline: serialize (COLLECTIVE — every
+        process assembles sharded state), then rank 0 alone writes
+        atomically with retry/backoff, applies ``keep_latest`` retention,
+        and everyone re-synchronizes at a barrier so no process reads a
+        checkpoint before it is durable.  ``force=True`` (preemption
+        snapshot) bypasses the ``save_model`` period gate — though
+        ``save_model = 0`` (checkpointing disabled) stays disabled.
+        Returns True when a checkpoint was written."""
+        from .parallel.distributed import (
+            any_process_flag, barrier, is_primary, process_info,
+        )
+        from .utils import checkpoint as ckpt
+
+        round_ = self.start_counter
+        path = os.path.join(self.name_model_dir, f"{round_:04d}.model")
         self.start_counter += 1
-        if self.save_period == 0 or self.start_counter % self.save_period != 0:
-            return
-        os.makedirs(self.name_model_dir, exist_ok=True)
-        self.net_trainer.save_model(path)
+        if self.save_period == 0 or (
+                not force and self.start_counter % self.save_period != 0):
+            return False
+        blob = self.net_trainer.checkpoint_bytes()
+        err = None
+        if is_primary():
+            try:
+                os.makedirs(self.name_model_dir, exist_ok=True)
+                ckpt.write_checkpoint(
+                    path, blob, round_=round_,
+                    net_fp=self.net_trainer.net_fp(),
+                    save_ustate=self.net_trainer.save_ustate,
+                    retry=True, silent=bool(self.silent),
+                )
+                if self.keep_latest > 0:
+                    ckpt.apply_retention(
+                        self.name_model_dir, self.keep_latest,
+                        silent=bool(self.silent),
+                    )
+            except Exception as exc:  # noqa: BLE001 - relayed collectively
+                err = exc
+        if process_info()[1] > 1:
+            # success/failure must be exchanged BEFORE the barrier — a
+            # raise on rank 0 alone would strand the other ranks in it
+            if any_process_flag(err is not None):
+                if err is not None:
+                    raise err
+                raise ckpt.CheckpointError(
+                    f"checkpoint {path} failed to write on the primary "
+                    "process"
+                )
+            barrier("ckpt_save")
+        elif err is not None:
+            raise err
+        return True
 
     def _create_iterators(self) -> None:
         split = cfgmod.split_sections(self.cfg)
@@ -270,7 +403,10 @@ class LearnTask:
 
     # ------------------------------------------------------------------
     def task_train(self) -> None:
-        start = time.time()
+        from .parallel.distributed import any_process_flag, process_info
+        from .utils.checkpoint import DivergenceError, PreemptionHandler
+
+        self._train_start = time.time()
         if self.continue_training == 0 and self.name_model_in == "NULL":
             self._save_model()
         else:
@@ -287,173 +423,293 @@ class LearnTask:
         timer = StepTimer()
         tracer = TraceController()
         tracer.configure(self.cfg)
-        global_step = 0
-        cc = self.max_round
-        while self.start_counter <= self.num_round and cc > 0:
-            cc -= 1
-            if not self.silent:
-                print(f"update round {self.start_counter - 1}", flush=True)
-            sample_counter = 0
-            self.net_trainer.start_round(self.start_counter)
-            self.itr_train.before_first()
-            timer.clear()
-            pipe_mark = time.perf_counter()  # last fence (lap start)
-            pending: List = []  # scan_steps>1: batches staged for ONE dispatch
-            in_flight: List = []  # async (handle, n_steps) chunks in flight
+        self._global_step = 0
+        self._divergence_retries = 0
+        self._lr_scale = 1.0
+        nproc = process_info()[1]
+        # SIGTERM/SIGINT → finish the current step, snapshot, exit clean.
+        # Single-process runs stop at the next BATCH boundary; multi-
+        # process runs stop at the next ROUND boundary (the per-batch
+        # check would need a per-batch collective to keep the SPMD
+        # programs aligned) — the flag is agreed across processes so one
+        # preempted worker stops the whole job consistently.
+        self._preempt = PreemptionHandler().install()
+        preempted = False
+        try:
+            cc = self.max_round
+            while self.start_counter <= self.num_round and cc > 0:
+                cc -= 1
+                try:
+                    completed = self._train_one_round(timer, tracer)
+                except DivergenceError as e:
+                    if self._handle_divergence(e):
+                        cc += 1  # the aborted attempt keeps its budget
+                        continue
+                    tracer.close()
+                    raise
+                self._divergence_retries = 0
+                if not completed:  # preempted mid-round (single-process)
+                    snapshotted = self._save_model(force=True)
+                    preempted = True
+                    break
+                # boundary preemption check (collective in multi-process
+                # runs): force the snapshot past the save_model period
+                # gate so the preempted state is never lost
+                stop = (self._preempt.requested if nproc == 1
+                        else any_process_flag(self._preempt.requested))
+                snapshotted = self._save_model(force=stop)
+                if stop:
+                    preempted = True
+                    break
+        finally:
+            self._preempt.uninstall()
+        tracer.close()
+        if preempted:
+            last = self.start_counter - 1
+            if snapshotted:
+                print(
+                    f"preemption: state saved through round {last} "
+                    f"({last:04d}.model); resume with continue=1",
+                    flush=True,
+                )
+            else:
+                print("preemption: exiting (checkpointing disabled, "
+                      "save_model=0)", flush=True)
+            return
+        if not self.silent:
+            print(f"\nupdating end, "
+                  f"{int(time.time() - self._train_start)} sec in all")
 
-            def _lap(n_steps: int) -> None:
-                """Fold the span since the last fence into the timer —
-                decode + dispatch + device wait for one chunk.  The laps
-                (plus the round-end drain) tile the round's wall time
-                exactly, so samples/sec is the true PIPELINE rate (max of
-                host and device time per chunk), not just device time."""
-                nonlocal pipe_mark
-                now = time.perf_counter()
-                timer.add(now - pipe_mark, n_steps)
-                pipe_mark = now
+    def _handle_divergence(self, e) -> bool:
+        """Respond to a non-finite loss per ``divergence_policy``.
 
-            def _fence(drain_all: bool) -> None:
-                """Block on finished chunks, recording a lap per chunk.
-                ``drain_all=False`` keeps the newest chunk running — the
-                double buffer (chunk k-1 must land before k+2 stages)."""
-                import jax as _jx
+        ``rollback``: reload the newest valid checkpoint, optionally back
+        off the learning rate (``divergence_lr_backoff``), and retry —
+        up to ``divergence_max_retries`` consecutive failures.  Returns
+        True when training should continue; False aborts (the default
+        ``abort`` policy: stop rather than train on corrupt weights)."""
+        print(f"DIVERGENCE: {e}", flush=True)
+        if self.divergence_policy != "rollback":
+            return False
+        if self._divergence_retries >= self.divergence_max_retries:
+            print(
+                f"divergence: giving up after "
+                f"{self._divergence_retries} consecutive rollbacks",
+                flush=True,
+            )
+            return False
+        # the injected fault (fault-injection harness) is one-shot: drop
+        # it from the cfg so the rebuilt trainer doesn't re-arm it
+        self.cfg = [(n, v) for n, v in self.cfg if n != "inject_nan_step"]
+        bound = None  # exclusive upper round bound while falling back
+        while True:
+            round_, path, reason = self._locate_agreed_checkpoint(
+                before=bound)
+            if round_ < 0:
+                print("divergence: no valid checkpoint to roll back to",
+                      flush=True)
+                return False
+            if reason is not None:
+                print(f"divergence: agreed rollback target round {round_} "
+                      f"is unusable: {reason}", flush=True)
+                return False
+            tr = self._load_trainer(path)
+            if tr.weights_finite():  # collective — identical verdict
+                break
+            # CRC-valid but numerically poisoned: the blow-up happened in
+            # the LAST update of the round this checkpoint captured (its
+            # losses were measured pre-update, all finite) — exclude it
+            # and fall back further
+            print(f"divergence: checkpoint {path} carries non-finite "
+                  "weights; falling back past it", flush=True)
+            bound = round_
+        self._divergence_retries += 1
+        if self.divergence_lr_backoff != 1.0:
+            self._lr_scale *= self.divergence_lr_backoff
+            tr.scale_learning_rate(self._lr_scale)
+        self.net_trainer = tr
+        self.start_counter = round_ + 1
+        print(
+            f"divergence: rolled back to round {round_} ({path}), "
+            f"lr scale now {self._lr_scale:g} "
+            f"(retry {self._divergence_retries}/"
+            f"{self.divergence_max_retries})",
+            flush=True,
+        )
+        return True
 
-                while len(in_flight) > (0 if drain_all else 1):
-                    handle, ns = in_flight.pop(0)
-                    _jx.block_until_ready(handle)
-                    _lap(ns)
+    def _train_one_round(self, timer, tracer) -> bool:
+        """Run one training round; returns False when a preemption
+        request stopped the round early (single-process only — see
+        task_train), True when the round ran to completion."""
+        if not self.silent:
+            print(f"update round {self.start_counter - 1}", flush=True)
+        from .parallel.distributed import process_info
 
-            def _flush_pending() -> None:
-                """Run staged batches as one device program (lax.scan over
-                the fused step) — amortizes per-dispatch host cost
-                exactly like bench.py (doc/performance.md).
+        check_preempt = process_info()[1] == 1
+        preempted = False
+        sample_counter = 0
+        self.net_trainer.start_round(self.start_counter)
+        self.itr_train.before_first()
+        timer.clear()
+        pipe_mark = time.perf_counter()  # last fence (lap start)
+        pending: List = []  # scan_steps>1: batches staged for ONE dispatch
+        in_flight: List = []  # async (handle, n_steps) chunks in flight
 
-                With ``eval_train = 0`` the scan dispatch is ASYNC: the
-                device chews chunk k while the host decodes/augments
-                chunk k+1 (the reference's two-stage ThreadBuffer
-                overlap, here via XLA's async dispatch queue).  At most
-                two chunks stay in flight — a double buffer — so host
-                memory stays bounded.  Timing is fence-to-fence (_lap):
-                each recorded span covers a chunk's host decode AND its
-                device wait, so the round statistics report the honest
-                pipeline rate.  With ``eval_train = 1`` every chunk is
-                synchronous (metrics fetch outputs) and the timer spans
-                just the dispatch+wait, the plain step-time metric."""
-                nonlocal global_step
-                if not pending:
-                    return
-                tracer.step(global_step)
-                sync_mode = bool(self.net_trainer.eval_train)
-                if sync_mode:
-                    timer.start()
-                if len(pending) == 1:
-                    from .io.data import DataBatch as _DB
+        def _lap(n_steps: int) -> None:
+            """Fold the span since the last fence into the timer —
+            decode + dispatch + device wait for one chunk.  The laps
+            (plus the round-end drain) tile the round's wall time
+            exactly, so samples/sec is the true PIPELINE rate (max of
+            host and device time per chunk), not just device time."""
+            nonlocal pipe_mark
+            now = time.perf_counter()
+            timer.add(now - pipe_mark, n_steps)
+            pipe_mark = now
 
-                    if not sync_mode:
-                        _fence(drain_all=True)  # update() syncs anyway
-                    self.net_trainer.update(
-                        _DB(data=pending[0][0], label=pending[0][1])
-                    )
-                    if not sync_mode:
-                        self.net_trainer.sync()
-                        _lap(1)
-                else:
+        def _fence(drain_all: bool) -> None:
+            """Block on finished chunks, recording a lap per chunk.
+            ``drain_all=False`` keeps the newest chunk running — the
+            double buffer (chunk k-1 must land before k+2 stages)."""
+            import jax as _jx
+
+            while len(in_flight) > (0 if drain_all else 1):
+                handle, ns = in_flight.pop(0)
+                _jx.block_until_ready(handle)
+                _lap(ns)
+
+        def _flush_pending() -> None:
+            """Run staged batches as one device program (lax.scan over
+            the fused step) — amortizes per-dispatch host cost
+            exactly like bench.py (doc/performance.md).
+
+            With ``eval_train = 0`` the scan dispatch is ASYNC: the
+            device chews chunk k while the host decodes/augments
+            chunk k+1 (the reference's two-stage ThreadBuffer
+            overlap, here via XLA's async dispatch queue).  At most
+            two chunks stay in flight — a double buffer — so host
+            memory stays bounded.  Timing is fence-to-fence (_lap):
+            each recorded span covers a chunk's host decode AND its
+            device wait, so the round statistics report the honest
+            pipeline rate.  With ``eval_train = 1`` every chunk is
+            synchronous (metrics fetch outputs) and the timer spans
+            just the dispatch+wait, the plain step-time metric."""
+            if not pending:
+                return
+            tracer.step(self._global_step)
+            sync_mode = bool(self.net_trainer.eval_train)
+            if sync_mode:
+                timer.start()
+            if len(pending) == 1:
+                from .io.data import DataBatch as _DB
+
+                if not sync_mode:
+                    _fence(drain_all=True)  # update() syncs anyway
+                self.net_trainer.update(
+                    _DB(data=pending[0][0], label=pending[0][1])
+                )
+                if not sync_mode:
+                    self.net_trainer.sync()
+                    _lap(1)
+            else:
+                import numpy as _np
+
+                handle = self.net_trainer.update_scan(
+                    _np.stack([d for d, _ in pending]),
+                    _np.stack([l for _, l in pending]),
+                    sync=sync_mode,
+                    # sharded iterators guarantee equal K per process
+                    # (equal-steps contract) — skip the collective
+                    # K-check so the async overlap stays unbroken
+                    check_steps=False,
+                )
+                if not sync_mode:
+                    in_flight.append((handle, len(pending)))
+                    _fence(drain_all=False)
+            if sync_mode:
+                timer.stop(n_steps=len(pending))
+            self._global_step += len(pending)
+            pending.clear()
+
+        def _drain_in_flight() -> None:
+            _fence(drain_all=True)
+
+        # multi-process scan is safe from the CLI: sharded train
+        # iterators run equal batch counts per round (equal-steps
+        # contract), so every process flushes identical [K, ...]
+        # stacks at the same points
+        scan_ok = (
+            self.scan_steps > 1
+            and self.net_trainer.update_period == 1
+            and not self.net_trainer._n_extras()
+            # node-bound train metrics need the per-step node
+            # forwards only update() provides (irrelevant when
+            # eval_train is off — train metrics never run then)
+            and not (self.net_trainer.eval_train
+                     and self.net_trainer.train_metric.need_nodes())
+        )
+        while self.itr_train.next():
+            if self.test_io == 0:
+                batch = self.itr_train.value()
+                if scan_ok and not batch.num_batch_padd:
                     import numpy as _np
 
-                    handle = self.net_trainer.update_scan(
-                        _np.stack([d for d, _ in pending]),
-                        _np.stack([l for _, l in pending]),
-                        sync=sync_mode,
-                        # sharded iterators guarantee equal K per process
-                        # (equal-steps contract) — skip the collective
-                        # K-check so the async overlap stays unbroken
-                        check_steps=False,
+                    # copy: iterator buffers are reused by next()
+                    pending.append(
+                        (_np.array(batch.data), _np.array(batch.label))
                     )
-                    if not sync_mode:
-                        in_flight.append((handle, len(pending)))
-                        _fence(drain_all=False)
-                if sync_mode:
-                    timer.stop(n_steps=len(pending))
-                global_step += len(pending)
-                pending.clear()
-
-            def _drain_in_flight() -> None:
-                _fence(drain_all=True)
-
-            # multi-process scan is safe from the CLI: sharded train
-            # iterators run equal batch counts per round (equal-steps
-            # contract), so every process flushes identical [K, ...]
-            # stacks at the same points
-            scan_ok = (
-                self.scan_steps > 1
-                and self.net_trainer.update_period == 1
-                and not self.net_trainer._n_extras()
-                # node-bound train metrics need the per-step node
-                # forwards only update() provides (irrelevant when
-                # eval_train is off — train metrics never run then)
-                and not (self.net_trainer.eval_train
-                         and self.net_trainer.train_metric.need_nodes())
-            )
-            while self.itr_train.next():
-                if self.test_io == 0:
-                    batch = self.itr_train.value()
-                    if scan_ok and not batch.num_batch_padd:
-                        import numpy as _np
-
-                        # copy: iterator buffers are reused by next()
-                        pending.append(
-                            (_np.array(batch.data), _np.array(batch.label))
-                        )
-                        if len(pending) >= self.scan_steps:
-                            _flush_pending()
-                    else:
-                        _flush_pending()  # keep update order
-                        _fence(drain_all=True)  # update()'s sync would
-                        # fence leftovers inside the timed span otherwise
-                        tracer.step(global_step)
-                        timer.start()
-                        self.net_trainer.update(batch)
-                        if not self.net_trainer.eval_train:
-                            self.net_trainer.sync()
-                        timer.stop()
-                        global_step += 1
-                        pipe_mark = time.perf_counter()  # span was timed
-                sample_counter += 1
-                if (self.print_step > 0 and sample_counter % self.print_step == 0
-                        and not self.silent):
-                    elapsed = int(time.time() - start)
-                    print(
-                        f"round {self.start_counter - 1:8d}:"
-                        f"[{sample_counter:8d}] {elapsed} sec elapsed",
-                        flush=True,
-                    )
-            _flush_pending()  # tail chunk shorter than scan_steps
-            _drain_in_flight()  # round boundary: device queue empty
-            if self.test_io == 0:
-                if not self.silent and timer.count:
-                    print(
-                        f"round {self.start_counter - 1:8d}: "
-                        + timer.report(self.net_trainer.batch_size),
-                        flush=True,
-                    )
-                sys.stderr.write(f"[{self.start_counter}]")
-                if not self.itr_evals:
-                    sys.stderr.write(self.net_trainer.evaluate(None, "train"))
-                for it, nm in zip(self.itr_evals, self.eval_names):
-                    sys.stderr.write(self.net_trainer.evaluate(it, nm))
-                sys.stderr.write("\n")
+                    if len(pending) >= self.scan_steps:
+                        _flush_pending()
+                else:
+                    _flush_pending()  # keep update order
+                    _fence(drain_all=True)  # update()'s sync would
+                    # fence leftovers inside the timed span otherwise
+                    tracer.step(self._global_step)
+                    timer.start()
+                    self.net_trainer.update(batch)
+                    if not self.net_trainer.eval_train:
+                        self.net_trainer.sync()
+                    timer.stop()
+                    self._global_step += 1
+                    pipe_mark = time.perf_counter()  # span was timed
+            sample_counter += 1
+            if (self.print_step > 0 and sample_counter % self.print_step == 0
+                    and not self.silent):
+                elapsed = int(time.time() - self._train_start)
+                print(
+                    f"round {self.start_counter - 1:8d}:"
+                    f"[{sample_counter:8d}] {elapsed} sec elapsed",
+                    flush=True,
+                )
+            if check_preempt and self._preempt.requested:
+                preempted = True
+                break
+        _flush_pending()  # tail chunk shorter than scan_steps
+        _drain_in_flight()  # round/preemption boundary: queue empty
+        if preempted:
+            return False
+        if self.test_io == 0:
+            if not self.silent and timer.count:
+                print(
+                    f"round {self.start_counter - 1:8d}: "
+                    + timer.report(self.net_trainer.batch_size),
+                    flush=True,
+                )
+            sys.stderr.write(f"[{self.start_counter}]")
+            if not self.itr_evals:
+                sys.stderr.write(self.net_trainer.evaluate(None, "train"))
+            for it, nm in zip(self.itr_evals, self.eval_names):
+                sys.stderr.write(self.net_trainer.evaluate(it, nm))
+            sys.stderr.write("\n")
+            sys.stderr.flush()
+            if self.test_on_server:
+                dev = self.net_trainer.check_weight_sync()
+                sys.stderr.write(
+                    f"[{self.start_counter}]\tweight-sync:"
+                    f"max_dev={dev:g} ok\n"
+                )
                 sys.stderr.flush()
-                if self.test_on_server:
-                    dev = self.net_trainer.check_weight_sync()
-                    sys.stderr.write(
-                        f"[{self.start_counter}]\tweight-sync:"
-                        f"max_dev={dev:g} ok\n"
-                    )
-                    sys.stderr.flush()
-            self._save_model()
-        tracer.close()
-        if not self.silent:
-            print(f"\nupdating end, {int(time.time() - start)} sec in all")
+        return True
 
     def task_predict(self, raw: bool = False) -> None:
         """``task=pred``: one argmax/value per line.  ``task=pred_raw``:

@@ -12,6 +12,12 @@ bumps the generation; the producer re-reads it between items and restarts
 the wrapped iterator; the consumer discards queue entries from stale
 generations.  This replaces the reference's semaphore handshake with an
 equivalent that cannot deadlock on mid-epoch rewinds.
+
+Fault tolerance: an exception from the wrapped iterator (decode error,
+I/O failure) is captured, enqueued, and re-raised in the CONSUMER's
+``next()`` — previously it killed the daemon thread silently and the
+consumer blocked forever on an empty queue.  The producer survives the
+error and serves the next epoch after a ``before_first`` rewind.
 """
 
 from __future__ import annotations
@@ -23,6 +29,13 @@ from typing import Optional
 from .data import DataBatch, DataIter
 
 _END = object()
+
+
+class _ProducerError:
+    """Queue wrapper for an exception raised inside the producer thread."""
+
+    def __init__(self, exc: BaseException) -> None:
+        self.exc = exc
 
 
 class ThreadBufferIterator(DataIter):
@@ -58,7 +71,14 @@ class ThreadBufferIterator(DataIter):
 
     # ------------------------------------------------------------------
     def _producer(self):
-        served = -1  # last generation fully produced
+        # served = 0: production starts at the consumer's FIRST
+        # before_first() (generation 1) — the DataIter contract
+        # (``data.py::DataIter.__iter__``) guarantees one precedes any
+        # next().  Producing generation 0 eagerly would race the first
+        # rewind: a wrapped-iterator pass (and any error it raised)
+        # could be consumed and discarded as stale before the consumer
+        # ever observed it.
+        served = 0  # last generation fully produced
         while True:
             with self._gen_lock:
                 while not self._stop and self._gen <= served:
@@ -66,17 +86,27 @@ class ThreadBufferIterator(DataIter):
                 if self._stop:
                     return
                 gen = self._gen
-            self.base.before_first()
-            while True:
-                with self._gen_lock:
-                    if self._stop:
-                        return
-                    if self._gen != gen:
-                        break  # consumer rewound; restart epoch
-                if not self.base.next():
-                    self._put((gen, _END))
-                    break
-                self._put((gen, self.base.value()))
+            try:
+                self.base.before_first()
+                while True:
+                    with self._gen_lock:
+                        if self._stop:
+                            return
+                        if self._gen != gen:
+                            break  # consumer rewound; restart epoch
+                    if not self.base.next():
+                        self._put((gen, _END))
+                        break
+                    self._put((gen, self.base.value()))
+            except Exception as e:  # noqa: BLE001 - relayed to consumer
+                # deliver the failure to the consumer instead of dying
+                # silently (which left next() blocked forever); the
+                # producer stays alive to serve the next epoch.  The
+                # trailing _END terminates the epoch for a consumer that
+                # swallows the error and calls next() again — otherwise
+                # that retry would block on the empty queue
+                self._put((gen, _ProducerError(e)))
+                self._put((gen, _END))
             served = gen
 
     def _put(self, item) -> None:
@@ -106,6 +136,8 @@ class ThreadBufferIterator(DataIter):
                 continue  # stale epoch
             if item is _END:
                 return False
+            if isinstance(item, _ProducerError):
+                raise item.exc  # surface the producer's failure here
             self._cur = item
             return True
 

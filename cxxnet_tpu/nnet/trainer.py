@@ -42,11 +42,11 @@ from ..io.data import DataBatch
 from ..parallel import MeshPlan, make_mesh
 from ..parallel.distributed import fetch_array, fetch_local_rows
 from ..updater import Updater, create_updater
+from ..utils import checkpoint as ckpt
+from ..utils.checkpoint import MODEL_MAGIC, DivergenceError  # noqa: F401
 from ..utils.metric import MetricSet
 from .graph import NetGraph
 from .net import FunctionalNet
-
-MODEL_MAGIC = b"CXTPU001"
 
 
 class NetTrainer:
@@ -70,6 +70,8 @@ class NetTrainer:
         self.update_on_server = 0
         self.zero = 0
         self.save_ustate = 0
+        self.divergence_policy = ""  # "" off | "abort" | "rollback"
+        self.inject_nan_step = -1  # fault-injection hook (tests only)
         self.mesh_plan: Optional[MeshPlan] = None
         self.aux = {}  # non-gradient layer state (BN running stats)
         self.metric = MetricSet()
@@ -104,6 +106,19 @@ class NetTrainer:
             # "Updater state is NOT checkpointed; resume restarts
             # momentum from zero" (SURVEY §5 checkpoint notes)
             self.save_ustate = int(val)
+        elif name == "divergence_policy":
+            # NaN/Inf loss guard: "" disables (no per-step host sync),
+            # abort|rollback enable the check; the response lives in the
+            # task driver (cli.py) which catches DivergenceError
+            if val not in ("", "off", "abort", "rollback"):
+                raise ValueError(
+                    f"divergence_policy={val!r}: must be abort or rollback"
+                )
+            self.divergence_policy = "" if val == "off" else val
+        elif name == "inject_nan_step":
+            # fault-injection harness: treat the loss at this epoch as
+            # NaN (one transient blow-up) so recovery paths are testable
+            self.inject_nan_step = int(val)
         elif name in ("zero", "fsdp"):
             # zero = 1: optimizer state sharded over the data axis
             # (update_on_server's modern spelling); zero = 3 / fsdp = 1:
@@ -484,7 +499,8 @@ class NetTrainer:
                     )
         with_out = bool(self.eval_train)
         fn = self._scan_step_fn(k, per_step, with_out)
-        step0 = jnp.asarray(self.epoch_counter, jnp.int32)
+        first_epoch = self.epoch_counter
+        step0 = jnp.asarray(first_epoch, jnp.int32)
         (self.params, self.ustates, self.aux, self._rng_key, _end, ys) = fn(
             self.params, self.ustates, self.aux,
             self._stage_scan(data, per_step),
@@ -492,6 +508,10 @@ class NetTrainer:
             self._next_rng(), step0,
         )
         self.epoch_counter += k
+        if self.divergence_policy:
+            # guard fetches the per-step losses — with sync=False this
+            # serializes the async overlap (the cost of the check)
+            self._guard_loss(ys[0] if with_out else ys, first_epoch, k)
         if with_out:
             losses, outs = ys
             outs_np = self._local_scan_rows(outs)
@@ -628,6 +648,55 @@ class NetTrainer:
         return self._jit_cache["apply"]
 
     # ------------------------------------------------------------------
+    def _guard_loss(self, losses, first_epoch: int, n_steps: int = 1) -> None:
+        """NaN/Inf divergence guard (active when ``divergence_policy`` is
+        set): fetch the step's loss(es), raise :class:`DivergenceError`
+        on any non-finite value.  Each call forces a device sync, so the
+        guard trades the async dispatch overlap for blow-up detection —
+        that is why it is opt-in.
+
+        ``inject_nan_step`` (fault-injection harness) makes the loss at
+        that epoch read as NaN once, so recovery paths are testable
+        without waiting for a real numeric blow-up."""
+        arr = np.asarray(jax.device_get(losses), np.float64).reshape(-1)
+        inj = self.inject_nan_step
+        if inj >= 0 and first_epoch <= inj < first_epoch + n_steps:
+            self.inject_nan_step = -1  # one-shot: a transient fault
+            arr = arr.copy()
+            arr[min(inj - first_epoch, max(arr.size - 1, 0))] = np.nan
+        finite = np.isfinite(arr)
+        if finite.all():
+            return
+        bad = int(np.flatnonzero(~finite)[0])
+        epoch = first_epoch + min(bad, n_steps - 1)
+        raise DivergenceError(
+            f"divergence guard: non-finite loss {arr[bad]!r} at update "
+            f"{epoch} (round {self.round}, policy "
+            f"{self.divergence_policy or 'abort'})",
+            loss=arr, epoch=epoch,
+        )
+
+    def weights_finite(self) -> bool:
+        """True when every parameter tensor is free of NaN/Inf — the
+        divergence-rollback sanity check: a CRC-valid checkpoint can
+        still carry a baked-in blow-up (the last update of the round it
+        captured went non-finite AFTER its loss was measured).
+        COLLECTIVE in multi-process runs (``fetch_array`` allgathers),
+        so every process computes the identical verdict."""
+        for slots in self.params.values():
+            for w in slots.values():
+                if not np.isfinite(fetch_array(w)).all():
+                    return False
+        return True
+
+    def scale_learning_rate(self, factor: float) -> None:
+        """Multiply every updater's base learning rate by ``factor``
+        (divergence-rollback backoff).  Clears the jit cache — compiled
+        steps bake the schedule constants in."""
+        for up in self.updaters.values():
+            up.param.base_lr *= factor
+        self._jit_cache.clear()
+
     def start_round(self, round_: int) -> None:
         self.round = round_
 
@@ -921,6 +990,8 @@ class NetTrainer:
                     mask, self._next_rng(), step, extras,
                 )
             )
+            if self.divergence_policy:
+                self._guard_loss(loss, self.epoch_counter)
             if self.eval_train:
                 self.train_metric.add_eval(
                     self._train_metric_preds(out, n_real, node_cache),
@@ -944,6 +1015,10 @@ class NetTrainer:
                 self.params, self.aux, data, labels, mask,
                 self._next_rng(), step, extras,
             )
+        if self.divergence_policy:
+            # accumulation path: catch the blow-up per micro-batch,
+            # BEFORE the bad gradient is folded into the accumulator
+            self._guard_loss(loss, self.epoch_counter)
         if self._grad_accum is None:
             self._grad_accum = grads
         else:
@@ -1133,7 +1208,13 @@ class NetTrainer:
                 params.setdefault(key, {})[tag] = npz[k]
         return header, params, aux, ust
 
-    def save_model(self, path: str) -> None:
+    def checkpoint_bytes(self) -> bytes:
+        """Serialize the full checkpoint to one byte string.
+
+        COLLECTIVE in multi-process runs: assembling sharded arrays
+        (``fetch_array``) allgathers across the job, so EVERY process
+        must call this even when only rank 0 writes the file (the
+        driver's discipline — ``cli.py::_save_model``)."""
         header = {
             "structure": json.loads(self.graph.structure_to_json()),
             "epoch_counter": self.epoch_counter,
@@ -1164,11 +1245,32 @@ class NetTrainer:
                     for slot, w in slots.items():
                         flat[f"ust:{key}/{tag}@{slot}"] = fetch_array(w)
         np.savez(buf, **flat)
-        with open(path, "wb") as f:
-            f.write(MODEL_MAGIC)
-            f.write(struct.pack("<I", len(hjson)))
-            f.write(hjson)
-            f.write(buf.getvalue())
+        out = _io.BytesIO()
+        out.write(MODEL_MAGIC)
+        out.write(struct.pack("<I", len(hjson)))
+        out.write(hjson)
+        out.write(buf.getvalue())
+        return out.getvalue()
+
+    def net_fp(self) -> str:
+        """Fingerprint of the current net structure (manifest field)."""
+        return ckpt.net_fingerprint(self.graph.structure_to_json())
+
+    def save_model(self, path: str, round_: Optional[int] = None,
+                   manifest: bool = True) -> None:
+        """Atomic checkpoint write (temp + fsync + rename) plus a sidecar
+        manifest carrying CRC32 / size / round / net fingerprint, so a
+        kill mid-write can never leave a loadable-looking truncation."""
+        blob = self.checkpoint_bytes()
+        if manifest:
+            ckpt.write_checkpoint(
+                path, blob,
+                round_=self.round if round_ is None else round_,
+                net_fp=self.net_fp(),
+                save_ustate=self.save_ustate,
+            )
+        else:
+            ckpt.atomic_write_bytes(path, blob)
 
     def load_model(self, path: str) -> None:
         if not any(n == "netconfig" for n, _ in self.cfg):

@@ -91,6 +91,55 @@ def process_info() -> Tuple[int, int]:
         return 0, 1
 
 
+def is_primary() -> bool:
+    """True on the process that owns checkpoint writes (rank 0)."""
+    return process_info()[0] == 0
+
+
+def agree_on_value(val: int, reduce: str = "min") -> int:
+    """Cross-process integer agreement (allgather + min/max reduce).
+
+    Single-process runs return ``val`` unchanged.  Used by the
+    checkpoint subsystem so every process resumes from the SAME round
+    (``min`` — a round every process can see) and so a preemption signal
+    delivered to any one process stops the whole job (``max``)."""
+    import numpy as np
+
+    _, count = process_info()
+    if count == 1:
+        return int(val)
+    from jax.experimental import multihost_utils
+
+    vals = np.asarray(
+        multihost_utils.process_allgather(np.asarray([val], np.int64))
+    ).reshape(-1)
+    return int(vals.min() if reduce == "min" else vals.max())
+
+
+def agree_on_round(local_round: int) -> int:
+    """Resume-round consensus: the newest round EVERY process holds a
+    valid checkpoint for (-1 when any process has none)."""
+    return agree_on_value(local_round, reduce="min")
+
+
+def any_process_flag(flag: bool) -> bool:
+    """True when the flag is set on ANY process (collective)."""
+    return bool(agree_on_value(int(bool(flag)), reduce="max"))
+
+
+def barrier(name: str = "cxxnet_barrier") -> None:
+    """Block until every process reaches this point (no-op single-proc).
+
+    Used after rank-0 checkpoint writes so no process races ahead and
+    reads (or prunes) a checkpoint before it is fully durable."""
+    _, count = process_info()
+    if count == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
 def fetch_array(x) -> "np.ndarray":
     """Global jax.Array → full host ndarray, multi-process safe.
 

@@ -1,3 +1,12 @@
-"""Utility modules: metrics, timing."""
+"""Utility modules: metrics, timing, fault-tolerant checkpointing."""
 
+from .checkpoint import (  # noqa: F401
+    CheckpointError,
+    DivergenceError,
+    PreemptionHandler,
+    atomic_write_bytes,
+    find_latest_valid,
+    retry_io,
+    validate_checkpoint,
+)
 from .metric import MetricSet, create_metric  # noqa: F401

@@ -1,0 +1,403 @@
+"""Fault-tolerant checkpoint subsystem: atomic writes, manifests, recovery.
+
+The reference assumed one long-lived process: ``LearnTask`` wrote
+``NNNN.model`` in place and ``continue=1`` blindly loaded the newest file.
+On preemptible machines that breaks — a kill mid-write leaves a truncated
+checkpoint that resume then loads.  This module supplies the primitives
+the task driver and trainer build fault tolerance from (the TensorFlow
+lesson, arXiv:1605.08695 §4.2: consistent checkpointing and automatic
+recovery are system requirements, not afterthoughts):
+
+* **atomic writes** — write to a temp file in the same directory, fsync,
+  rename; readers never observe a half-written checkpoint;
+* **sidecar manifests** — ``NNNN.model.manifest.json`` carrying CRC32,
+  byte size, round number, a net-structure fingerprint, and the
+  ``save_ustate`` flag, so resume can *prove* a checkpoint is intact
+  (and belongs to this net) before loading it;
+* **validation + newest-valid selection** — glob all ``*.model`` files
+  (no consecutive-scan gap bug), check each against its manifest, fall
+  back past corrupt ones instead of crashing;
+* **retention** — ``keep_latest = N`` prunes old checkpoints (and their
+  sidecars) after each successful save;
+* **retry with exponential backoff** — transient I/O flakiness (network
+  filesystems) does not kill a multi-hour run;
+* **preemption handling** — a SIGTERM/SIGINT handler that *requests* a
+  clean stop; the train loop snapshots state at the next safe point and
+  exits instead of dying mid-write;
+* **divergence guard** — ``DivergenceError`` raised by the trainer when
+  a step's loss goes non-finite; the driver's ``divergence_policy``
+  decides abort vs rollback-to-last-good-checkpoint.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import signal
+import time
+import zlib
+from typing import Callable, List, Optional, Sequence, Tuple
+
+# model container magic (shared with nnet.trainer, which re-exports it)
+MODEL_MAGIC = b"CXTPU001"
+MANIFEST_SUFFIX = ".manifest.json"
+MANIFEST_FORMAT = 1
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint failed validation or could not be written/read."""
+
+
+class DivergenceError(RuntimeError):
+    """A training step produced a non-finite loss.
+
+    Raised by ``NetTrainer`` when ``divergence_policy`` is set; carries
+    the offending loss value(s) and the epoch range they cover so the
+    driver can report precisely where training blew up.
+    """
+
+    def __init__(self, message: str, loss=None, epoch: Optional[int] = None):
+        super().__init__(message)
+        self.loss = loss
+        self.epoch = epoch
+
+
+# ----------------------------------------------------------------------
+# atomic I/O + retry
+def atomic_write_bytes(path: str, data: bytes, fsync: bool = True) -> None:
+    """Write ``data`` to ``path`` atomically: temp file in the same
+    directory, flush+fsync, rename.  A crash at any point leaves either
+    the old file or the new one, never a truncation."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    if fsync:
+        # durability of the rename itself (dir entry) — best effort;
+        # not all filesystems support fsync on a directory fd
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+
+
+def retry_io(
+    fn: Callable,
+    attempts: int = 4,
+    base_delay: float = 0.05,
+    exceptions: Tuple[type, ...] = (OSError,),
+    what: str = "checkpoint I/O",
+    silent: bool = False,
+    _sleep: Callable[[float], None] = time.sleep,
+):
+    """Run ``fn()`` retrying transient failures with exponential backoff
+    (delays ``base_delay * 2**k``).  The last failure propagates."""
+    for k in range(attempts):
+        try:
+            return fn()
+        except exceptions as e:
+            if k == attempts - 1:
+                raise
+            delay = base_delay * (2 ** k)
+            if not silent:
+                print(
+                    f"{what} failed ({type(e).__name__}: {e}); "
+                    f"retry {k + 1}/{attempts - 1} in {delay:.2f}s",
+                    flush=True,
+                )
+            _sleep(delay)
+
+
+# ----------------------------------------------------------------------
+# manifests
+def crc32_of(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            crc = zlib.crc32(b, crc)
+    return crc & 0xFFFFFFFF
+
+
+def net_fingerprint(structure_json: str) -> str:
+    """Stable fingerprint of a net's structure (key-order independent)."""
+    canon = json.dumps(json.loads(structure_json), sort_keys=True,
+                       separators=(",", ":"))
+    return f"{crc32_of(canon.encode('utf-8')):08x}"
+
+
+def manifest_path(model_path: str) -> str:
+    return model_path + MANIFEST_SUFFIX
+
+
+def write_manifest(
+    model_path: str,
+    round_: Optional[int] = None,
+    net_fp: Optional[str] = None,
+    save_ustate: int = 0,
+    blob: Optional[bytes] = None,
+) -> dict:
+    """Write the sidecar manifest for an already-written checkpoint.
+
+    ``blob`` (the exact bytes written) avoids re-reading the file; the
+    manifest itself is written atomically, AFTER the checkpoint, so a
+    manifest's existence implies its checkpoint was fully durable."""
+    if blob is not None:
+        crc, size = crc32_of(blob), len(blob)
+    else:
+        crc, size = crc32_file(model_path), os.path.getsize(model_path)
+    man = {
+        "format": MANIFEST_FORMAT,
+        "crc32": crc,
+        "size": size,
+        "round": round_,
+        "net_fingerprint": net_fp,
+        "save_ustate": int(save_ustate),
+        "time": time.time(),
+    }
+    atomic_write_bytes(
+        manifest_path(model_path),
+        (json.dumps(man, indent=1) + "\n").encode("utf-8"),
+    )
+    return man
+
+
+def write_checkpoint(
+    path: str,
+    blob: bytes,
+    round_: Optional[int] = None,
+    net_fp: Optional[str] = None,
+    save_ustate: int = 0,
+    retry: bool = False,
+    silent: bool = True,
+) -> None:
+    """THE checkpoint write discipline — atomic payload write, then the
+    sidecar manifest — shared by every writer (``NetTrainer.save_model``
+    and the task driver's ``_save_model``) so the format and ordering
+    can never diverge between them.  ``retry=True`` wraps both writes in
+    exponential-backoff retries (long-running driver saves on flaky
+    filesystems)."""
+    def _write():
+        atomic_write_bytes(path, blob)
+
+    def _manifest():
+        write_manifest(path, round_=round_, net_fp=net_fp,
+                       save_ustate=save_ustate, blob=blob)
+
+    if retry:
+        retry_io(_write, what=f"writing {path}", silent=silent)
+        retry_io(_manifest, what=f"writing {manifest_path(path)}",
+                 silent=silent)
+    else:
+        _write()
+        _manifest()
+
+
+def read_manifest(model_path: str) -> Optional[dict]:
+    """The checkpoint's manifest, or None if absent/unparseable."""
+    p = manifest_path(model_path)
+    try:
+        with open(p, "r", encoding="utf-8") as f:
+            man = json.load(f)
+        return man if isinstance(man, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def validate_checkpoint(
+    model_path: str, net_fp: Optional[str] = None
+) -> Optional[str]:
+    """Check a checkpoint's integrity; return None when valid, else a
+    human-readable reason.
+
+    With a manifest: byte size and CRC32 must match (catches truncation
+    AND payload byte-flips), and — when both sides carry one — the net
+    fingerprint must match the current conf's.  Without a manifest
+    (legacy checkpoint): structural validation only (magic, parseable
+    header); payload corruption is then caught at load time."""
+    try:
+        size = os.path.getsize(model_path)
+    except OSError as e:
+        return f"unreadable: {e}"
+    man = read_manifest(model_path)
+    if man is not None:
+        if man.get("size") != size:
+            return f"size mismatch: manifest {man.get('size')}, file {size}"
+        try:
+            crc = crc32_file(model_path)
+        except OSError as e:
+            return f"unreadable: {e}"
+        if man.get("crc32") != crc:
+            return (f"crc32 mismatch: manifest {man.get('crc32'):#010x}, "
+                    f"file {crc:#010x}")
+        mfp = man.get("net_fingerprint")
+        if net_fp is not None and mfp is not None and mfp != net_fp:
+            return (f"net fingerprint mismatch: checkpoint {mfp}, "
+                    f"current conf {net_fp} (different netconfig)")
+        return None
+    # no manifest: structural checks only
+    try:
+        with open(model_path, "rb") as f:
+            magic = f.read(8)
+            if magic != MODEL_MAGIC:
+                return "bad magic (not a cxxnet-tpu model file)"
+            raw = f.read(4)
+            if len(raw) < 4:
+                return "truncated header length"
+            import struct
+
+            (hlen,) = struct.unpack("<I", raw)
+            hdr = f.read(hlen)
+            if len(hdr) < hlen:
+                return "truncated header"
+            json.loads(hdr.decode("utf-8"))
+    except (OSError, ValueError, UnicodeDecodeError) as e:
+        return f"corrupt header: {e}"
+    return None
+
+
+# ----------------------------------------------------------------------
+# discovery + retention
+def checkpoint_round(filename: str) -> Optional[int]:
+    """Round number encoded in a ``NNNN.model`` filename, else None."""
+    base = os.path.basename(filename)
+    stem, dot, ext = base.partition(".")
+    if ext != "model" or not stem.isdigit():
+        return None
+    return int(stem)
+
+
+def list_checkpoints(model_dir: str) -> List[Tuple[int, str]]:
+    """All ``NNNN.model`` files in ``model_dir``, sorted by round —
+    a glob, NOT a consecutive scan, so gaps (``save_model > 1``) and
+    pruned prefixes (``keep_latest``) are handled."""
+    try:
+        names = os.listdir(model_dir)
+    except OSError:
+        return []
+    out = []
+    for n in fnmatch.filter(names, "*.model"):
+        r = checkpoint_round(n)
+        if r is not None:
+            out.append((r, os.path.join(model_dir, n)))
+    return sorted(out)
+
+
+def find_latest_valid(
+    model_dir: str,
+    net_fp: Optional[str] = None,
+    silent: bool = False,
+    before: Optional[int] = None,
+) -> Optional[Tuple[int, str]]:
+    """Newest checkpoint that passes validation, scanning newest→oldest
+    and warning past corrupt ones — resume survives a preemption that
+    truncated the most recent write.  ``before`` excludes rounds >= it
+    (divergence rollback falling back past a numerically poisoned but
+    CRC-valid checkpoint)."""
+    for round_, path in reversed(list_checkpoints(model_dir)):
+        if before is not None and round_ >= before:
+            continue
+        reason = validate_checkpoint(path, net_fp=net_fp)
+        if reason is None:
+            return round_, path
+        if not silent:
+            print(f"checkpoint {path} skipped: {reason}", flush=True)
+    return None
+
+
+def apply_retention(
+    model_dir: str, keep_latest: int, silent: bool = True
+) -> List[str]:
+    """Prune all but the newest ``keep_latest`` checkpoints (and their
+    manifests).  ``keep_latest <= 0`` keeps everything.  Returns the
+    removed model paths."""
+    if keep_latest <= 0:
+        return []
+    removed = []
+    for _, path in list_checkpoints(model_dir)[:-keep_latest]:
+        for p in (path, manifest_path(path)):
+            try:
+                os.remove(p)
+            except OSError:
+                continue
+        removed.append(path)
+        if not silent:
+            print(f"retention: removed {path}", flush=True)
+    return removed
+
+
+# ----------------------------------------------------------------------
+# preemption
+class PreemptionHandler:
+    """Cooperative SIGTERM/SIGINT handling for the train loop.
+
+    First signal sets ``requested`` — the loop checks it at batch/round
+    boundaries, snapshots state, and exits cleanly.  A second signal
+    restores the previous handlers and re-raises (force quit for an
+    operator who really means it)."""
+
+    def __init__(self, signals: Sequence[int] = (signal.SIGTERM,
+                                                 signal.SIGINT)) -> None:
+        self.signals = tuple(signals)
+        self.requested = False
+        self.signum: Optional[int] = None
+        self._prev: dict = {}
+        self._installed = False
+
+    def _handle(self, signum, frame):
+        if self.requested:
+            # second signal: give up on graceful shutdown
+            self.uninstall()
+            os.kill(os.getpid(), signum)
+            return
+        self.requested = True
+        self.signum = signum
+        print(
+            f"received signal {signal.Signals(signum).name}: finishing the "
+            "current step, then checkpointing and exiting "
+            "(signal again to force quit)",
+            flush=True,
+        )
+
+    def install(self) -> "PreemptionHandler":
+        if not self._installed:
+            for s in self.signals:
+                self._prev[s] = signal.signal(s, self._handle)
+            self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            for s, prev in self._prev.items():
+                signal.signal(s, prev)
+            self._prev.clear()
+            self._installed = False
+
+    def __enter__(self) -> "PreemptionHandler":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

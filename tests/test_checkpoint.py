@@ -1,0 +1,220 @@
+"""Unit tests for the fault-tolerant checkpoint subsystem
+(``cxxnet_tpu/utils/checkpoint.py``): atomic writes, manifests,
+corruption detection, newest-valid discovery, retention, retry backoff,
+and the preemption handler."""
+
+import json
+import os
+import signal
+import struct
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.utils import checkpoint as ckpt
+
+
+def _fake_model_bytes(payload: bytes = b"\x01" * 64) -> bytes:
+    header = json.dumps({"structure": {"x": 1}, "epoch_counter": 3})
+    hj = header.encode()
+    return ckpt.MODEL_MAGIC + struct.pack("<I", len(hj)) + hj + payload
+
+
+def _write_ckpt(dirpath, round_, payload=b"\x01" * 64, net_fp=None):
+    path = os.path.join(str(dirpath), f"{round_:04d}.model")
+    blob = _fake_model_bytes(payload)
+    ckpt.atomic_write_bytes(path, blob)
+    ckpt.write_manifest(path, round_=round_, net_fp=net_fp, blob=blob)
+    return path, blob
+
+
+# ----------------------------------------------------------------------
+def test_atomic_write_no_temp_left(tmp_path):
+    p = str(tmp_path / "out.bin")
+    ckpt.atomic_write_bytes(p, b"hello")
+    assert open(p, "rb").read() == b"hello"
+    ckpt.atomic_write_bytes(p, b"world")  # overwrite is atomic too
+    assert open(p, "rb").read() == b"world"
+    assert os.listdir(tmp_path) == ["out.bin"]  # no .tmp debris
+
+
+def test_atomic_write_failure_preserves_old(tmp_path, monkeypatch):
+    p = str(tmp_path / "out.bin")
+    ckpt.atomic_write_bytes(p, b"old")
+
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("disk detached")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError):
+        ckpt.atomic_write_bytes(p, b"new")
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert open(p, "rb").read() == b"old"
+    assert os.listdir(tmp_path) == ["out.bin"]
+
+
+def test_retry_io_backoff_then_success():
+    calls = {"n": 0}
+    delays = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = ckpt.retry_io(flaky, attempts=4, base_delay=0.01, silent=True,
+                        _sleep=delays.append)
+    assert out == "ok" and calls["n"] == 3
+    assert delays == [0.01, 0.02]  # exponential backoff
+
+
+def test_retry_io_exhausts():
+    def always():
+        raise OSError("gone")
+
+    with pytest.raises(OSError):
+        ckpt.retry_io(always, attempts=3, base_delay=0.0, silent=True,
+                      _sleep=lambda d: None)
+
+
+# ----------------------------------------------------------------------
+def test_manifest_roundtrip_and_validation(tmp_path):
+    path, blob = _write_ckpt(tmp_path, 2, net_fp="cafe0123")
+    man = ckpt.read_manifest(path)
+    assert man["round"] == 2 and man["size"] == len(blob)
+    assert man["crc32"] == ckpt.crc32_of(blob)
+    assert ckpt.validate_checkpoint(path) is None
+    assert ckpt.validate_checkpoint(path, net_fp="cafe0123") is None
+    # fingerprint mismatch = "different netconfig" → invalid
+    assert "fingerprint" in ckpt.validate_checkpoint(path, net_fp="deadbeef")
+
+
+def test_validate_detects_truncation(tmp_path):
+    path, blob = _write_ckpt(tmp_path, 0)
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    assert "size mismatch" in ckpt.validate_checkpoint(path)
+
+
+def test_validate_detects_byte_flip(tmp_path):
+    path, blob = _write_ckpt(tmp_path, 0)
+    flipped = bytearray(blob)
+    flipped[-5] ^= 0xFF  # payload flip; length and name stay plausible
+    with open(path, "wb") as f:
+        f.write(bytes(flipped))
+    assert "crc32 mismatch" in ckpt.validate_checkpoint(path)
+
+
+def test_validate_legacy_without_manifest(tmp_path):
+    # pre-manifest checkpoint: structural validation only
+    path = str(tmp_path / "0001.model")
+    with open(path, "wb") as f:
+        f.write(_fake_model_bytes())
+    assert ckpt.validate_checkpoint(path) is None
+    # truncated inside the header → caught structurally
+    with open(path, "wb") as f:
+        f.write(_fake_model_bytes()[:10])
+    assert ckpt.validate_checkpoint(path) is not None
+    # wrong magic → caught
+    with open(path, "wb") as f:
+        f.write(b"NOTMAGIC" + b"\x00" * 32)
+    assert "magic" in ckpt.validate_checkpoint(path)
+
+
+# ----------------------------------------------------------------------
+def test_list_checkpoints_handles_gaps(tmp_path):
+    # save_model=2 leaves gaps: 0001, 0003 — the consecutive-scan bug
+    # found nothing here; the glob must find both, newest last
+    for r in (1, 3):
+        _write_ckpt(tmp_path, r)
+    (tmp_path / "notes.txt").write_text("ignore me")
+    (tmp_path / "x.model").write_bytes(b"non-numeric stem: ignored")
+    rounds = [r for r, _ in ckpt.list_checkpoints(str(tmp_path))]
+    assert rounds == [1, 3]
+    assert ckpt.list_checkpoints(str(tmp_path / "missing")) == []
+
+
+def test_find_latest_valid_falls_back_past_corrupt(tmp_path):
+    _write_ckpt(tmp_path, 0)
+    _write_ckpt(tmp_path, 2)
+    path4, blob4 = _write_ckpt(tmp_path, 4)
+    with open(path4, "wb") as f:
+        f.write(blob4[:20])  # newest truncated (preempted mid-write)
+    found = ckpt.find_latest_valid(str(tmp_path), silent=True)
+    assert found is not None
+    round_, path = found
+    assert round_ == 2 and path.endswith("0002.model")
+
+
+def test_find_latest_valid_none(tmp_path):
+    assert ckpt.find_latest_valid(str(tmp_path), silent=True) is None
+
+
+def test_apply_retention(tmp_path):
+    for r in range(5):
+        _write_ckpt(tmp_path, r)
+    removed = ckpt.apply_retention(str(tmp_path), keep_latest=2)
+    assert [os.path.basename(p) for p in removed] == [
+        "0000.model", "0001.model", "0002.model"
+    ]
+    left = sorted(os.listdir(tmp_path))
+    assert left == [
+        "0003.model", "0003.model" + ckpt.MANIFEST_SUFFIX,
+        "0004.model", "0004.model" + ckpt.MANIFEST_SUFFIX,
+    ]
+    # keep_latest <= 0 keeps everything
+    assert ckpt.apply_retention(str(tmp_path), keep_latest=0) == []
+
+
+# ----------------------------------------------------------------------
+def test_net_fingerprint_stable_under_key_order():
+    a = json.dumps({"layers": [1, 2], "nodes": 3})
+    b = json.dumps({"nodes": 3, "layers": [1, 2]})
+    assert ckpt.net_fingerprint(a) == ckpt.net_fingerprint(b)
+    c = json.dumps({"nodes": 4, "layers": [1, 2]})
+    assert ckpt.net_fingerprint(a) != ckpt.net_fingerprint(c)
+
+
+def test_preemption_handler_sets_flag_and_restores():
+    h = ckpt.PreemptionHandler(signals=(signal.SIGTERM,))
+    prev = signal.getsignal(signal.SIGTERM)
+    with h:
+        assert not h.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert h.requested and h.signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_trainer_save_writes_manifest_and_atomic(tmp_path):
+    """NetTrainer.save_model routes through the atomic writer and drops
+    a valid sidecar manifest whose fingerprint matches the graph."""
+    from cxxnet_tpu.models import mnist_mlp_conf
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu import config as cfgmod
+
+    conf = mnist_mlp_conf(batch_size=4, dev="cpu")
+    tr = NetTrainer()
+    tr.set_params(cfgmod.parse_pairs(conf))
+    tr.init_model()
+    path = str(tmp_path / "0007.model")
+    tr.save_model(path, round_=7)
+    assert ckpt.validate_checkpoint(path) is None
+    man = ckpt.read_manifest(path)
+    assert man["round"] == 7
+    assert man["net_fingerprint"] == ckpt.net_fingerprint(
+        tr.graph.structure_to_json()
+    )
+    assert man["save_ustate"] == 0
+    # and the file round-trips
+    tr2 = NetTrainer()
+    tr2.set_params(cfgmod.parse_pairs(conf))
+    tr2.load_model(path)
+    for key in tr.params:
+        for tag in tr.params[key]:
+            np.testing.assert_array_equal(
+                np.asarray(tr.params[key][tag]),
+                np.asarray(tr2.params[key][tag]),
+            )

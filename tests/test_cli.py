@@ -83,9 +83,12 @@ def test_train_task_end_to_end(tmp_path):
         return float(line.split("test-error:")[1].split()[0])
 
     assert err_of(lines[-1]) < err_of(lines[0]) + 1e-9
-    # checkpoints written each round
-    models = sorted(os.listdir(tmp_path / "models"))
+    # checkpoints written each round (each with a sidecar manifest)
+    files = os.listdir(tmp_path / "models")
+    models = sorted(f for f in files if f.endswith(".model"))
     assert models == ["0000.model", "0001.model", "0002.model", "0003.model"]
+    for m in models:
+        assert f"{m}.manifest.json" in files
 
 
 def test_continue_training(tmp_path):
