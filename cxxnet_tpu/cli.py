@@ -8,6 +8,10 @@ per-round evaluation lines ``[round]\\tname-metric:value`` on stderr;
 model (inferring ``start_counter`` from its filename); ``test_io=1``
 pulls batches without updating (IO throughput dry-run); ``print_step``
 progress lines; ``max_round`` caps rounds this invocation.
+
+New scope beyond the reference: ``task = serve`` runs the online
+inference server (``serve/`` subsystem, doc/serving.md) — dynamic
+micro-batching over an HTTP JSON endpoint with hot model reload.
 """
 
 from __future__ import annotations
@@ -58,6 +62,13 @@ class LearnTask:
         self.gen_topk = 0
         self.gen_topp = 0.0
         self.gen_cache = 1
+        self.serve_host = "127.0.0.1"
+        self.serve_port = 9090
+        self.serve_max_batch = 0  # 0: the trainer's batch_size
+        self.batch_timeout_ms = 2.0
+        self.queue_limit = 128
+        self.serve_reload_period = 0.0  # seconds; 0 disables hot reload
+        self.serve_deadline_ms = 0.0  # default per-request deadline
         self.cfg: List[tuple] = []
 
     # ------------------------------------------------------------------
@@ -118,6 +129,20 @@ class LearnTask:
             self.gen_topp = float(val)
         elif name == "gen_cache":
             self.gen_cache = int(val)
+        elif name == "serve_host":
+            self.serve_host = val
+        elif name == "serve_port":
+            self.serve_port = int(val)
+        elif name == "max_batch_size":
+            self.serve_max_batch = int(val)
+        elif name == "batch_timeout_ms":
+            self.batch_timeout_ms = float(val)
+        elif name == "queue_limit":
+            self.queue_limit = int(val)
+        elif name == "serve_reload_period":
+            self.serve_reload_period = float(val)
+        elif name == "serve_deadline_ms":
+            self.serve_deadline_ms = float(val)
         self.cfg.append((name, val))
 
     # ------------------------------------------------------------------
@@ -136,7 +161,7 @@ class LearnTask:
 
         maybe_init_distributed(self.cfg)
         if self.task not in ("train", "finetune", "pred", "pred_raw",
-                             "extract", "generate", "summary"):
+                             "extract", "generate", "summary", "serve"):
             raise ValueError(f"unknown task {self.task!r}")
         self.init()
         if not self.silent:
@@ -151,6 +176,8 @@ class LearnTask:
             self.task_generate()
         elif self.task == "summary":
             self.task_summary()
+        elif self.task == "serve":
+            self.task_serve()
         else:
             raise ValueError(f"unknown task {self.task!r}")
         return 0
@@ -162,6 +189,10 @@ class LearnTask:
         return tr
 
     def init(self) -> None:
+        if self.task == "serve":
+            # the serving engine owns model discovery/validation and
+            # needs no data iterators — see task_serve
+            return
         if self.task == "train" and self.continue_training:
             if self._sync_latest_model():
                 print(f"Init: Continue training from round {self.start_counter}")
@@ -720,9 +751,14 @@ class LearnTask:
         if self.itr_pred is None:
             raise ValueError("must specify a pred iterator to generate predictions")
         print("start predicting...")
+        t0 = time.perf_counter()
+        nrow = 0
         with open(self.name_pred, "w", encoding="utf-8") as fo:
             self.itr_pred.before_first()
             while self.itr_pred.next():
+                # stream per batch: each batch's rows are formatted and
+                # flushed as soon as they land, so memory stays O(batch)
+                # no matter how large the prediction set is
                 batch = self.itr_pred.value()
                 n = batch.batch_size - batch.num_batch_padd
                 if raw:
@@ -739,7 +775,75 @@ class LearnTask:
                             )
                         else:
                             fo.write(f"{v:g}\n")
-        print(f"finished prediction, write into {self.name_pred}")
+                fo.flush()
+                nrow += n
+        dt = time.perf_counter() - t0
+        rate = nrow / dt if dt > 0 else 0.0
+        print(f"finished prediction, write into {self.name_pred} "
+              f"({nrow} rows, {rate:.1f} rows/sec)")
+
+    def task_serve(self) -> None:
+        """``task=serve``: run the online inference server (doc/serving.md).
+
+        Loads ``model_in`` (or the newest valid checkpoint in
+        ``model_dir``) into a :class:`~cxxnet_tpu.serve.Engine` and
+        serves ``/predict`` / ``/extract`` / ``/healthz`` / ``/statsz``
+        on ``serve_host:serve_port`` (``serve_port = 0`` picks an
+        ephemeral port, printed on startup).  SIGTERM/SIGINT shut down
+        cleanly: in-flight requests finish, queued ones are failed with
+        503, then the process exits."""
+        import signal as _signal
+        import threading
+
+        from .serve import Engine
+        from .serve.server import serve_forever
+
+        model_in = (None if self.name_model_in == "NULL"
+                    else self.name_model_in)
+        engine = Engine(
+            cfg=self.cfg,
+            model_in=model_in,
+            model_dir=None if model_in else self.name_model_dir,
+            max_batch_size=self.serve_max_batch,
+            batch_timeout_ms=self.batch_timeout_ms,
+            queue_limit=self.queue_limit,
+            default_deadline_ms=self.serve_deadline_ms,
+            silent=bool(self.silent),
+        )
+        httpd_box = {}
+
+        def _ready(httpd):
+            httpd_box["httpd"] = httpd
+            h = engine.healthz()
+            print(f"serving model round {h['round']} "
+                  f"(fp {h['net_fp']}) on "
+                  f"http://{httpd.server_address[0]}:{httpd.server_port}",
+                  flush=True)
+
+        def _stop(signum, frame):
+            print("serve: shutdown requested", flush=True)
+            h = httpd_box.get("httpd")
+            if h is not None:
+                # shutdown() blocks until serve_forever returns — must
+                # not run on the thread stuck inside serve_forever
+                threading.Thread(target=h.shutdown, daemon=True).start()
+
+        prev = {s: _signal.signal(s, _stop)
+                for s in (_signal.SIGTERM, _signal.SIGINT)}
+        try:
+            serve_forever(
+                engine,
+                host=self.serve_host,
+                port=self.serve_port,
+                reload_period_s=self.serve_reload_period,
+                verbose=not self.silent,
+                ready_fn=_ready,
+            )
+        finally:
+            for s, p in prev.items():
+                _signal.signal(s, p)
+            engine.close()
+        print("serve: shutdown complete", flush=True)
 
     def task_summary(self) -> None:
         """``task=summary``: per-layer table — type, name, output node

@@ -1107,6 +1107,41 @@ class NetTrainer:
         ret += self.metric.print(data_name)
         return ret
 
+    def predict_fn(self, node_id: Optional[int] = None):
+        """The PURE, shape-stable inference function — the compiled
+        primitive the serving subsystem caches (``serve/cache.py``):
+        ``f(params, aux, data, extras) -> f32 out rows`` with eval-mode
+        forward semantics and no trainer state captured mutably (params
+        and aux are explicit arguments, so a hot-swapped model is just a
+        different first argument).  XLA specializes per input shape;
+        callers that control the batch shape (power-of-two buckets)
+        control the compile count.  ``node_id`` selects a feature node
+        (``resolve_feature_node``); ``None`` is the final output."""
+        return self._eval_fn() if node_id is None else self._node_fn(node_id)
+
+    def resolve_feature_node(self, node_name: str) -> int:
+        """``top[-k]`` / node-name → node index (ExtractFeature rules)."""
+        g = self.graph
+        if node_name.startswith("top[-"):
+            offset = int(node_name[len("top[-"):-1])
+            nnode = g.num_nodes
+            if not (1 <= offset <= nnode):
+                raise ValueError("ExtractFeature: offset out of node range")
+            return nnode - offset
+        return g.node_index_of(node_name)
+
+    @staticmethod
+    def predict_from_scores(out: np.ndarray) -> np.ndarray:
+        """Raw out-node rows → per-instance predictions: argmax
+        (multi-column), the raw scalar (1-column), or the per-position
+        ``(N, T)`` argmax id matrix for sequence models."""
+        if out.ndim == 3:
+            return out.argmax(axis=-1).astype(np.float32)
+        out2d = out.reshape(out.shape[0], -1)
+        if out2d.shape[1] == 1:
+            return out2d[:, 0]
+        return out2d.argmax(axis=1).astype(np.float32)
+
     def predict(self, batch: DataBatch) -> np.ndarray:
         """Per-instance prediction: argmax, or raw value for 1-col output.
 
@@ -1115,23 +1150,10 @@ class NetTrainer:
         out = self._run_sharded(
             self._eval_fn(), np.asarray(batch.data), tuple(batch.extra_data)
         )
-        if out.ndim == 3:
-            return out.argmax(axis=-1).astype(np.float32)
-        out2d = out.reshape(out.shape[0], -1)
-        if out2d.shape[1] == 1:
-            return out2d[:, 0]
-        return out2d.argmax(axis=1).astype(np.float32)
+        return self.predict_from_scores(out)
 
     def extract_feature(self, batch: DataBatch, node_name: str) -> np.ndarray:
-        g = self.graph
-        if node_name.startswith("top[-"):
-            offset = int(node_name[len("top[-"):-1])
-            nnode = g.num_nodes
-            if not (1 <= offset <= nnode):
-                raise ValueError("ExtractFeature: offset out of node range")
-            node_id = nnode - offset
-        else:
-            node_id = g.node_index_of(node_name)
+        node_id = self.resolve_feature_node(node_name)
         return self._run_sharded(
             self._node_fn(node_id), np.asarray(batch.data),
             tuple(batch.extra_data),

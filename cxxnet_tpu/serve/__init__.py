@@ -1,0 +1,43 @@
+"""Online inference serving subsystem.
+
+The training half of the framework walks an iterator once and exits
+(``cli.py`` tasks); this package is the serving half the ROADMAP's
+"heavy traffic" north star requires: a model loaded from a validated
+checkpoint, a shape-bucketed cache of compiled predict programs, a
+dynamic micro-batcher with explicit backpressure, serving metrics, and
+a stdlib HTTP front-end — ``task = serve`` in the CLI, or embed
+:class:`Engine` directly:
+
+    from cxxnet_tpu import serve
+    eng = serve.Engine(cfg=conf_text, model_dir="models")
+    pred = eng.submit(rows)            # thread-safe, micro-batched
+
+See ``doc/serving.md`` for configuration and semantics.
+"""
+
+from .batcher import (  # noqa: F401
+    ClosedError,
+    DeadlineError,
+    MicroBatcher,
+    OverloadError,
+    ServeError,
+)
+from .cache import ShapeBucketCache, bucket_size  # noqa: F401
+from .engine import Engine, ModelLoadError  # noqa: F401
+from .metrics import ServingStats  # noqa: F401
+from .server import make_server, serve_forever  # noqa: F401
+
+__all__ = [
+    "Engine",
+    "MicroBatcher",
+    "ShapeBucketCache",
+    "ServingStats",
+    "ServeError",
+    "OverloadError",
+    "DeadlineError",
+    "ClosedError",
+    "ModelLoadError",
+    "bucket_size",
+    "make_server",
+    "serve_forever",
+]

@@ -1,0 +1,164 @@
+"""Shape-bucketed compiled-predict cache.
+
+The cuDNN lesson (Chetlur et al., arXiv:1410.0759): fast inference comes
+from a small set of FIXED, reusable compiled primitives, not per-call
+specialization.  ``jax.jit`` specializes per input *shape*, so a serving
+front-end that forwards raw request sizes compiles a fresh XLA program
+for every distinct batch size it ever sees — the first request of size
+37 stalls behind a multi-second compile, and the compile cache grows
+without bound.
+
+:class:`ShapeBucketCache` coarsens the shape space instead: a request of
+``n`` rows is zero-padded up to the next power-of-two bucket (rounded up
+to the mesh's data-axis size so sharded predict stays legal), runs
+through the trainer's pure predict function for that bucket, and the
+padded rows are trimmed off the result.  Mixed request sizes therefore
+hit at most ``log2(max size)`` compiled programs, all warm after the
+first pass.  Cache keys are
+``(net_fingerprint, kind, bucket, row_shape, dtype)`` — a hot model
+reload (new fingerprint) or a different feature node naturally occupies
+new slots.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["bucket_size", "ShapeBucketCache"]
+
+
+def bucket_size(n: int, multiple_of: int = 1) -> int:
+    """Smallest power of two >= ``n``, rounded up to ``multiple_of``
+    (the mesh data-axis size, so every bucket shards evenly)."""
+    if n <= 0:
+        raise ValueError(f"bucket_size: need at least one row, got {n}")
+    b = 1 << (int(n - 1).bit_length())
+    if multiple_of > 1:
+        b += (-b) % multiple_of
+    return b
+
+
+class ShapeBucketCache:
+    """Bucketed eval-forward runner over one :class:`NetTrainer`.
+
+    Thread-safe for stats; concurrent ``predict`` calls are safe (JAX
+    dispatch is), though the serving engine funnels execution through
+    one batcher thread anyway.  The heavy state — the compiled XLA
+    executables — lives in the trainer's jitted functions; this class
+    owns the bucketing policy and the hit/miss accounting keyed the way
+    the executables are actually specialized.
+    """
+
+    def __init__(self, trainer, max_batch_size: int = 0) -> None:
+        self._trainer = trainer
+        self.max_batch_size = int(max_batch_size)
+        self._keys: Dict[tuple, int] = {}  # key -> times used
+        self._graph = trainer.graph  # identity snapshot: reset on rebuild
+        self._net_fp: Optional[str] = None
+        self.hits = 0
+        self.misses = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    @property
+    def trainer(self):
+        return self._trainer
+
+    def net_fp(self) -> str:
+        """Cached net fingerprint (recomputed after a net rebuild)."""
+        self._check_generation()
+        if self._net_fp is None:
+            self._net_fp = self._trainer.net_fp()
+        return self._net_fp
+
+    def _check_generation(self) -> None:
+        """Drop stale keys when the trainer rebuilt its net (load_model /
+        init_model clear the jit cache, so 'hits' would lie)."""
+        if self._trainer.graph is not self._graph:
+            with self._lock:
+                self._keys.clear()
+                self._graph = self._trainer.graph
+                self._net_fp = None
+
+    def _n_data(self) -> int:
+        plan = self._trainer.mesh_plan
+        return plan.n_data if plan is not None else 1
+
+    def bucket_for(self, n: int) -> int:
+        return bucket_size(n, self._n_data())
+
+    # ------------------------------------------------------------------
+    def _run(self, kind: str, node_id: Optional[int],
+             data: np.ndarray) -> np.ndarray:
+        """Pad ``data`` to its bucket, run the compiled predict fn, trim."""
+        import jax
+        import jax.numpy as jnp
+
+        tr = self._trainer
+        assert tr.net is not None, "init_model/load_model first"
+        if tr.graph.extra_data_num:
+            raise ValueError(
+                "serving does not support nets with extra_data nodes"
+            )
+        data = np.ascontiguousarray(data, np.float32)
+        if data.ndim < 2:
+            raise ValueError(
+                f"predict input must be a (N, ...) batch, got shape "
+                f"{data.shape}"
+            )
+        n = data.shape[0]
+        bucket = self.bucket_for(n)
+        key = (self.net_fp(), kind, node_id, bucket,
+               data.shape[1:], str(data.dtype))
+        with self._lock:
+            if key in self._keys:
+                self._keys[key] += 1
+                self.hits += 1
+            else:
+                self._keys[key] = 1
+                self.misses += 1
+        if bucket > n:
+            data = np.concatenate(
+                [data, np.zeros((bucket - n,) + data.shape[1:], data.dtype)],
+                axis=0,
+            )
+        fn = tr.predict_fn(node_id)
+        out = np.asarray(jax.device_get(
+            fn(tr.params, tr.aux, jnp.asarray(data), ())
+        ))
+        return out[:n]
+
+    def scores(self, data: np.ndarray) -> np.ndarray:
+        """Raw f32 out-node rows for ``data`` (no argmax).  Shares its
+        cache slots (and compiled programs) with :meth:`predict` — the
+        argmax happens on host, after the compiled part."""
+        return self._run("out", None, data)
+
+    def predict(self, data: np.ndarray) -> np.ndarray:
+        """Per-instance predictions (trainer argmax semantics), trimmed
+        to exactly ``data.shape[0]`` rows."""
+        return self._trainer.predict_from_scores(
+            self._run("out", None, data)
+        )
+
+    def extract(self, data: np.ndarray, node_name: str) -> np.ndarray:
+        node_id = self._trainer.resolve_feature_node(node_name)
+        return self._run("extract", node_id, data)
+
+    def keys_snapshot(self) -> list:
+        """Consistent copy of the cache keys (for reload warmup —
+        request threads keep inserting concurrently)."""
+        with self._lock:
+            return list(self._keys)
+
+    # ------------------------------------------------------------------
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "buckets": len(self._keys),
+                "hits": self.hits,
+                "misses": self.misses,
+            }

@@ -1,0 +1,371 @@
+"""Serving engine: checkpoint-validated model + batcher + predict cache.
+
+:class:`Engine` is the embeddable core of the serving subsystem (the
+HTTP front-end in ``server.py`` is one thin client of it; tests and the
+bench tool drive it directly):
+
+* **model loading** — from an explicit checkpoint path or the newest
+  *valid* checkpoint in a model directory, using the fault-tolerant
+  manifest machinery from PR 1 (CRC32 + size + net-fingerprint
+  validation; corrupt/truncated checkpoints are skipped, never served);
+* **compiled-predict cache** — a :class:`~cxxnet_tpu.serve.cache.
+  ShapeBucketCache` so mixed request sizes stay within a handful of
+  warm XLA programs;
+* **dynamic micro-batching** — every request goes through the
+  :class:`~cxxnet_tpu.serve.batcher.MicroBatcher`; ``submit`` is the
+  direct Python API (numpy in, numpy out, thread-safe);
+* **hot reload** — :meth:`reload_if_newer` loads a newer valid
+  checkpoint into a FRESH trainer, warms its compile cache on the
+  shapes already in service, then swaps it in atomically under the
+  model lock; in-flight batches finish on the old model, the next
+  batch runs on the new one;
+* **metrics** — a :class:`~cxxnet_tpu.serve.metrics.ServingStats`
+  shared with the front-end's ``/statsz``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..nnet.trainer import NetTrainer
+from ..utils import checkpoint as ckpt
+from .batcher import ClosedError, MicroBatcher, ServeError
+from .cache import ShapeBucketCache
+from .metrics import ServingStats
+
+__all__ = ["Engine", "ModelLoadError"]
+
+ConfigEntry = Tuple[str, str]
+
+
+class ModelLoadError(ServeError):
+    """No usable checkpoint could be loaded."""
+
+    http_status = 503
+
+
+def _parse_cfg(cfg: Union[str, Sequence[ConfigEntry], None]):
+    if cfg is None:
+        return []
+    if isinstance(cfg, str):
+        from .. import config as cfgmod
+
+        return list(cfgmod.parse_pairs(cfg))
+    return list(cfg)
+
+
+class Engine:
+    """One served model behind a micro-batcher.
+
+    ``cfg`` carries the netconfig (checkpoints store structure; layer
+    settings come from the conf — the same contract as
+    ``NetTrainer.load_model``) plus any trainer globals (``dev`` etc.).
+    Exactly one model source: ``model_in`` (path), ``model_dir``
+    (newest valid checkpoint; also the hot-reload watch directory), or
+    ``trainer`` (an already-initialized trainer — embedding/bench use).
+    """
+
+    def __init__(
+        self,
+        cfg: Union[str, Sequence[ConfigEntry], None] = None,
+        model_in: Optional[str] = None,
+        model_dir: Optional[str] = None,
+        trainer: Optional[NetTrainer] = None,
+        max_batch_size: int = 0,
+        batch_timeout_ms: float = 2.0,
+        queue_limit: int = 128,
+        default_deadline_ms: float = 0.0,
+        silent: bool = True,
+    ) -> None:
+        self._cfg = _parse_cfg(cfg)
+        self.model_dir = model_dir
+        self.silent = silent
+        self.default_deadline_ms = float(default_deadline_ms)
+        self._model_lock = threading.RLock()
+        self._round = -1
+        self._model_path: Optional[str] = None
+        if trainer is not None:
+            if trainer.net is None:
+                raise ValueError("Engine(trainer=...): init/load it first")
+            self._trainer = trainer
+        elif model_in is not None:
+            reason = ckpt.validate_checkpoint(
+                model_in, net_fp=self._conf_net_fp()
+            )
+            if reason is not None:
+                raise ModelLoadError(f"{model_in}: {reason}")
+            self._trainer = self._load_trainer(model_in)
+            self._set_model(model_in)
+        elif model_dir is not None:
+            found = ckpt.find_latest_valid(
+                model_dir, net_fp=self._conf_net_fp(), silent=silent
+            )
+            if found is None:
+                raise ModelLoadError(
+                    f"no valid checkpoint in {model_dir!r}"
+                )
+            self._round = found[0]
+            self._trainer = self._load_trainer(found[1])
+            self._set_model(found[1], found[0])
+        else:
+            raise ValueError(
+                "Engine needs one of model_in / model_dir / trainer"
+            )
+        if self._trainer.graph.extra_data_num:
+            raise ValueError(
+                "serving does not support nets with extra_data nodes"
+            )
+        if max_batch_size <= 0:
+            max_batch_size = self._trainer.batch_size or 64
+        self.max_batch_size = max_batch_size
+        self.stats = ServingStats()
+        self._cache = ShapeBucketCache(self._trainer, max_batch_size)
+        self._row_shapes = self._allowed_row_shapes(self._trainer)
+        self.batcher = MicroBatcher(
+            self._run_batch,
+            max_batch_size=max_batch_size,
+            batch_timeout_ms=batch_timeout_ms,
+            queue_limit=queue_limit,
+            stats=self.stats,
+        )
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # loading
+    def _conf_net_fp(self) -> Optional[str]:
+        """Fingerprint of the conf's netconfig for manifest validation
+        (None when the conf carries none — validation then skips the
+        fingerprint cross-check, manifest CRC still applies)."""
+        from ..nnet.graph import NetGraph
+
+        try:
+            g = NetGraph()
+            g.configure(self._cfg)
+            return ckpt.net_fingerprint(g.structure_to_json())
+        except Exception:
+            return None
+
+    def _load_trainer(self, path: str) -> NetTrainer:
+        tr = NetTrainer()
+        tr.set_params(self._cfg)
+        ckpt.retry_io(lambda: tr.load_model(path),
+                      what=f"loading {path}", silent=self.silent)
+        return tr
+
+    def _set_model(self, path: str, round_: Optional[int] = None) -> None:
+        self._model_path = path
+        if round_ is not None:
+            self._round = round_
+        else:
+            r = ckpt.checkpoint_round(path)
+            man = ckpt.read_manifest(path)
+            if man is not None and man.get("round") is not None:
+                r = int(man["round"])
+            self._round = r if r is not None else -1
+
+    @staticmethod
+    def _allowed_row_shapes(tr: NetTrainer) -> List[Tuple[int, ...]]:
+        """Row shapes a request may carry: the net's native input row,
+        plus its flat spelling (the wrapper contract: flat ``(N, D)``
+        is accepted wherever a 4-D tensor is)."""
+        row = tuple(tr.net.input_node_shape(1)[1:])
+        shapes = [row]
+        flat = (int(np.prod(row)),)
+        if flat != row:
+            shapes.append(flat)
+        return shapes
+
+    # ------------------------------------------------------------------
+    # request path
+    def _validate(self, data) -> np.ndarray:
+        arr = np.ascontiguousarray(data, np.float32)
+        if arr.ndim == 1 and (arr.shape[0],) in self._row_shapes:
+            arr = arr[None, :]  # single flat instance
+        if arr.ndim < 2 or arr.shape[0] < 1:
+            raise ValueError(
+                f"request must be a (N, ...) batch of at least one row, "
+                f"got shape {arr.shape}"
+            )
+        if arr.shape[0] > self.max_batch_size:
+            # without this cap a single huge request would bypass both
+            # max_batch_size and the queue bound (queue_limit counts
+            # requests, not rows) and pad to an even bigger bucket
+            raise ValueError(
+                f"request has {arr.shape[0]} rows, above the server's "
+                f"max_batch_size={self.max_batch_size}; split it into "
+                f"smaller requests"
+            )
+        if tuple(arr.shape[1:]) not in self._row_shapes:
+            raise ValueError(
+                f"bad input row shape {tuple(arr.shape[1:])}; this model "
+                f"accepts rows of shape "
+                f"{' or '.join(str(s) for s in self._row_shapes)}"
+            )
+        return arr
+
+    def _run_batch(self, kind: str, node: Optional[str],
+                   data: np.ndarray) -> np.ndarray:
+        """Batcher callback: one coalesced batch through the CURRENT
+        model's bucket cache (the lock makes the model swap atomic with
+        respect to batch execution)."""
+        with self._model_lock:
+            cache = self._cache
+        n = data.shape[0]
+        self.stats.record_batch(n, cache.bucket_for(n))
+        if kind == "extract":
+            return cache.extract(data, node)
+        if kind == "scores":
+            return cache.scores(data)
+        return cache.predict(data)
+
+    def submit(
+        self,
+        data,
+        kind: str = "predict",
+        node: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+    ) -> np.ndarray:
+        """The direct (embedding) API: block until the request's rows
+        come back through the micro-batcher.  Thread-safe; concurrent
+        callers are what the batcher exists to coalesce.
+
+        ``kind``: ``predict`` (argmax/value per instance), ``scores``
+        (raw f32 out-node rows), or ``extract`` (features of ``node``).
+        Raises ``OverloadError`` / ``DeadlineError`` / ``ValueError``
+        on shed, expiry, or malformed input."""
+        if self._closed:
+            raise ClosedError("engine is closed")
+        if kind not in ("predict", "scores", "extract"):
+            raise ValueError(f"unknown request kind {kind!r}")
+        if kind == "extract" and not node:
+            raise ValueError("extract requests need a node name")
+        arr = self._validate(data)
+        self.stats.record_request(arr.shape[0])
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        t0 = time.monotonic()
+        try:
+            out = self.batcher.submit(
+                arr, kind=kind, node=node if kind == "extract" else None,
+                deadline_ms=deadline_ms,
+            )
+        except ServeError as e:
+            self.stats.record_outcome(
+                "shed" if e.http_status == 429
+                else "expired" if e.http_status == 504 else "error"
+            )
+            raise
+        except BaseException:
+            self.stats.record_outcome("error")
+            raise
+        self.stats.record_outcome("ok", time.monotonic() - t0)
+        return out
+
+    def predict(self, data, deadline_ms: Optional[float] = None):
+        return self.submit(data, kind="predict", deadline_ms=deadline_ms)
+
+    def extract(self, data, node: str,
+                deadline_ms: Optional[float] = None):
+        return self.submit(data, kind="extract", node=node,
+                           deadline_ms=deadline_ms)
+
+    # ------------------------------------------------------------------
+    # hot reload
+    def reload_if_newer(self) -> bool:
+        """Swap to a newer valid checkpoint in ``model_dir`` (no-op and
+        False when there is none, when the engine was built without a
+        watch directory, or when the newest round is already serving).
+
+        The new trainer is built and its compile cache warmed on every
+        bucket shape currently in service BEFORE the swap, so the first
+        requests after a reload do not stall behind XLA compiles; the
+        swap itself is a pointer flip under the model lock."""
+        if self.model_dir is None:
+            return False
+        found = ckpt.find_latest_valid(
+            self.model_dir, net_fp=self._conf_net_fp(), silent=self.silent
+        )
+        if found is None or found[0] <= self._round:
+            return False
+        round_, path = found
+        tr = self._load_trainer(path)
+        cache = ShapeBucketCache(tr, self._cache.max_batch_size)
+        self._warm(cache)
+        with self._model_lock:
+            self._trainer = tr
+            self._cache = cache
+            self._row_shapes = self._allowed_row_shapes(tr)
+            self._set_model(path, round_)
+        if not self.silent:
+            print(f"serve: hot-reloaded round {round_} from {path}",
+                  flush=True)
+        return True
+
+    def _warm(self, cache: ShapeBucketCache) -> None:
+        """Compile the new model for every (kind, node, bucket, shape)
+        the old cache served, by running zero batches through it."""
+        with self._model_lock:
+            keys = self._cache.keys_snapshot()
+        for _fp, kind, node_id, bucket, row_shape, dtype in keys:
+            zeros = np.zeros((bucket,) + tuple(row_shape), dtype)
+            try:
+                cache._run(kind, node_id, zeros)
+            except Exception:
+                if not self.silent:
+                    print(f"serve: warmup failed for bucket {bucket} "
+                          f"shape {row_shape}", flush=True)
+
+    # ------------------------------------------------------------------
+    # introspection
+    @property
+    def round(self) -> int:
+        return self._round
+
+    @property
+    def trainer(self) -> NetTrainer:
+        """The live trainer (swapped by hot reload; hold no references
+        across requests)."""
+        with self._model_lock:
+            return self._trainer
+
+    def healthz(self) -> Dict[str, object]:
+        with self._model_lock:
+            return {
+                "status": "ok" if not self._closed else "closed",
+                "round": self._round,
+                "model": self._model_path,
+                "net_fp": self._cache.net_fp(),
+            }
+
+    def snapshot_stats(self) -> Dict[str, object]:
+        out = self.stats.snapshot()
+        with self._model_lock:
+            out["compile_cache"] = self._cache.stats()
+            out["model"] = {
+                "path": self._model_path,
+                "round": self._round,
+                "net_fp": self._cache.net_fp(),
+            }
+        out["batcher"] = {
+            "max_batch_size": self.batcher.max_batch_size,
+            "batch_timeout_ms": self.batcher.batch_timeout * 1e3,
+            "queue_limit": self.batcher.queue_limit,
+        }
+        return out
+
+    # ------------------------------------------------------------------
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self.batcher.close()
+
+    def __enter__(self) -> "Engine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,107 @@
+"""Serving metrics: request/batch counters + latency percentiles.
+
+One :class:`ServingStats` per engine, shared by the batcher (queue and
+batch accounting), the request paths (latency, outcome counters), and
+the HTTP front-end (``/statsz`` renders :meth:`snapshot`).  Latency uses
+:class:`~cxxnet_tpu.utils.profiler.PercentileTracker` — the serving-side
+sibling of the train loop's ``StepTimer``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..utils.profiler import PercentileTracker
+
+__all__ = ["ServingStats"]
+
+
+class ServingStats:
+    """Thread-safe counters for the serving subsystem.
+
+    * request outcomes: ``ok`` / ``shed`` (queue full) / ``expired``
+      (deadline passed before execution) / ``error``
+    * batch shape: executed batches, rows, padded bucket rows — the
+      batch-fill ratio (real rows / bucket rows actually computed) says
+      how much of each compiled program's work was useful, the
+      coalescing ratio (rows per batch) says how well the micro-batcher
+      amortizes dispatch
+    * end-to-end request latency percentiles (enqueue → result)
+    """
+
+    def __init__(self, latency_window: int = 2048) -> None:
+        self._lock = threading.Lock()
+        self.started = time.time()
+        self.requests = 0
+        self.rows_in = 0
+        self.ok = 0
+        self.shed = 0
+        self.expired = 0
+        self.errors = 0
+        self.batches = 0
+        self.batch_rows = 0
+        self.bucket_rows = 0
+        self.latency = PercentileTracker(latency_window)
+        self._queue_depth: Optional[Callable[[], int]] = None
+
+    # ------------------------------------------------------------------
+    def bind_queue_depth(self, fn: Callable[[], int]) -> None:
+        """Register the live queue-depth gauge (the batcher's)."""
+        self._queue_depth = fn
+
+    def record_request(self, rows: int) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows_in += rows
+
+    def record_outcome(self, outcome: str,
+                       latency_s: Optional[float] = None) -> None:
+        with self._lock:
+            if outcome == "ok":
+                self.ok += 1
+            elif outcome == "shed":
+                self.shed += 1
+            elif outcome == "expired":
+                self.expired += 1
+            else:
+                self.errors += 1
+        if latency_s is not None:
+            self.latency.add(latency_s)
+
+    def record_batch(self, rows: int, bucket_rows: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batch_rows += rows
+            self.bucket_rows += bucket_rows
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            out: Dict[str, object] = {
+                "uptime_sec": time.time() - self.started,
+                "requests": self.requests,
+                "rows_in": self.rows_in,
+                "ok": self.ok,
+                "shed": self.shed,
+                "expired": self.expired,
+                "errors": self.errors,
+                "batches": self.batches,
+                "batch_rows": self.batch_rows,
+                "bucket_rows": self.bucket_rows,
+                "batch_fill_ratio": (
+                    self.batch_rows / self.bucket_rows
+                    if self.bucket_rows else 0.0
+                ),
+                "rows_per_batch": (
+                    self.batch_rows / self.batches if self.batches else 0.0
+                ),
+            }
+        out["latency_ms"] = self.latency.summary(scale=1e3)
+        if self._queue_depth is not None:
+            try:
+                out["queue_depth"] = int(self._queue_depth())
+            except Exception:
+                out["queue_depth"] = -1
+        return out

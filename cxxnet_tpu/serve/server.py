@@ -1,0 +1,165 @@
+"""HTTP front-end: a stdlib JSON endpoint over :class:`Engine`.
+
+``ThreadingHTTPServer`` gives one thread per connection; every handler
+thread blocks in ``Engine.submit`` while the micro-batcher coalesces the
+concurrent requests into shared device calls — the threading model IS
+the batching opportunity.  Endpoints:
+
+* ``POST /predict``  — ``{"data": [[...], ...]}`` → ``{"pred": [...]}``
+  (add ``"raw": true`` for the full score rows)
+* ``POST /extract``  — ``{"data": ..., "node": "fc1"}`` →
+  ``{"features": [[...], ...]}``
+* ``GET  /healthz``  — liveness + model identity (round, fingerprint)
+* ``GET  /statsz``   — serving metrics (see ``metrics.py``)
+
+Errors map to JSON bodies with meaningful statuses: 400 malformed
+request, 404 unknown route, 429 load shed, 503 shutting down, 504
+deadline expired, 500 model failure.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .batcher import ServeError
+from .engine import Engine
+
+__all__ = ["make_server", "serve_forever"]
+
+MAX_BODY_BYTES = 64 << 20  # reject absurd request bodies outright
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    engine: Engine = None  # bound by make_server via subclassing
+    verbose = False
+
+    # ------------------------------------------------------------------
+    def log_message(self, fmt, *args):  # noqa: N802 - stdlib name
+        if self.verbose:
+            BaseHTTPRequestHandler.log_message(self, fmt, *args)
+
+    def _reply(self, status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> Optional[dict]:
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = 0
+        if length <= 0 or length > MAX_BODY_BYTES:
+            self._reply(400, {"error": "missing or oversized body"})
+            return None
+        try:
+            obj = json.loads(self.rfile.read(length).decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as e:
+            self._reply(400, {"error": f"bad JSON: {e}"})
+            return None
+        if not isinstance(obj, dict) or "data" not in obj:
+            self._reply(400, {"error": 'body must be {"data": [...]}'})
+            return None
+        return obj
+
+    # ------------------------------------------------------------------
+    def do_GET(self) -> None:  # noqa: N802 - stdlib name
+        if self.path == "/healthz":
+            self._reply(200, self.engine.healthz())
+        elif self.path == "/statsz":
+            self._reply(200, self.engine.snapshot_stats())
+        else:
+            self._reply(404, {"error": f"unknown route {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 - stdlib name
+        if self.path not in ("/predict", "/extract"):
+            self._reply(404, {"error": f"unknown route {self.path}"})
+            return
+        obj = self._read_json()
+        if obj is None:
+            return
+        deadline = obj.get("deadline_ms")
+        try:
+            if self.path == "/extract":
+                node = obj.get("node")
+                if not node:
+                    self._reply(400, {"error": "extract needs a node name"})
+                    return
+                out = self.engine.extract(obj["data"], node,
+                                          deadline_ms=deadline)
+                self._reply(200, {"features": out.tolist()})
+            else:
+                kind = "scores" if obj.get("raw") else "predict"
+                out = self.engine.submit(obj["data"], kind=kind,
+                                         deadline_ms=deadline)
+                key = "scores" if kind == "scores" else "pred"
+                self._reply(200, {key: np.asarray(out).tolist()})
+        except ServeError as e:
+            self._reply(e.http_status, {"error": str(e)})
+        except (ValueError, TypeError) as e:
+            self._reply(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 - served as a 500
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+def make_server(
+    engine: Engine,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    verbose: bool = False,
+) -> ThreadingHTTPServer:
+    """Bind (but do not run) the HTTP server; ``port=0`` picks an
+    ephemeral port — read it back from ``server.server_port``."""
+    handler = type(
+        "BoundHandler", (_Handler,), {"engine": engine, "verbose": verbose}
+    )
+    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd.daemon_threads = True
+    return httpd
+
+
+def serve_forever(
+    engine: Engine,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    reload_period_s: float = 0.0,
+    verbose: bool = False,
+    ready_fn=None,
+) -> Tuple[ThreadingHTTPServer, Optional[threading.Thread]]:
+    """Run the server until ``httpd.shutdown()`` (blocking).
+
+    ``reload_period_s > 0`` starts a background thread polling
+    ``engine.reload_if_newer()`` — hot model reload without dropping a
+    request.  ``ready_fn(httpd)`` is called once the socket is bound,
+    before serving (the CLI prints the actual port there)."""
+    httpd = make_server(engine, host, port, verbose=verbose)
+    stop = threading.Event()
+    reloader = None
+    if reload_period_s > 0 and engine.model_dir is not None:
+        def _poll():
+            while not stop.wait(reload_period_s):
+                try:
+                    engine.reload_if_newer()
+                except Exception as e:  # noqa: BLE001 - keep serving
+                    print(f"serve: reload failed: {e}", flush=True)
+
+        reloader = threading.Thread(
+            target=_poll, name="cxxnet-serve-reload", daemon=True
+        )
+        reloader.start()
+    if ready_fn is not None:
+        ready_fn(httpd)
+    try:
+        httpd.serve_forever(poll_interval=0.2)
+    finally:
+        stop.set()
+        httpd.server_close()
+    return httpd, reloader

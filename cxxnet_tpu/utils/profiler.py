@@ -16,10 +16,72 @@ Config keys (all global):
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 ConfigEntry = Tuple[str, str]
+
+
+class PercentileTracker:
+    """Thread-safe sliding-window percentile estimator (serving latency).
+
+    Keeps the newest ``window`` samples in a ring buffer; percentiles are
+    computed over that window on demand.  Unlike :class:`StepTimer` (one
+    round of a single-threaded train loop) this is written for many
+    concurrent request threads recording into one tracker for the whole
+    server lifetime, so it is locked and bounded."""
+
+    def __init__(self, window: int = 2048) -> None:
+        self._window = max(1, int(window))
+        self._buf: List[float] = []
+        self._pos = 0
+        self._count = 0
+        self._total = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, value: float) -> None:
+        with self._lock:
+            if len(self._buf) < self._window:
+                self._buf.append(float(value))
+            else:
+                self._buf[self._pos] = float(value)
+                self._pos = (self._pos + 1) % self._window
+            self._count += 1
+            self._total += float(value)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def percentiles(
+        self, qs: Sequence[float] = (50.0, 95.0, 99.0)
+    ) -> Dict[str, float]:
+        """``{"p50": ..., "p95": ...}`` over the current window (empty
+        dict when no samples); nearest-rank on the sorted window."""
+        with self._lock:
+            snap = sorted(self._buf)
+        if not snap:
+            return {}
+        n = len(snap)
+        out = {}
+        for q in qs:
+            idx = min(n - 1, max(0, int(round(q / 100.0 * n)) - 1))
+            out[f"p{q:g}"] = snap[idx]
+        return out
+
+    def summary(self, scale: float = 1.0) -> Dict[str, float]:
+        """count / mean / p50 / p95 / p99, each multiplied by ``scale``
+        (pass 1e3 to report seconds as milliseconds)."""
+        with self._lock:
+            count, total = self._count, self._total
+        if not count:
+            return {"count": 0}
+        out = {"count": float(count), "mean": total / count * scale}
+        out.update(
+            {k: v * scale for k, v in self.percentiles().items()}
+        )
+        return out
 
 
 class StepTimer:

@@ -115,6 +115,7 @@ class Net:
         self._trainer.set_param("dev", dev)
         if cfg:
             self._trainer.set_params(cfgmod.parse_pairs(cfg))
+        self._predict_cache = None  # lazy ShapeBucketCache (predict/extract)
 
     @property
     def trainer(self) -> NetTrainer:
@@ -178,21 +179,59 @@ class Net:
             data.head, data.tail = False, True
         return ret
 
+    def _bucket_cache(self):
+        """The shape-bucketed compiled-predict cache for raw-array
+        inference (``serve/cache.py``): odd request sizes pad to
+        power-of-two buckets, so repeated mixed-size calls reuse a
+        handful of warm XLA programs instead of re-jitting per size.
+        Self-invalidates when the trainer rebuilds its net
+        (init_model / load_model)."""
+        from .serve.cache import ShapeBucketCache
+
+        if (self._predict_cache is None
+                or self._predict_cache.trainer is not self._trainer):
+            self._predict_cache = ShapeBucketCache(
+                self._trainer, self._trainer.batch_size or 64
+            )
+        return self._predict_cache
+
+    def _bucketed_ok(self, arr: np.ndarray) -> bool:
+        """Raw arrays route through the bucket cache for single-process
+        runs (multi-process predict needs the trainer's global-array
+        assembly) on nets without extra_data side inputs."""
+        import jax
+
+        return (arr.ndim >= 2 and jax.process_count() == 1
+                and self._trainer.graph is not None
+                and not self._trainer.graph.extra_data_num)
+
     def predict(self, data: Union[DataIter, np.ndarray]) -> np.ndarray:
-        """Prediction for the current batch (iter) or the given array."""
+        """Prediction for the current batch (iter) or the given array.
+
+        Raw arrays return exactly ``data.shape[0]`` rows — internal
+        bucket/shard padding is always trimmed — and hit the bucketed
+        compile cache, so request sizes like 3, 7, 100 stop compiling
+        fresh XLA programs per size."""
         if isinstance(data, DataIter):
             batch = data.value()
             n = batch.batch_size - batch.num_batch_padd
             return self._trainer.predict(batch)[:n]
-        return self._trainer.predict(_as_batch(np.asarray(data), None))
+        arr = np.ascontiguousarray(np.asarray(data), np.float32)
+        if self._bucketed_ok(arr):
+            return self._bucket_cache().predict(arr)
+        return self._trainer.predict(_as_batch(arr, None))
 
     def extract(self, data: Union[DataIter, np.ndarray], name: str) -> np.ndarray:
-        """Feature extraction by node name or ``top[-k]``."""
+        """Feature extraction by node name or ``top[-k]`` (raw arrays:
+        trimmed to the input row count, bucket-cached like predict)."""
         if isinstance(data, DataIter):
             batch = data.value()
             n = batch.batch_size - batch.num_batch_padd
             return self._trainer.extract_feature(batch, name)[:n]
-        return self._trainer.extract_feature(_as_batch(np.asarray(data), None), name)
+        arr = np.ascontiguousarray(np.asarray(data), np.float32)
+        if self._bucketed_ok(arr):
+            return self._bucket_cache().extract(arr, name)
+        return self._trainer.extract_feature(_as_batch(arr, None), name)
 
     def generate(self, prompt: str = "", gen_len: int = 256,
                  temp: float = 0.0, cache: bool = True,

@@ -1,0 +1,566 @@
+"""Serving subsystem tests: bucket cache, micro-batcher, engine, HTTP.
+
+The compile-counting tests instrument ``FunctionalNet.forward`` — inside
+a jitted function it runs only at TRACE time, so its call count equals
+the number of XLA compilations triggered through the predict path.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import config as cfgmod
+from cxxnet_tpu import serve
+from cxxnet_tpu.nnet.trainer import NetTrainer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MLP_CFG = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+1:a1] = relu:a1
+layer[a1->out] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 32
+dev = cpu
+eta = 0.1
+"""
+
+
+def make_trainer(seed=0, cfg=MLP_CFG):
+    tr = NetTrainer()
+    tr.set_params(cfgmod.parse_pairs(cfg))
+    tr.set_param("seed", str(seed))
+    tr.init_model()
+    return tr
+
+
+def count_traces(tr):
+    """Wrap the net's forward so each XLA (re)trace bumps a counter."""
+    calls = []
+    orig = tr.net.forward
+
+    def counting(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    tr.net.forward = counting
+    return calls
+
+
+def toy_rows(n, seed=0):
+    return np.random.RandomState(seed).randn(n, 16).astype(np.float32)
+
+
+# ----------------------------------------------------------------------
+# bucket policy + compile cache
+def test_bucket_size_policy():
+    assert [serve.bucket_size(n) for n in (1, 2, 3, 7, 8, 9, 100)] == [
+        1, 2, 4, 8, 8, 16, 128,
+    ]
+    # rounded up to the mesh data-axis size so sharded predict stays legal
+    assert serve.bucket_size(3, multiple_of=8) == 8
+    assert serve.bucket_size(100, multiple_of=8) == 128
+    with pytest.raises(ValueError):
+        serve.bucket_size(0)
+
+
+def test_compile_count_mixed_sizes():
+    """Mixed request sizes {1,3,7,32,100} compile AT MOST once per
+    power-of-two bucket; after warmup, zero new compiles."""
+    tr = make_trainer()
+    calls = count_traces(tr)
+    cache = serve.ShapeBucketCache(tr, max_batch_size=128)
+    sizes = [1, 3, 7, 32, 100]
+    x = toy_rows(128)
+    for n in sizes:
+        out = cache.predict(x[:n])
+        assert out.shape[0] == n
+    buckets = {serve.bucket_size(n) for n in sizes}  # {1, 4, 8, 32, 128}
+    warm = len(calls)
+    assert warm <= len(buckets), (
+        f"{warm} compiles for {len(buckets)} buckets"
+    )
+    # post-warmup: repeated mixed sizes, fresh data — NO new compiles
+    for seed in (1, 2, 3):
+        for n in sizes:
+            cache.predict(toy_rows(n, seed=seed))
+    assert len(calls) == warm, "post-warmup recompile detected"
+    st = cache.stats()
+    assert st["misses"] == len(sizes)  # one miss per first-seen bucket key
+    assert st["hits"] == 3 * len(sizes)
+
+
+def test_cache_trims_padding_and_matches_full_batch():
+    tr = make_trainer()
+    cache = serve.ShapeBucketCache(tr, max_batch_size=32)
+    x = toy_rows(32)
+    full = cache.predict(x)
+    for n in (1, 3, 7, 30):
+        out = cache.predict(x[:n])
+        assert out.shape[0] == n  # bucket padding trimmed
+        np.testing.assert_array_equal(out, full[:n])
+    feats = cache.extract(x[:5], "fc1")
+    assert feats.shape[0] == 5
+
+
+def test_cache_sharded_mesh_buckets():
+    """dev=cpu:0-7 (8 virtual devices): buckets round to the data-axis
+    size and odd sizes still predict correctly through the sharded jit."""
+    tr = make_trainer(cfg=MLP_CFG.replace("dev = cpu", "dev = cpu:0-7"))
+    assert tr.mesh_plan.n_data == 8
+    cache = serve.ShapeBucketCache(tr, max_batch_size=32)
+    assert cache.bucket_for(3) == 8
+    x = toy_rows(32)
+    out = cache.predict(x[:3])
+    assert out.shape[0] == 3
+    np.testing.assert_array_equal(out, cache.predict(x)[:3])
+
+
+# ----------------------------------------------------------------------
+# micro-batcher
+def test_batcher_coalesces_concurrent_requests():
+    batches = []
+
+    def runner(kind, node, data):
+        batches.append(data.shape[0])
+        time.sleep(0.01)  # widen the window so peers can join
+        return data * 2.0
+
+    b = serve.MicroBatcher(runner, max_batch_size=64, batch_timeout_ms=50,
+                           queue_limit=64)
+    xs = [np.full((1, 4), i, np.float32) for i in range(8)]
+    outs = [None] * 8
+
+    def go(i):
+        outs[i] = b.submit(xs[i])
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    b.close()
+    for i in range(8):
+        np.testing.assert_array_equal(outs[i], xs[i] * 2.0)  # split right
+    assert max(batches) > 1, f"no coalescing happened: {batches}"
+    assert sum(batches) == 8
+
+
+def test_batcher_load_shed_and_deadline():
+    gate = threading.Event()
+
+    def runner(kind, node, data):
+        gate.wait(timeout=5)
+        return data
+
+    b = serve.MicroBatcher(runner, max_batch_size=4, batch_timeout_ms=0,
+                           queue_limit=2)
+    x = np.zeros((1, 4), np.float32)
+    results = []
+    t1 = threading.Thread(target=lambda: results.append(b.submit(x)))
+    t1.start()
+    time.sleep(0.05)  # worker picked req 1 up and is blocked in runner
+    # a request whose deadline passes while queued is expired, not run
+    err = []
+
+    def late():
+        try:
+            b.submit(x, deadline_ms=10)
+        except serve.DeadlineError as e:
+            err.append(e)
+
+    t2 = threading.Thread(target=late)
+    t2.start()
+    time.sleep(0.05)
+    # queue now holds the deadline request; fill to the limit, then shed
+    t3 = threading.Thread(target=lambda: b.submit(x))
+    t3.start()
+    time.sleep(0.05)
+    with pytest.raises(serve.OverloadError):
+        b.submit(x)
+    gate.set()
+    t1.join(5), t2.join(5), t3.join(5)
+    b.close()
+    assert len(err) == 1, "queued request should have expired"
+    assert len(results) == 1
+
+
+def test_batcher_close_fails_pending():
+    gate = threading.Event()
+    b = serve.MicroBatcher(lambda k, n, d: (gate.wait(5), d)[1],
+                           max_batch_size=4, batch_timeout_ms=0,
+                           queue_limit=8)
+    x = np.zeros((1, 4), np.float32)
+    threading.Thread(target=lambda: b.submit(x)).start()
+    time.sleep(0.05)
+    err = []
+
+    def pending():
+        try:
+            b.submit(x)
+        except serve.ClosedError as e:
+            err.append(e)
+
+    t = threading.Thread(target=pending)
+    t.start()
+    time.sleep(0.05)
+    gate.set()
+    b.close()
+    t.join(5)
+    assert len(err) == 1
+    with pytest.raises(serve.ClosedError):
+        b.submit(x)
+
+
+# ----------------------------------------------------------------------
+# engine
+def test_engine_concurrent_submit_identical_to_sequential():
+    """N threads through the micro-batcher get byte-identical results to
+    sequential predict — coalescing and bucket padding must not change a
+    single bit of any row."""
+    tr = make_trainer()
+    eng = serve.Engine(trainer=tr, max_batch_size=64, batch_timeout_ms=20,
+                       queue_limit=256)
+    sizes = [1, 3, 7, 5, 2, 1, 4, 6, 3, 1, 8, 2, 7, 5, 3, 2]
+    datas = [toy_rows(n, seed=i) for i, n in enumerate(sizes)]
+    seq = [eng.predict(d) for d in datas]  # warm + sequential reference
+    outs = [None] * len(sizes)
+
+    def go(i):
+        outs[i] = eng.submit(datas[i])
+
+    threads = [threading.Thread(target=go, args=(i,))
+               for i in range(len(sizes))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, (a, b) in enumerate(zip(seq, outs)):
+        np.testing.assert_array_equal(a, b, err_msg=f"request {i}")
+    st = eng.snapshot_stats()
+    assert st["requests"] == 2 * len(sizes)
+    assert st["ok"] == 2 * len(sizes)
+    assert st["latency_ms"]["count"] == 2 * len(sizes)
+    eng.close()
+
+
+def test_engine_validates_input_shapes():
+    eng = serve.Engine(trainer=make_trainer(), max_batch_size=8,
+                       batch_timeout_ms=0)
+    with pytest.raises(ValueError, match="row shape"):
+        eng.predict(np.zeros((2, 5), np.float32))
+    with pytest.raises(ValueError, match="kind"):
+        eng.submit(toy_rows(1), kind="nope")
+    with pytest.raises(ValueError, match="node"):
+        eng.submit(toy_rows(1), kind="extract")
+    # a single flat instance is promoted to a 1-row batch
+    assert eng.predict(toy_rows(1)[0]).shape == (1,)
+    # one request may not exceed max_batch_size rows (it would bypass
+    # the queue bound and pad to an even larger bucket)
+    with pytest.raises(ValueError, match="max_batch_size"):
+        eng.predict(toy_rows(9))
+    eng.close()
+    with pytest.raises(serve.ClosedError):
+        eng.predict(toy_rows(1))
+
+
+def _save_round(tr, model_dir, round_):
+    os.makedirs(model_dir, exist_ok=True)
+    tr.round = round_
+    tr.save_model(os.path.join(model_dir, f"{round_:04d}.model"))
+
+
+def test_engine_loads_newest_valid_and_hot_reloads(tmp_path):
+    mdir = str(tmp_path / "models")
+    tr1 = make_trainer(seed=1)
+    _save_round(tr1, mdir, 1)
+    eng = serve.Engine(cfg=MLP_CFG, model_dir=mdir, max_batch_size=32,
+                       batch_timeout_ms=0)
+    assert eng.round == 1
+    x = toy_rows(8)
+    p1 = eng.submit(x, kind="scores")
+    assert not eng.reload_if_newer()  # nothing newer yet
+
+    tr2 = make_trainer(seed=2)  # different init → different scores
+    _save_round(tr2, mdir, 2)
+    # corrupt newer round must be skipped, not served
+    with open(os.path.join(mdir, "0003.model"), "wb") as f:
+        f.write(b"garbage not a model")
+    assert eng.reload_if_newer()
+    assert eng.round == 2
+    assert eng.healthz()["round"] == 2
+    p2 = eng.submit(x, kind="scores")
+    assert not np.array_equal(p1, p2), "reload did not change the model"
+    ref = serve.ShapeBucketCache(tr2, 32).scores(x)
+    np.testing.assert_array_equal(p2, ref)
+    eng.close()
+
+
+def test_engine_reload_warms_served_buckets(tmp_path):
+    """The post-swap model must already be compiled for every bucket in
+    service — requests after a hot reload never stall on XLA compiles."""
+    mdir = str(tmp_path / "models")
+    _save_round(make_trainer(seed=1), mdir, 1)
+    eng = serve.Engine(cfg=MLP_CFG, model_dir=mdir, max_batch_size=32,
+                       batch_timeout_ms=0)
+    eng.predict(toy_rows(3))   # bucket 4
+    eng.predict(toy_rows(20))  # bucket 32
+    _save_round(make_trainer(seed=2), mdir, 2)
+    assert eng.reload_if_newer()
+    calls = count_traces(eng.trainer)
+    eng.predict(toy_rows(3))
+    eng.predict(toy_rows(20))
+    assert len(calls) == 0, "served buckets were not pre-warmed on reload"
+    eng.close()
+
+
+def test_engine_rejects_invalid_model_in(tmp_path):
+    bad = str(tmp_path / "bad.model")
+    with open(bad, "wb") as f:
+        f.write(b"garbage")
+    with pytest.raises(serve.ModelLoadError):
+        serve.Engine(cfg=MLP_CFG, model_in=bad)
+    with pytest.raises(serve.ModelLoadError):
+        serve.Engine(cfg=MLP_CFG, model_dir=str(tmp_path / "empty"))
+
+
+# ----------------------------------------------------------------------
+# HTTP front-end
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return json.loads(r.read())
+
+
+def _post(port, path, obj):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(obj).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_http_endpoints_inprocess():
+    tr = make_trainer()
+    eng = serve.Engine(trainer=tr, max_batch_size=32, batch_timeout_ms=1)
+    httpd = serve.make_server(eng, port=0)
+    port = httpd.server_port
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        h = _get(port, "/healthz")
+        assert h["status"] == "ok" and "net_fp" in h
+        x = toy_rows(5)
+        got = np.asarray(_post(port, "/predict", {"data": x.tolist()})["pred"])
+        np.testing.assert_array_equal(got, eng.predict(x))
+        raw = np.asarray(
+            _post(port, "/predict", {"data": x.tolist(), "raw": True})
+            ["scores"]
+        )
+        assert raw.shape == (5, 4)
+        feats = np.asarray(
+            _post(port, "/extract", {"data": x.tolist(), "node": "fc1"})
+            ["features"]
+        )
+        assert feats.shape[0] == 5
+        st = _get(port, "/statsz")
+        for key in ("requests", "ok", "batch_fill_ratio", "latency_ms",
+                    "compile_cache", "queue_depth"):
+            assert key in st, key
+        # error mapping: 404 route, 400 malformed / bad shape
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(port, "/nope")
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(port, "/predict", {"wrong": 1})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(port, "/predict", {"data": [[1.0, 2.0]]})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(port, "/extract", {"data": x.tolist()})
+        assert e.value.code == 400
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# CLI task=serve smoke (ephemeral port, clean shutdown)
+SERVE_CONF = """
+data = train
+iter = synthetic
+  nsample = 64
+  input_shape = 1,1,16
+  nclass = 4
+iter = end
+
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+1:a1] = relu:a1
+layer[a1->out] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,16
+batch_size = 32
+dev = cpu
+eta = 0.1
+num_round = 1
+save_model = 1
+eval_train = 1
+metric = error
+model_dir = MODELDIR
+print_step = 0
+"""
+
+
+def test_cli_serve_smoke(tmp_path):
+    from conftest import run_cli
+
+    conf = tmp_path / "serve.conf"
+    conf.write_text(SERVE_CONF.replace("MODELDIR", str(tmp_path / "models")))
+    r = run_cli([str(conf)], str(tmp_path))
+    assert r.returncode == 0, r.stderr + r.stdout
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cxxnet_tpu", str(conf), "task=serve",
+         "serve_port=0", "silent=1", "batch_timeout_ms=1"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=str(tmp_path), env=env,
+    )
+    lines = []
+
+    def _pump():
+        for line in proc.stdout:
+            lines.append(line)
+
+    reader = threading.Thread(target=_pump, daemon=True)
+    reader.start()
+    try:
+        port = None
+        deadline = time.time() + 120
+        while time.time() < deadline and port is None:
+            for line in list(lines):
+                if "serving model round" in line and "http://" in line:
+                    port = int(line.rsplit(":", 1)[1])
+                    break
+            if proc.poll() is not None:
+                raise AssertionError("server died:\n" + "".join(lines))
+            time.sleep(0.1)
+        assert port is not None, "server never reported its port:\n" + (
+            "".join(lines)
+        )
+        h = _get(port, "/healthz")
+        assert h["status"] == "ok" and h["round"] == 1
+        x = toy_rows(3)
+        pred = _post(port, "/predict", {"data": x.tolist()})["pred"]
+        assert len(pred) == 3
+        st = _get(port, "/statsz")
+        assert st["ok"] >= 1
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+    reader.join(timeout=5)
+    out = "".join(lines)
+    assert proc.returncode == 0, out
+    assert "shutdown complete" in out
+
+
+# ----------------------------------------------------------------------
+# serving metrics
+def test_percentile_tracker():
+    from cxxnet_tpu.utils.profiler import PercentileTracker
+
+    t = PercentileTracker(window=100)
+    assert t.percentiles() == {} and t.summary() == {"count": 0}
+    for v in range(1, 101):
+        t.add(v / 1000.0)
+    s = t.summary(scale=1e3)
+    assert s["count"] == 100
+    assert 45 <= s["p50"] <= 55
+    assert 90 <= s["p95"] <= 99
+    assert 95 <= s["p99"] <= 100
+    for v in range(200):  # window slides: old samples age out
+        t.add(1.0)
+    assert t.percentiles()["p50"] == 1.0
+    assert t.count == 300
+
+
+def test_serving_stats_fill_ratio():
+    from cxxnet_tpu.serve.metrics import ServingStats
+
+    s = ServingStats()
+    s.record_batch(rows=6, bucket_rows=8)
+    s.record_batch(rows=8, bucket_rows=8)
+    snap = s.snapshot()
+    assert snap["batches"] == 2
+    assert snap["batch_fill_ratio"] == pytest.approx(14 / 16)
+    assert snap["rows_per_batch"] == pytest.approx(7.0)
+
+
+@pytest.mark.slow
+def test_batched_throughput_beats_sequential():
+    """Acceptance: micro-batched throughput at concurrency 16 >= 3x the
+    sequential single-request rate on the synthetic MLP."""
+    tr = make_trainer()
+    eng = serve.Engine(trainer=tr, max_batch_size=64, batch_timeout_ms=5,
+                       queue_limit=1024)
+    x = toy_rows(1)
+    for _ in range(4):
+        eng.predict(x)  # warm bucket 1 + bucket paths
+
+    n_seq = 50
+    t0 = time.perf_counter()
+    for _ in range(n_seq):
+        eng.predict(x)
+    seq_rate = n_seq / (time.perf_counter() - t0)
+
+    n_each, n_thread = 50, 16
+
+    def go():
+        for _ in range(n_each):
+            eng.predict(x)
+
+    threads = [threading.Thread(target=go) for _ in range(n_thread)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    conc_rate = n_each * n_thread / (time.perf_counter() - t0)
+    eng.close()
+    assert conc_rate >= 3 * seq_rate, (
+        f"batched {conc_rate:.0f} req/s vs sequential {seq_rate:.0f} req/s"
+    )

@@ -191,6 +191,49 @@ eta = 0.1
         bare.load_model(str(tmp_path / "m.model"))
 
 
+def test_predict_ndarray_trims_to_request_rows():
+    """Raw-array predict/extract must return exactly the requested rows
+    (bucket padding trimmed) and match the full-batch rows bit-exactly."""
+    net = Net(dev="cpu", cfg=MLP_CFG)
+    net.init_model()
+    x, _ = toy_xy(32)
+    full = net.predict(x)
+    full_feat = net.extract(x, "fc1")
+    for n in (1, 3, 7, 20):
+        pred = net.predict(x[:n])
+        assert pred.shape == (n,)
+        np.testing.assert_array_equal(pred, full[:n])
+        feat = net.extract(x[:n], "fc1")
+        assert feat.shape[0] == n
+        np.testing.assert_array_equal(feat, full_feat[:n])
+
+
+def test_predict_ndarray_bucket_cache_no_rejit():
+    """Repeated odd-sized raw-array calls hit the shape-bucket cache
+    instead of re-tracing a fresh XLA program per size (forward runs
+    only at trace time, so its call count == compile count)."""
+    net = Net(dev="cpu", cfg=MLP_CFG)
+    net.init_model()
+    x, _ = toy_xy(64)
+    calls = []
+    orig = net.trainer.net.forward
+
+    def counting(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    net.trainer.net.forward = counting
+    sizes = [1, 3, 7, 5, 3, 1, 7, 6, 2, 5]
+    for n in sizes:
+        assert net.predict(x[:n]).shape == (n,)
+    # buckets {1, 2, 4, 8}: at most one trace per bucket, none repeated
+    assert len(calls) <= len({1, 2, 4, 8})
+    warm = len(calls)
+    for n in sizes:
+        net.predict(x[:n])
+    assert len(calls) == warm, "odd-sized predict re-jitted after warmup"
+
+
 def test_net_update_scan_trains_like_update():
     # [K, B, ...] stack path: 4 chunks of 16 per epoch as one dispatch
     net = Net(dev="cpu", cfg=MLP_CFG)

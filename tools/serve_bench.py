@@ -1,0 +1,203 @@
+"""Serving latency/throughput benchmark (closed-loop + open-loop).
+
+Drives the serving engine (``cxxnet_tpu/serve``) in-process over a
+synthetic MLP — no HTTP in the way, so the numbers isolate the
+micro-batcher + compiled-predict-cache data path:
+
+* **closed-loop**: C worker threads, each firing its next request the
+  moment the previous one returns — measures saturated throughput and
+  the batching speedup over a single sequential client (the ISSUE-2
+  acceptance bar: >= 3x at concurrency 16);
+* **open-loop**: requests arrive on a fixed-rate clock regardless of
+  completions (the honest way to measure latency under load — a
+  closed loop self-throttles and hides queueing collapse); reports
+  achieved rate and p50/p95/p99 latency at each offered rate.
+
+Prints one JSON document on stdout.
+
+Usage::
+
+    python tools/serve_bench.py [--model mnist_mlp] [--dev cpu]
+        [--concurrency 16] [--requests 200] [--rows 1]
+        [--max-batch 64] [--timeout-ms 2] [--open-rates 100,500]
+        [--open-duration 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_engine(args):
+    from cxxnet_tpu import config as cfgmod
+    from cxxnet_tpu import serve
+    from cxxnet_tpu.models import MODEL_BUILDERS
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    conf = MODEL_BUILDERS[args.model](
+        batch_size=args.max_batch, dev=args.dev
+    )
+    tr = NetTrainer()
+    tr.set_params(cfgmod.parse_pairs(conf))
+    tr.init_model()
+    eng = serve.Engine(
+        trainer=tr,
+        max_batch_size=args.max_batch,
+        batch_timeout_ms=args.timeout_ms,
+        queue_limit=max(1024, 4 * args.concurrency),
+    )
+    row = tuple(tr.net.input_node_shape(1)[1:])
+    x = np.random.RandomState(0).rand(args.rows, *row).astype(np.float32)
+    return eng, x
+
+
+def closed_loop(eng, x, concurrency, requests):
+    """Each of ``concurrency`` threads runs ``requests`` back-to-back."""
+    lat = []
+    lock = threading.Lock()
+
+    def worker():
+        mine = []
+        for _ in range(requests):
+            t0 = time.perf_counter()
+            eng.predict(x)
+            mine.append(time.perf_counter() - t0)
+        with lock:
+            lat.extend(mine)
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    lat.sort()
+    n = len(lat)
+    return {
+        "concurrency": concurrency,
+        "requests": n,
+        "wall_sec": wall,
+        "req_per_sec": n / wall,
+        "rows_per_sec": n * x.shape[0] / wall,
+        "latency_ms": {
+            "p50": lat[n // 2] * 1e3,
+            "p95": lat[min(n - 1, int(n * 0.95))] * 1e3,
+            "p99": lat[min(n - 1, int(n * 0.99))] * 1e3,
+        },
+    }
+
+
+def open_loop(eng, x, rate, duration):
+    """Fixed-rate arrivals for ``duration`` seconds; late completions
+    still count — achieved < offered means the server cannot keep up."""
+    from cxxnet_tpu import serve as _serve
+
+    lat, errs = [], [0]
+    lock = threading.Lock()
+    threads = []
+
+    def fire():
+        t0 = time.perf_counter()
+        try:
+            eng.predict(x)
+        except _serve.ServeError:
+            with lock:
+                errs[0] += 1
+            return
+        dt = time.perf_counter() - t0
+        with lock:
+            lat.append(dt)
+
+    period = 1.0 / rate
+    t_start = time.perf_counter()
+    k = 0
+    while True:
+        t_next = t_start + k * period
+        now = time.perf_counter()
+        if now - t_start >= duration:
+            break
+        if t_next > now:
+            time.sleep(t_next - now)
+        th = threading.Thread(target=fire)
+        th.start()
+        threads.append(th)
+        k += 1
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t_start
+    lat.sort()
+    n = len(lat)
+    out = {
+        "offered_req_per_sec": rate,
+        "sent": k,
+        "completed": n,
+        "shed_or_error": errs[0],
+        "achieved_req_per_sec": n / wall,
+    }
+    if n:
+        out["latency_ms"] = {
+            "p50": lat[n // 2] * 1e3,
+            "p95": lat[min(n - 1, int(n * 0.95))] * 1e3,
+            "p99": lat[min(n - 1, int(n * 0.99))] * 1e3,
+        }
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", default="mnist_mlp")
+    ap.add_argument("--dev", default=os.environ.get("BENCH_DEV", "cpu"))
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--requests", type=int, default=200,
+                    help="closed-loop requests per thread")
+    ap.add_argument("--rows", type=int, default=1,
+                    help="rows per request")
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--timeout-ms", type=float, default=2.0)
+    ap.add_argument("--open-rates", default="",
+                    help="comma-separated offered req/s for open-loop runs")
+    ap.add_argument("--open-duration", type=float, default=3.0)
+    args = ap.parse_args(argv)
+
+    eng, x = build_engine(args)
+    for _ in range(8):
+        eng.predict(x)  # warm the bucket + compile
+
+    seq = closed_loop(eng, x, concurrency=1, requests=args.requests)
+    conc = closed_loop(eng, x, concurrency=args.concurrency,
+                       requests=args.requests)
+    result = {
+        "model": args.model,
+        "dev": args.dev,
+        "rows_per_request": args.rows,
+        "max_batch_size": args.max_batch,
+        "batch_timeout_ms": args.timeout_ms,
+        "closed_loop": {
+            "sequential": seq,
+            "concurrent": conc,
+            "speedup": conc["req_per_sec"] / seq["req_per_sec"],
+        },
+    }
+    rates = [float(r) for r in args.open_rates.split(",") if r.strip()]
+    if rates:
+        result["open_loop"] = [
+            open_loop(eng, x, rate, args.open_duration) for rate in rates
+        ]
+    result["serving_stats"] = eng.snapshot_stats()
+    eng.close()
+    print(json.dumps(result, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
