@@ -69,6 +69,10 @@ class LearnTask:
         self.queue_limit = 128
         self.serve_reload_period = 0.0  # seconds; 0 disables hot reload
         self.serve_deadline_ms = 0.0  # default per-request deadline
+        self.drain_timeout_s = 5.0  # SIGTERM: flush in-flight this long
+        self.reload_breaker_threshold = 3
+        self.reload_breaker_cooldown_s = 30.0
+        self.watchdog_timeout_s = 600.0  # serve batcher stall guard
         self.cfg: List[tuple] = []
 
     # ------------------------------------------------------------------
@@ -143,6 +147,14 @@ class LearnTask:
             self.serve_reload_period = float(val)
         elif name == "serve_deadline_ms":
             self.serve_deadline_ms = float(val)
+        elif name == "drain_timeout_s":
+            self.drain_timeout_s = float(val)
+        elif name == "reload_breaker_threshold":
+            self.reload_breaker_threshold = int(val)
+        elif name == "reload_breaker_cooldown_s":
+            self.reload_breaker_cooldown_s = float(val)
+        elif name == "watchdog_timeout_s":
+            self.watchdog_timeout_s = float(val)
         self.cfg.append((name, val))
 
     # ------------------------------------------------------------------
@@ -160,6 +172,11 @@ class LearnTask:
         from .parallel import maybe_init_distributed
 
         maybe_init_distributed(self.cfg)
+        # arm the chaos harness (no-op without fault_inject keys); the
+        # instrumented sites live in io/, utils/checkpoint.py and serve/
+        from .utils import faults
+
+        faults.configure(self.cfg)
         if self.task not in ("train", "finetune", "pred", "pred_raw",
                              "extract", "generate", "summary", "serve"):
             raise ValueError(f"unknown task {self.task!r}")
@@ -272,12 +289,15 @@ class LearnTask:
         return round_, path, None
 
     def _load_trainer(self, path: str) -> NetTrainer:
-        """Fresh trainer with ``path`` loaded, retrying transient I/O."""
-        from .utils import checkpoint as ckpt
+        """Fresh trainer with ``path`` loaded, retrying transient I/O
+        under the unified :class:`RetryPolicy` (``retry_*`` config keys
+        — the same policy the serving engine uses)."""
+        from .utils.faults import RetryPolicy
 
         tr = self._create_trainer()
-        ckpt.retry_io(lambda: tr.load_model(path),
-                      what=f"loading {path}", silent=bool(self.silent))
+        RetryPolicy.from_cfg(self.cfg).run(
+            lambda: tr.load_model(path),
+            what=f"loading {path}", silent=bool(self.silent))
         return tr
 
     def _sync_latest_model(self) -> bool:
@@ -789,9 +809,10 @@ class LearnTask:
         ``model_dir``) into a :class:`~cxxnet_tpu.serve.Engine` and
         serves ``/predict`` / ``/extract`` / ``/healthz`` / ``/statsz``
         on ``serve_host:serve_port`` (``serve_port = 0`` picks an
-        ephemeral port, printed on startup).  SIGTERM/SIGINT shut down
-        cleanly: in-flight requests finish, queued ones are failed with
-        503, then the process exits."""
+        ephemeral port, printed on startup).  SIGTERM/SIGINT drain
+        gracefully: the server stops accepting, in-flight requests get
+        up to ``drain_timeout_s`` to finish, queued ones are failed
+        with 503, then the process exits 0."""
         import signal as _signal
         import threading
 
@@ -809,6 +830,9 @@ class LearnTask:
             queue_limit=self.queue_limit,
             default_deadline_ms=self.serve_deadline_ms,
             silent=bool(self.silent),
+            reload_breaker_threshold=self.reload_breaker_threshold,
+            reload_breaker_cooldown_s=self.reload_breaker_cooldown_s,
+            watchdog_timeout_s=self.watchdog_timeout_s,
         )
         httpd_box = {}
 
@@ -821,7 +845,8 @@ class LearnTask:
                   flush=True)
 
         def _stop(signum, frame):
-            print("serve: shutdown requested", flush=True)
+            print(f"serve: shutdown requested, draining in-flight "
+                  f"requests (up to {self.drain_timeout_s:g}s)", flush=True)
             h = httpd_box.get("httpd")
             if h is not None:
                 # shutdown() blocks until serve_forever returns — must
@@ -836,6 +861,7 @@ class LearnTask:
                 host=self.serve_host,
                 port=self.serve_port,
                 reload_period_s=self.serve_reload_period,
+                drain_timeout_s=self.drain_timeout_s,
                 verbose=not self.silent,
                 ready_fn=_ready,
             )

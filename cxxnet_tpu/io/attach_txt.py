@@ -73,3 +73,6 @@ class AttachTxtIterator(DataIter):
     def value(self) -> DataBatch:
         assert self._cur is not None
         return self._cur
+
+    def close(self) -> None:
+        self.base.close()

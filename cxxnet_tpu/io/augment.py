@@ -161,6 +161,9 @@ class AugmentIterator(InstIterator):
         assert self._out is not None
         return self._out
 
+    def close(self) -> None:
+        self.base.close()
+
     # ------------------------------------------------------------------
     def _affine(self, img: np.ndarray) -> np.ndarray:
         """Rotation/shear/scale/aspect as one warp (image_augmenter:75-123)."""

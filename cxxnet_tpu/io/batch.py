@@ -57,6 +57,9 @@ class InstIterator:
     def value(self) -> DataInst:
         raise NotImplementedError
 
+    def close(self) -> None:
+        """Release resources; wrappers delegate down the chain."""
+
 
 class BatchAdaptIterator(DataIter):
     def __init__(self, base: InstIterator) -> None:
@@ -162,3 +165,6 @@ class BatchAdaptIterator(DataIter):
     def value(self) -> DataBatch:
         assert self._head == 0 and self._out is not None, "call next() first"
         return self._out
+
+    def close(self) -> None:
+        self.base.close()

@@ -2,13 +2,27 @@
 
 Parity: ``/root/reference/src/io/iter_csv-inl.hpp`` — each row is
 ``label_width`` labels followed by ``prod(input_shape)`` dense features,
-comma-separated; ``has_header`` skips the first line.
+comma-separated; ``has_header`` skips the first line, ``#`` starts a
+comment (``np.loadtxt`` conventions).
+
+Resilience (doc/robustness.md): the file read retries transient
+``OSError`` under the unified :class:`~cxxnet_tpu.utils.faults.
+RetryPolicy` (all ``retry_*`` keys); with ``max_bad_records > 0`` rows
+that fail to parse (bad floats, wrong column count) are skipped and
+quarantined — exceeding the budget aborts with a summary.  The default
+``max_bad_records = 0`` keeps the strict legacy behavior AND the
+``np.loadtxt`` C fast path: the first bad row aborts, exactly as
+before.
 """
 
 from __future__ import annotations
 
+from typing import List, Tuple
+
 import numpy as np
 
+from ..utils import faults
+from ..utils.faults import BadRecordBudget, RetryPolicy
 from .batch import DataInst, InstIterator
 
 
@@ -24,6 +38,10 @@ class CSVIterator(InstIterator):
         self.has_header = 0
         self.silent = 0
         self.input_shape = (1, 1, 0)
+        self.max_bad_records = 0
+        self.quarantine_dir = ""
+        self._retry_cfg: List[Tuple[str, str]] = []
+        self._budget: BadRecordBudget | None = None
         self._rows: np.ndarray | None = None
         self._pos = 0
 
@@ -43,24 +61,90 @@ class CSVIterator(InstIterator):
             self.dist_num_worker = int(val)
         elif name == "dist_worker_rank":
             self.dist_worker_rank = int(val)
+        elif name == "max_bad_records":
+            self.max_bad_records = int(val)
+        elif name == "quarantine_dir":
+            self.quarantine_dir = val
+        elif name in RetryPolicy.CONFIG_KEYS:
+            self._retry_cfg.append((name, val))
 
-    def init(self):
-        nfeat = self.input_shape[0] * self.input_shape[1] * self.input_shape[2]
-        if nfeat <= 0:
-            raise ValueError("CSVIterator: input_shape must be set")
-        rows = np.loadtxt(
-            self.filename,
-            delimiter=",",
-            skiprows=1 if self.has_header else 0,
-            dtype=np.float32,
-            ndmin=2,
-        )
-        want = self.label_width + nfeat
+    def _retry(self) -> RetryPolicy:
+        return RetryPolicy.from_cfg(self._retry_cfg)
+
+    def _load_strict(self, want: int) -> np.ndarray:
+        """The pre-budget reader, verbatim semantics: ``np.loadtxt``'s C
+        tokenizer, first bad row aborts (used when no budget is set and
+        no fault is armed — the overwhelmingly common configuration)."""
+        def _read():
+            faults.fault_point("csv.read")
+            return np.loadtxt(
+                self.filename,
+                delimiter=",",
+                skiprows=1 if self.has_header else 0,
+                dtype=np.float32,
+                ndmin=2,
+            )
+
+        rows = self._retry().run(_read, what=f"reading {self.filename}",
+                                 silent=bool(self.silent))
         if rows.shape[1] != want:
             raise ValueError(
                 f"CSVIterator: row has {rows.shape[1]} columns, expected "
                 f"{want} (label_width + input size)"
             )
+        return rows
+
+    def _load_tolerant(self, want: int) -> np.ndarray:
+        """Per-row parse with skip-and-quarantine under the budget."""
+        lines = faults.retried_read_lines(
+            self.filename, "csv.read", self._retry_cfg,
+            silent=bool(self.silent))
+        parsed: List[np.ndarray] = []
+        for lineno, line in enumerate(lines, start=1):
+            if self.has_header and lineno == 1:
+                continue
+            # np.loadtxt parity: '#' starts a comment; comment-only and
+            # blank lines are not records
+            line = line.split("#", 1)[0]
+            if not line.strip():
+                continue
+            line = faults.fault_point("csv.row", line)
+            try:
+                row = np.asarray(
+                    [float(t) for t in line.strip().split(",")], np.float32
+                )
+                if row.shape[0] != want:
+                    raise ValueError(
+                        f"row has {row.shape[0]} columns, expected {want} "
+                        f"(label_width + input size)"
+                    )
+            except ValueError as e:
+                self._budget.record(self.filename, f"line{lineno}", e)
+                continue
+            parsed.append(row)
+        if not parsed:
+            raise ValueError(f"CSVIterator: {self.filename} has no usable rows")
+        return np.stack(parsed)
+
+    def init(self):
+        nfeat = self.input_shape[0] * self.input_shape[1] * self.input_shape[2]
+        if nfeat <= 0:
+            raise ValueError("CSVIterator: input_shape must be set")
+        want = self.label_width + nfeat
+        self._budget = BadRecordBudget(
+            self.max_bad_records, what="csv", silent=bool(self.silent),
+            quarantine_dir=self.quarantine_dir or None,
+        )
+        # the loadtxt fast path is bypassed only when per-ROW semantics
+        # are needed: a skip budget, or a corrupt fault on csv.row.
+        # csv.read faults (I/O error, latency) deliberately hit the
+        # strict path too — the chaos harness must exercise the
+        # production default reader, not just the tolerant one.
+        if (self.max_bad_records == 0
+                and not faults.injector().armed("csv.row")):
+            rows = self._load_strict(want)
+        else:
+            rows = self._load_tolerant(want)
         if self.dist_num_worker > 1:
             from .data import shard_rows
 
@@ -70,6 +154,8 @@ class CSVIterator(InstIterator):
         self._rows = rows
         if not self.silent:
             print(f"CSVIterator: filename={self.filename}, {len(rows)} rows")
+            if self._budget.epoch_count:
+                print(self._budget.summary(), flush=True)
 
     def before_first(self):
         self._pos = 0

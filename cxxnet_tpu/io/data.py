@@ -107,6 +107,11 @@ class DataIter:
     def value(self) -> DataBatch:
         raise NotImplementedError
 
+    def close(self) -> None:
+        """Release resources (threads, native readers).  Idempotent;
+        wrappers delegate down the chain.  Base iterators holding no
+        resources inherit this no-op."""
+
     # python sugar
     def __iter__(self):
         self.before_first()

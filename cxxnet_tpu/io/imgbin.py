@@ -41,6 +41,8 @@ from typing import IO, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils import faults
+from ..utils.faults import BadRecordBudget
 from .batch import DataInst, InstIterator
 
 PAGE_MAGIC = 0x43584250  # "CXBP"
@@ -264,6 +266,9 @@ class ImageBinIterator(InstIterator):
         self._native_pos = 0
         self._epoch_cap = 0
         self._served = 0
+        self.max_bad_records = 0  # skip budget per epoch; 0 = strict
+        self.quarantine_dir = ""
+        self._budget: Optional[BadRecordBudget] = None
 
     def set_param(self, name, val):
         if name in ("image_bin", "image_bin_x"):
@@ -288,6 +293,10 @@ class ImageBinIterator(InstIterator):
             self.native_decoder = int(val)
         elif name == "decode_thread":
             self.decode_thread = int(val)
+        elif name == "max_bad_records":
+            self.max_bad_records = int(val)
+        elif name == "quarantine_dir":
+            self.quarantine_dir = val
 
     def init(self):
         # PS_RANK env parity: the reference applies it UNCONDITIONALLY
@@ -383,6 +392,16 @@ class ImageBinIterator(InstIterator):
                 )
             shards = mine
         self._shards = shards
+        if self.native_decoder and not self._raw and self.max_bad_records > 0:
+            # skip-and-quarantine needs record-level error isolation,
+            # which only the pure-Python reader provides — the native
+            # reader pool decodes ahead across threads and a corrupt
+            # record would abort it wholesale.  A set budget therefore
+            # forces the Python path, uniformly on every machine.
+            self.native_decoder = 0
+            if not self.silent:
+                print("imgbin: max_bad_records set; using the pure-Python "
+                      "reader for skip-and-quarantine", flush=True)
         if self.native_decoder and not self._raw:
             try:
                 from .native import NativePageReader, available
@@ -401,6 +420,11 @@ class ImageBinIterator(InstIterator):
                 warnings.warn(
                     f"imgbin: native decoder disabled, pure-Python fallback: {e}"
                 )
+        self._budget = BadRecordBudget(
+            self.max_bad_records, what="imgbin",
+            silent=bool(self.silent),
+            quarantine_dir=self.quarantine_dir or None,
+        )
         self.before_first()
 
     def _load_labels(self, lst_path: str) -> List[Tuple[int, np.ndarray]]:
@@ -414,6 +438,8 @@ class ImageBinIterator(InstIterator):
 
     def before_first(self):
         self._served = 0
+        if self._budget is not None:
+            self._budget.start_epoch()
         if self._native is not None:
             self._native.reset()
             self._native_pos = 0
@@ -422,18 +448,47 @@ class ImageBinIterator(InstIterator):
         self._open_shard(0)
 
     def _open_shard(self, k: int) -> None:
-        if k < len(self._shards):
+        while k < len(self._shards):
             bin_path, lst_path = self._shards[k]
-            self._records = self._load_labels(lst_path)
-            self._page_iter = iter_bin_pages(bin_path)
+            try:
+                records = self._load_labels(lst_path)
+            except (OSError, ValueError) as e:
+                if self._budget is None:
+                    raise
+                self._budget.record(bin_path, "open", e,
+                                    note="whole shard skipped")
+                k += 1
+                continue
+            try:
+                page_iter = iter_bin_pages(bin_path)
+            except (OSError, ValueError) as e:
+                # shard unreadable at open time (bad page format,
+                # missing file): quarantine the whole shard — with its
+                # record count, so the loss is never under-reported —
+                # or abort via the budget when skipping is not allowed
+                if self._budget is None:
+                    raise
+                self._budget.record(
+                    bin_path, "open", e,
+                    note=f"whole shard skipped, {len(records)} record(s) "
+                         "dropped")
+                k += 1
+                continue
+            self._records = records
+            self._page_iter = page_iter
             self._page, self._page_pos, self._rec_pos = [], 0, 0
-        else:
-            self._page_iter = None
+            self._shard_pos = k
+            return
+        self._shard_pos = k
+        self._page_iter = None
 
     def next(self) -> bool:
         if self._epoch_cap and self._served >= self._epoch_cap:
             return False
         if not self._next_inner():
+            if (self._budget is not None and self._budget.epoch_count
+                    and not self.silent):
+                print(self._budget.summary(), flush=True)
             return False
         self._served += 1
         return True
@@ -458,21 +513,45 @@ class ImageBinIterator(InstIterator):
         while True:
             if self._page_iter is None:
                 return False
+            bin_path = self._shards[self._shard_pos][0]
             if self._page_pos < len(self._page):
                 blob = self._page[self._page_pos]
                 self._page_pos += 1
-                idx, labels = self._records[self._rec_pos]
+                rec = self._rec_pos
+                idx, labels = self._records[rec]
                 self._rec_pos += 1
-                if self._raw:
-                    data = self._decode_raw(blob)
-                else:
-                    data = decode_image(blob)
+                try:
+                    blob = faults.fault_point("imgbin.record", blob)
+                    if self._raw:
+                        data = self._decode_raw(blob)
+                    else:
+                        data = decode_image(blob)
+                except Exception as e:  # noqa: BLE001 - untrusted bytes
+                    # corrupt record: quarantine + skip; BadDataError
+                    # aborts with a summary once the budget is exhausted
+                    self._budget.record(bin_path, rec, e)
+                    continue
                 self._out = DataInst(idx, data, labels)
                 return True
             try:
+                faults.fault_point("imgbin.page")
                 self._page = next(self._page_iter)
                 self._page_pos = 0
             except StopIteration:
+                self._shard_pos += 1
+                self._open_shard(self._shard_pos)
+                if self._shard_pos >= len(self._shards):
+                    return False
+            except (OSError, ValueError) as e:
+                # corrupt/unreadable page: past this point the shard's
+                # blob↔label alignment is unrecoverable, so quarantine
+                # the page — reporting the trailing records it drops —
+                # and resume at the next shard boundary
+                dropped = len(self._records) - self._rec_pos
+                self._budget.record(
+                    bin_path, f"page@rec{self._rec_pos}", e,
+                    note=f"{dropped} trailing record(s) of the shard "
+                         "dropped")
                 self._shard_pos += 1
                 self._open_shard(self._shard_pos)
                 if self._shard_pos >= len(self._shards):
@@ -486,6 +565,11 @@ class ImageBinIterator(InstIterator):
     def value(self) -> DataInst:
         assert self._out is not None
         return self._out
+
+    def close(self) -> None:
+        if self._native is not None:
+            self._native.close()  # stop reader/decode threads
+            self._native = None
 
 
 def encode_raw(img: np.ndarray) -> bytes:

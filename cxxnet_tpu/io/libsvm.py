@@ -19,6 +19,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..utils import faults
+from ..utils.faults import BadRecordBudget, RetryPolicy
 from .data import DataBatch, DataIter
 
 
@@ -32,6 +34,11 @@ class LibSVMIterator(DataIter):
         self.label_width = 1
         self.round_batch = 1
         self.densify = 1
+        self.silent = 0
+        self.max_bad_records = 0
+        self.quarantine_dir = ""
+        self._retry_cfg: List = []
+        self._budget: Optional[BadRecordBudget] = None
         self._row_ptr: Optional[np.ndarray] = None
         self._index: Optional[np.ndarray] = None
         self._value: Optional[np.ndarray] = None
@@ -52,29 +59,59 @@ class LibSVMIterator(DataIter):
             self.round_batch = int(val)
         elif name == "densify":
             self.densify = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "max_bad_records":
+            self.max_bad_records = int(val)
+        elif name == "quarantine_dir":
+            self.quarantine_dir = val
+        elif name in RetryPolicy.CONFIG_KEYS:
+            self._retry_cfg.append((name, val))
+
+    def _read_lines(self) -> List[str]:
+        return faults.retried_read_lines(
+            self.path, "libsvm.read", self._retry_cfg,
+            silent=bool(self.silent))
 
     def init(self) -> None:
         if not self.path:
             raise ValueError("libsvm: data_path required")
         if self.batch_size <= 0:
             raise ValueError("libsvm: batch_size required")
+        self._budget = BadRecordBudget(
+            self.max_bad_records, what="libsvm", silent=bool(self.silent),
+            quarantine_dir=self.quarantine_dir or None,
+        )
         row_ptr: List[int] = [0]
         idx: List[int] = []
         val: List[float] = []
         labels: List[List[float]] = []
-        with open(self.path) as f:
-            for line in f:
-                toks = line.split()
-                if not toks:
-                    continue
-                labels.append(
-                    [float(x) for x in toks[0].split(",")][: self.label_width]
-                )
+        for lineno, line in enumerate(self._read_lines(), start=1):
+            line = faults.fault_point("libsvm.row", line)
+            toks = line.split()
+            if not toks:
+                continue
+            mark_idx, mark_val = len(idx), len(val)
+            try:
+                lab = [float(x)
+                       for x in toks[0].split(",")][: self.label_width]
                 for t in toks[1:]:
                     i, _, v = t.partition(":")
-                    idx.append(int(i))
+                    fi = int(i)
+                    if fi < 0:
+                        raise ValueError(f"negative feature index {fi}")
+                    idx.append(fi)
                     val.append(float(v))
-                row_ptr.append(len(idx))
+            except ValueError as e:
+                # corrupt row: roll back its partial features, then
+                # quarantine + skip (abort past max_bad_records)
+                del idx[mark_idx:], val[mark_val:]
+                self._budget.record(self.path, f"line{lineno}", e)
+                continue
+            labels.append(lab)
+            row_ptr.append(len(idx))
+        if self._budget.epoch_count and not self.silent:
+            print(self._budget.summary(), flush=True)
         self._row_ptr = np.asarray(row_ptr, np.int64)
         self._index = np.asarray(idx, np.int32)
         self._value = np.asarray(val, np.float32)

@@ -53,3 +53,6 @@ class MemBufferIterator(DataIter):
 
     def value(self) -> DataBatch:
         return self._cache[self._pos - 1]
+
+    def close(self) -> None:
+        self.base.close()

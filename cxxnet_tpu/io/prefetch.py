@@ -18,6 +18,14 @@ I/O failure) is captured, enqueued, and re-raised in the CONSUMER's
 ``next()`` — previously it killed the daemon thread silently and the
 consumer blocked forever on an empty queue.  The producer survives the
 error and serves the next epoch after a ``before_first`` rewind.
+
+A :class:`~cxxnet_tpu.utils.faults.Watchdog` guards the other hang mode:
+a producer stuck INSIDE the wrapped iterator (I/O stall, hung decoder)
+never enqueues anything, so the consumer would block forever on ``get``.
+The producer heartbeats on every step; when no beat lands for
+``watchdog_timeout_s`` (default 600, ``0`` disables) while the consumer
+is waiting, ``next()`` raises :class:`WatchdogError` with the hung
+thread's stack instead of hanging the train loop.
 """
 
 from __future__ import annotations
@@ -26,6 +34,8 @@ import queue
 import threading
 from typing import Optional
 
+from ..utils import faults
+from ..utils.faults import Watchdog, WatchdogError
 from .data import DataBatch, DataIter
 
 _END = object()
@@ -43,12 +53,15 @@ class ThreadBufferIterator(DataIter):
         self.base = base
         self.buffer_size = 2
         self.silent = 0
+        self.watchdog_timeout_s = 600.0  # 0 disables the stall guard
         self._q: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
+        self._watchdog: Optional[Watchdog] = None
         self._cur: Optional[DataBatch] = None
         self._gen = 0                      # consumer's current epoch
         self._gen_lock = threading.Condition()
         self._stop = False
+        self._closed = False
 
     def supports_dist_shard(self) -> bool:
         return self.base.supports_dist_shard()
@@ -59,12 +72,19 @@ class ThreadBufferIterator(DataIter):
             self.buffer_size = int(val)
         elif name == "silent":
             self.silent = int(val)
+        elif name == "watchdog_timeout_s":
+            self.watchdog_timeout_s = float(val)
 
     def init(self):
         self.base.init()
         self._q = queue.Queue(maxsize=self.buffer_size)
         self._gen = 0
         self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._watchdog = Watchdog(
+            what="prefetch producer",
+            timeout_s=self.watchdog_timeout_s,
+            thread=self._thread,
+        )
         self._thread.start()
         if not self.silent:
             print(f"ThreadBufferIterator: buffer_size={self.buffer_size}")
@@ -79,9 +99,11 @@ class ThreadBufferIterator(DataIter):
         # could be consumed and discarded as stale before the consumer
         # ever observed it.
         served = 0  # last generation fully produced
+        wd = self._watchdog
         while True:
             with self._gen_lock:
                 while not self._stop and self._gen <= served:
+                    wd.beat()  # idle-waiting for a rewind is progress
                     self._gen_lock.wait(timeout=0.5)
                 if self._stop:
                     return
@@ -94,10 +116,13 @@ class ThreadBufferIterator(DataIter):
                             return
                         if self._gen != gen:
                             break  # consumer rewound; restart epoch
+                    wd.beat()
+                    faults.fault_point("prefetch.producer")
                     if not self.base.next():
                         self._put((gen, _END))
                         break
                     self._put((gen, self.base.value()))
+                    wd.beat()
             except Exception as e:  # noqa: BLE001 - relayed to consumer
                 # deliver the failure to the consumer instead of dying
                 # silently (which left next() blocked forever); the
@@ -130,8 +155,20 @@ class ThreadBufferIterator(DataIter):
 
     def next(self) -> bool:
         assert self._q is not None, "init() not called"
+        wd = self._watchdog
         while True:
-            gen, item = self._q.get()
+            try:
+                gen, item = self._q.get(timeout=0.2)
+            except queue.Empty:
+                t = self._thread
+                if t is not None and not t.is_alive() and self._q.empty():
+                    raise WatchdogError(
+                        "prefetch producer thread died without delivering "
+                        "a result; the input pipeline cannot continue"
+                    ) from None
+                if wd is not None:
+                    wd.check()  # raises WatchdogError on a hung producer
+                continue
             if gen != self._gen:
                 continue  # stale epoch
             if item is _END:
@@ -146,6 +183,37 @@ class ThreadBufferIterator(DataIter):
         return self._cur
 
     def close(self):
+        """Stop and JOIN the producer, then close the wrapped iterator.
+
+        The old close() only flagged ``_stop`` and returned — the
+        producer thread (possibly blocked in ``put``) leaked, and
+        ``base`` never released its resources; tests accumulated daemon
+        threads.  Draining the queue unblocks a full-queue ``put`` so
+        the producer can observe ``_stop`` and exit; the join is
+        bounded because a producer hung inside ``base.next()`` is a
+        daemon thread the interpreter may abandon."""
+        if self._closed:
+            return
+        self._closed = True
         with self._gen_lock:
             self._stop = True
             self._gen_lock.notify_all()
+        thread, self._thread = self._thread, None
+        if self._q is not None:
+            while True:  # unblock a producer waiting in _put
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=5.0)
+        # duck-typed bases (tests, user code) may predate close()
+        close_base = getattr(self.base, "close", None)
+        if close_base is not None:
+            close_base()
+
+    def __del__(self):  # pragma: no cover - GC-order dependent
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..utils import faults
+from ..utils.faults import RetryPolicy
 from .data import DataBatch, DataIter
 
 
@@ -45,6 +47,7 @@ class TextIterator(DataIter):
         self.dist_num_worker = 1
         self.dist_worker_rank = 0
         self.round_batch = 1
+        self._retry_cfg: list = []
         self._raw: np.ndarray | None = None
         self._starts: np.ndarray | None = None
         self._loc = 0
@@ -71,12 +74,21 @@ class TextIterator(DataIter):
             self.dist_worker_rank = int(val)
         elif name == "round_batch":
             self.round_batch = int(val)
+        elif name in RetryPolicy.CONFIG_KEYS:
+            self._retry_cfg.append((name, val))
 
     def init(self):
         if self.seq_len <= 0 or self.batch_size <= 0:
             raise ValueError("text: set seq_len and batch_size")
-        with open(self.filename, "rb") as f:
-            raw = np.frombuffer(f.read(), np.uint8)
+
+        def _read():
+            faults.fault_point("text.read")
+            with open(self.filename, "rb") as f:
+                return np.frombuffer(f.read(), np.uint8)
+
+        raw = RetryPolicy.from_cfg(self._retry_cfg).run(
+            _read, what=f"reading {self.filename}",
+            silent=bool(self.silent))
         t = self.seq_len
         stride = self.stride or t
         starts = np.arange(0, len(raw) - t, stride, dtype=np.int64)

@@ -33,6 +33,8 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils.faults import Watchdog
+
 __all__ = [
     "ServeError", "OverloadError", "DeadlineError", "ClosedError",
     "MicroBatcher",
@@ -101,6 +103,7 @@ class MicroBatcher:
         batch_timeout_ms: float = 2.0,
         queue_limit: int = 128,
         stats=None,
+        watchdog_timeout_s: float = 600.0,
     ) -> None:
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
@@ -117,6 +120,17 @@ class MicroBatcher:
             stats.bind_queue_depth(self.pending_count)
         self._worker = threading.Thread(
             target=self._loop, name="cxxnet-serve-batcher", daemon=True
+        )
+        # a worker hung inside the runner (device stall, injected hang)
+        # would otherwise leave every submitter blocked forever; the
+        # watchdog turns that into a fail-fast WatchdogError carrying
+        # the worker's stack.  0 disables.  The timeout is generous by
+        # default because the first batch of a cold bucket legitimately
+        # sits behind an XLA compile.
+        self.watchdog = Watchdog(
+            what="serve batcher worker",
+            timeout_s=watchdog_timeout_s,
+            thread=self._worker,
         )
         self._worker.start()
 
@@ -154,7 +168,11 @@ class MicroBatcher:
                 )
             self._queue.append(req)
             self._nonempty.notify()
-        req.done.wait()
+        # stall window anchored at THIS request's enqueue: an idle-
+        # before-this worker isn't mistaken for hung, and (critically)
+        # submitters never touch the worker's beat clock — steady
+        # traffic must not mask a genuinely hung worker
+        self.watchdog.wait(req.done, since=req.enqueue_t)
         if req.error is not None:
             raise req.error
         return req.result
@@ -216,6 +234,7 @@ class MicroBatcher:
             batch = self._take_batch()
             if not batch:
                 continue
+            self.watchdog.beat()
             try:
                 data = (batch[0].data if len(batch) == 1
                         else np.concatenate([r.data for r in batch], axis=0))
@@ -224,6 +243,8 @@ class MicroBatcher:
                 for r in batch:
                     r.resolve(error=e)
                 continue
+            finally:
+                self.watchdog.beat()
             ofs = 0
             for r in batch:
                 n = r.data.shape[0]

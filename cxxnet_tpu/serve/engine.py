@@ -34,6 +34,8 @@ import numpy as np
 
 from ..nnet.trainer import NetTrainer
 from ..utils import checkpoint as ckpt
+from ..utils import faults
+from ..utils.faults import CircuitBreaker, RetryPolicy
 from .batcher import ClosedError, MicroBatcher, ServeError
 from .cache import ShapeBucketCache
 from .metrics import ServingStats
@@ -81,11 +83,23 @@ class Engine:
         queue_limit: int = 128,
         default_deadline_ms: float = 0.0,
         silent: bool = True,
+        reload_breaker_threshold: int = 3,
+        reload_breaker_cooldown_s: float = 30.0,
+        watchdog_timeout_s: float = 600.0,
     ) -> None:
         self._cfg = _parse_cfg(cfg)
         self.model_dir = model_dir
         self.silent = silent
         self.default_deadline_ms = float(default_deadline_ms)
+        # unified transient-I/O retry (doc/robustness.md): the old
+        # hard-coded retry_io site, now driven by retry_* config keys
+        self._retry = RetryPolicy.from_cfg(self._cfg)
+        # hot-reload circuit breaker: consecutive reload failures open
+        # it; the old model keeps serving and /healthz turns degraded
+        self.reload_breaker = CircuitBreaker(
+            failure_threshold=reload_breaker_threshold,
+            cooldown_s=reload_breaker_cooldown_s,
+        )
         self._model_lock = threading.RLock()
         self._round = -1
         self._model_path: Optional[str] = None
@@ -102,16 +116,36 @@ class Engine:
             self._trainer = self._load_trainer(model_in)
             self._set_model(model_in)
         elif model_dir is not None:
-            found = ckpt.find_latest_valid(
-                model_dir, net_fp=self._conf_net_fp(), silent=silent
-            )
-            if found is None:
-                raise ModelLoadError(
-                    f"no valid checkpoint in {model_dir!r}"
+            # newest checkpoint that both VALIDATES (manifest CRC) and
+            # LOADS — a garbage payload with a self-consistent manifest
+            # passes validation but explodes in load_model; fall back
+            # past it instead of refusing to serve while an older good
+            # checkpoint exists
+            net_fp = self._conf_net_fp()
+            before, last_err = None, None
+            while True:
+                found = ckpt.find_latest_valid(
+                    model_dir, net_fp=net_fp, silent=silent, before=before
                 )
-            self._round = found[0]
-            self._trainer = self._load_trainer(found[1])
-            self._set_model(found[1], found[0])
+                if found is None:
+                    detail = f" (last load failure: {last_err})" if last_err else ""
+                    raise ModelLoadError(
+                        f"no loadable checkpoint in {model_dir!r}{detail}"
+                    )
+                try:
+                    trainer_ = self._load_trainer(found[1])
+                except Exception as e:  # noqa: BLE001 - fall back past it
+                    last_err = e
+                    if not silent:
+                        print(f"serve: checkpoint {found[1]} failed to "
+                              f"load ({type(e).__name__}: {e}); falling "
+                              "back to an older round", flush=True)
+                    before = found[0]
+                    continue
+                self._round = found[0]
+                self._trainer = trainer_
+                self._set_model(found[1], found[0])
+                break
         else:
             raise ValueError(
                 "Engine needs one of model_in / model_dir / trainer"
@@ -132,6 +166,7 @@ class Engine:
             batch_timeout_ms=batch_timeout_ms,
             queue_limit=queue_limit,
             stats=self.stats,
+            watchdog_timeout_s=watchdog_timeout_s,
         )
         self._closed = False
 
@@ -153,8 +188,8 @@ class Engine:
     def _load_trainer(self, path: str) -> NetTrainer:
         tr = NetTrainer()
         tr.set_params(self._cfg)
-        ckpt.retry_io(lambda: tr.load_model(path),
-                      what=f"loading {path}", silent=self.silent)
+        self._retry.run(lambda: tr.load_model(path),
+                        what=f"loading {path}", silent=self.silent)
         return tr
 
     def _set_model(self, path: str, round_: Optional[int] = None) -> None:
@@ -213,6 +248,7 @@ class Engine:
         """Batcher callback: one coalesced batch through the CURRENT
         model's bucket cache (the lock makes the model swap atomic with
         respect to batch execution)."""
+        faults.fault_point("serve.batch")
         with self._model_lock:
             cache = self._cache
         n = data.shape[0]
@@ -287,6 +323,7 @@ class Engine:
         swap itself is a pointer flip under the model lock."""
         if self.model_dir is None:
             return False
+        faults.fault_point("serve.reload")
         found = ckpt.find_latest_valid(
             self.model_dir, net_fp=self._conf_net_fp(), silent=self.silent
         )
@@ -305,6 +342,42 @@ class Engine:
             print(f"serve: hot-reloaded round {round_} from {path}",
                   flush=True)
         return True
+
+    def try_reload(self) -> bool:
+        """:meth:`reload_if_newer` behind the circuit breaker — the
+        reload poll loop's entry point.  Never raises: a failed reload
+        is recorded (``reload_failures`` in ``/statsz``), trips the
+        breaker after ``reload_breaker_threshold`` consecutive
+        failures, and the OLD model keeps serving; while the breaker is
+        open polls are skipped entirely (the back-off), and ``/healthz``
+        reports ``degraded``.  Returns True only when a newer model was
+        actually swapped in."""
+        if not self.reload_breaker.allow():
+            return False
+        try:
+            swapped = self.reload_if_newer()
+        except Exception as e:  # noqa: BLE001 - degrade, don't die
+            self.reload_breaker.record_failure()
+            self.stats.record_reload(ok=False)
+            state = self.reload_breaker.state
+            if not self.silent:
+                print(f"serve: reload failed ({type(e).__name__}: {e}); "
+                      f"breaker {state}, serving round {self._round}",
+                      flush=True)
+            return False
+        self.reload_breaker.record_success()
+        self.stats.record_reload(ok=True, swapped=swapped)
+        return swapped
+
+    def reload_degraded(self) -> bool:
+        """True while the reload breaker is not closed — the model
+        still serves, but it may be stale.  A single sub-threshold
+        poll failure does NOT degrade health (a load balancer keying
+        on /healthz must not pull the instance for one transient
+        blip — that threshold is exactly what the breaker provides);
+        per-poll detail stays observable as ``last_reload_ok`` in
+        /statsz."""
+        return self.reload_breaker.state != "closed"
 
     def _warm(self, cache: ShapeBucketCache) -> None:
         """Compile the new model for every (kind, node, bucket, shape)
@@ -335,11 +408,14 @@ class Engine:
 
     def healthz(self) -> Dict[str, object]:
         with self._model_lock:
+            status = ("closed" if self._closed
+                      else "degraded" if self.reload_degraded() else "ok")
             return {
-                "status": "ok" if not self._closed else "closed",
+                "status": status,
                 "round": self._round,
                 "model": self._model_path,
                 "net_fp": self._cache.net_fp(),
+                "reload_breaker": self.reload_breaker.state,
             }
 
     def snapshot_stats(self) -> Dict[str, object]:
@@ -356,6 +432,7 @@ class Engine:
             "batch_timeout_ms": self.batcher.batch_timeout * 1e3,
             "queue_limit": self.batcher.queue_limit,
         }
+        out["reload_breaker"] = self.reload_breaker.snapshot()
         return out
 
     # ------------------------------------------------------------------

@@ -43,6 +43,13 @@ class ServingStats:
         self.batches = 0
         self.batch_rows = 0
         self.bucket_rows = 0
+        # hot-reload lifecycle (poll thread / try_reload): attempts,
+        # failures, completed swaps, and the last poll's verdict — the
+        # /statsz surface for "is the reload path healthy"
+        self.reload_attempts = 0
+        self.reload_failures = 0
+        self.reload_swaps = 0
+        self.last_reload_ok: Optional[bool] = None
         self.latency = PercentileTracker(latency_window)
         self._queue_depth: Optional[Callable[[], int]] = None
 
@@ -76,6 +83,15 @@ class ServingStats:
             self.batch_rows += rows
             self.bucket_rows += bucket_rows
 
+    def record_reload(self, ok: bool, swapped: bool = False) -> None:
+        with self._lock:
+            self.reload_attempts += 1
+            self.last_reload_ok = ok
+            if not ok:
+                self.reload_failures += 1
+            elif swapped:
+                self.reload_swaps += 1
+
     # ------------------------------------------------------------------
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
@@ -97,6 +113,10 @@ class ServingStats:
                 "rows_per_batch": (
                     self.batch_rows / self.batches if self.batches else 0.0
                 ),
+                "reload_attempts": self.reload_attempts,
+                "reload_failures": self.reload_failures,
+                "reload_swaps": self.reload_swaps,
+                "last_reload_ok": self.last_reload_ok,
             }
         out["latency_ms"] = self.latency.summary(scale=1e3)
         if self._queue_depth is not None:

@@ -15,12 +15,24 @@ the batching opportunity.  Endpoints:
 Errors map to JSON bodies with meaningful statuses: 400 malformed
 request, 404 unknown route, 429 load shed, 503 shutting down, 504
 deadline expired, 500 model failure.
+
+Lifecycle (doc/robustness.md): every request is tracked by an in-flight
+gauge; on shutdown the server stops accepting, then **drains** — waits
+up to ``drain_timeout_s`` for in-flight requests to finish writing their
+responses — before the engine closes, so a SIGTERM under load never
+drops a request whose handler has begun executing.  (A connection still
+parsing its request line/headers at shutdown is not yet counted; if it
+reaches the engine after the drain it gets a clean 503, not a hang.)  The hot-reload poll thread routes through
+``Engine.try_reload`` (circuit breaker + ``reload_failures`` /
+``last_reload_ok`` in ``/statsz``) instead of printing and retrying a
+broken reload at full poll rate.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
@@ -34,9 +46,42 @@ __all__ = ["make_server", "serve_forever"]
 MAX_BODY_BYTES = 64 << 20  # reject absurd request bodies outright
 
 
+class _InflightGauge:
+    """Counts requests between accept and response-written, and lets
+    shutdown wait for the count to reach zero (the drain)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self.count = 0
+
+    def __enter__(self) -> "_InflightGauge":
+        with self._lock:
+            self.count += 1
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with self._idle:
+            self.count -= 1
+            if self.count == 0:
+                self._idle.notify_all()
+
+    def wait_idle(self, timeout_s: float) -> bool:
+        """Block until no request is in flight; False on timeout."""
+        deadline = time.monotonic() + timeout_s
+        with self._idle:
+            while self.count > 0:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    return False
+                self._idle.wait(timeout=remain)
+        return True
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     engine: Engine = None  # bound by make_server via subclassing
+    inflight: _InflightGauge = None
     verbose = False
 
     # ------------------------------------------------------------------
@@ -72,14 +117,19 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------------
     def do_GET(self) -> None:  # noqa: N802 - stdlib name
-        if self.path == "/healthz":
-            self._reply(200, self.engine.healthz())
-        elif self.path == "/statsz":
-            self._reply(200, self.engine.snapshot_stats())
-        else:
-            self._reply(404, {"error": f"unknown route {self.path}"})
+        with self.inflight:
+            if self.path == "/healthz":
+                self._reply(200, self.engine.healthz())
+            elif self.path == "/statsz":
+                self._reply(200, self.engine.snapshot_stats())
+            else:
+                self._reply(404, {"error": f"unknown route {self.path}"})
 
     def do_POST(self) -> None:  # noqa: N802 - stdlib name
+        with self.inflight:
+            self._do_post()
+
+    def _do_post(self) -> None:
         if self.path not in ("/predict", "/extract"):
             self._reply(404, {"error": f"unknown route {self.path}"})
             return
@@ -117,12 +167,16 @@ def make_server(
     verbose: bool = False,
 ) -> ThreadingHTTPServer:
     """Bind (but do not run) the HTTP server; ``port=0`` picks an
-    ephemeral port — read it back from ``server.server_port``."""
+    ephemeral port — read it back from ``server.server_port``.  The
+    in-flight gauge hangs off the server as ``httpd.inflight``."""
+    gauge = _InflightGauge()
     handler = type(
-        "BoundHandler", (_Handler,), {"engine": engine, "verbose": verbose}
+        "BoundHandler", (_Handler,),
+        {"engine": engine, "verbose": verbose, "inflight": gauge},
     )
     httpd = ThreadingHTTPServer((host, port), handler)
     httpd.daemon_threads = True
+    httpd.inflight = gauge
     return httpd
 
 
@@ -131,25 +185,29 @@ def serve_forever(
     host: str = "127.0.0.1",
     port: int = 0,
     reload_period_s: float = 0.0,
+    drain_timeout_s: float = 5.0,
     verbose: bool = False,
     ready_fn=None,
 ) -> Tuple[ThreadingHTTPServer, Optional[threading.Thread]]:
     """Run the server until ``httpd.shutdown()`` (blocking).
 
     ``reload_period_s > 0`` starts a background thread polling
-    ``engine.reload_if_newer()`` — hot model reload without dropping a
-    request.  ``ready_fn(httpd)`` is called once the socket is bound,
-    before serving (the CLI prints the actual port there)."""
+    ``engine.try_reload()`` — hot model reload behind the circuit
+    breaker, without dropping a request.  ``ready_fn(httpd)`` is called
+    once the socket is bound, before serving (the CLI prints the actual
+    port there).
+
+    Shutdown is a graceful drain: after ``httpd.shutdown()`` stops the
+    accept loop, in-flight requests get up to ``drain_timeout_s`` to
+    finish writing their responses before this function returns (the
+    caller then closes the engine, which 503s anything still queued)."""
     httpd = make_server(engine, host, port, verbose=verbose)
     stop = threading.Event()
     reloader = None
     if reload_period_s > 0 and engine.model_dir is not None:
         def _poll():
             while not stop.wait(reload_period_s):
-                try:
-                    engine.reload_if_newer()
-                except Exception as e:  # noqa: BLE001 - keep serving
-                    print(f"serve: reload failed: {e}", flush=True)
+                engine.try_reload()  # breaker-gated; never raises
 
         reloader = threading.Thread(
             target=_poll, name="cxxnet-serve-reload", daemon=True
@@ -161,5 +219,12 @@ def serve_forever(
         httpd.serve_forever(poll_interval=0.2)
     finally:
         stop.set()
+        if drain_timeout_s > 0 and not httpd.inflight.wait_idle(
+                drain_timeout_s):
+            print(
+                f"serve: drain timed out after {drain_timeout_s:g}s with "
+                f"{httpd.inflight.count} request(s) still in flight",
+                flush=True,
+            )
         httpd.server_close()
     return httpd, reloader

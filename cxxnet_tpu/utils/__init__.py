@@ -1,4 +1,4 @@
-"""Utility modules: metrics, timing, fault-tolerant checkpointing."""
+"""Utility modules: metrics, timing, checkpointing, fault injection."""
 
 from .checkpoint import (  # noqa: F401
     CheckpointError,
@@ -8,5 +8,17 @@ from .checkpoint import (  # noqa: F401
     find_latest_valid,
     retry_io,
     validate_checkpoint,
+)
+from .faults import (  # noqa: F401
+    BadDataError,
+    BadRecordBudget,
+    CircuitBreaker,
+    FaultInjector,
+    InjectedCorruption,
+    InjectedFault,
+    RetryPolicy,
+    Watchdog,
+    WatchdogError,
+    fault_point,
 )
 from .metric import MetricSet, create_metric  # noqa: F401

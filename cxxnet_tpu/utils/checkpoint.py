@@ -39,6 +39,8 @@ import time
 import zlib
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from . import faults
+
 # model container magic (shared with nnet.trainer, which re-exports it)
 MODEL_MAGIC = b"CXTPU001"
 MANIFEST_SUFFIX = ".manifest.json"
@@ -69,6 +71,7 @@ def atomic_write_bytes(path: str, data: bytes, fsync: bool = True) -> None:
     """Write ``data`` to ``path`` atomically: temp file in the same
     directory, flush+fsync, rename.  A crash at any point leaves either
     the old file or the new one, never a truncation."""
+    faults.fault_point("checkpoint.write")
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
@@ -107,22 +110,14 @@ def retry_io(
     silent: bool = False,
     _sleep: Callable[[float], None] = time.sleep,
 ):
-    """Run ``fn()`` retrying transient failures with exponential backoff
-    (delays ``base_delay * 2**k``).  The last failure propagates."""
-    for k in range(attempts):
-        try:
-            return fn()
-        except exceptions as e:
-            if k == attempts - 1:
-                raise
-            delay = base_delay * (2 ** k)
-            if not silent:
-                print(
-                    f"{what} failed ({type(e).__name__}: {e}); "
-                    f"retry {k + 1}/{attempts - 1} in {delay:.2f}s",
-                    flush=True,
-                )
-            _sleep(delay)
+    """Legacy retry entry point — now a thin wrapper over the unified
+    :class:`~cxxnet_tpu.utils.faults.RetryPolicy` (no jitter, no
+    deadline, uncapped backoff: the exact old ``base_delay * 2**k``
+    schedule) so there is ONE retry implementation to maintain."""
+    return faults.RetryPolicy(
+        attempts=attempts, base_delay=base_delay,
+        max_delay=float("inf"), jitter=0.0, exceptions=exceptions,
+    ).run(fn, what=what, silent=silent, _sleep=_sleep)
 
 
 # ----------------------------------------------------------------------
@@ -239,6 +234,7 @@ def validate_checkpoint(
     (legacy checkpoint): structural validation only (magic, parseable
     header); payload corruption is then caught at load time."""
     try:
+        faults.fault_point("checkpoint.read")
         size = os.path.getsize(model_path)
     except OSError as e:
         return f"unreadable: {e}"

@@ -1,0 +1,666 @@
+"""Resilience layer: deterministic fault injection + recovery primitives.
+
+The ROADMAP's serving north star requires the TensorFlow-era posture
+(arXiv:1605.08695 §4.2): at scale, partial input failure and process
+churn are *normal operation*, not crashes.  This module is the substrate
+the io/, checkpoint, and serve/ layers build their hardening on — and
+the chaos harness that makes the hardening verifiable:
+
+* :class:`FaultInjector` — a registry of **named injection sites**
+  (``SITES``) instrumented through the hot paths.  A config key
+  ``fault_inject = site:kind:prob[:limit]`` arms a site with a fault
+  kind (``ioerror`` / ``corrupt`` / ``latency`` / ``hang``) fired with
+  probability ``prob`` per visit, at most ``limit`` times.  Draws come
+  from a per-spec RNG seeded by ``fault_seed`` + the site name, so a
+  schedule **replays deterministically** — the same seed produces the
+  same firing pattern, which is what lets tests assert exact skip
+  counts and quarantine offsets.
+* :class:`RetryPolicy` — the unified transient-I/O retry: exponential
+  backoff with deterministic jitter AND a total deadline, replacing the
+  ad-hoc ``retry_io`` call sites (config keys ``retry_attempts``,
+  ``retry_base_delay``, ``retry_max_delay``, ``retry_deadline_s``).
+* :class:`Watchdog` — detects a hung worker (prefetch producer,
+  serve batcher) and fails fast with a diagnostic (including the hung
+  thread's stack) instead of blocking the consumer forever.
+* :class:`CircuitBreaker` — consecutive-failure breaker for the serve
+  hot-reload path: back off instead of retrying a broken reload at
+  full poll rate, while the old model keeps serving.
+* :class:`BadRecordBudget` — skip-and-quarantine accounting for data
+  iterators: corrupt records/pages are skipped and logged up to
+  ``max_bad_records`` per epoch; exceeding the budget aborts with a
+  summary; quarantined offsets are written to a ``.quarantine``
+  sidecar next to the source file.
+
+See ``doc/robustness.md`` for the config surface and the chaos suite.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "SITES",
+    "KINDS",
+    "InjectedFault",
+    "InjectedCorruption",
+    "WatchdogError",
+    "BadDataError",
+    "FaultSpec",
+    "FaultInjector",
+    "fault_point",
+    "install",
+    "configure",
+    "reset",
+    "injector",
+    "retried_read_lines",
+    "RetryPolicy",
+    "Watchdog",
+    "CircuitBreaker",
+    "BadRecordBudget",
+]
+
+#: Every instrumented injection site and the fault kinds it supports.
+#: ``tools/chaos_run.sh`` iterates this matrix — adding a site here
+#: without a chaos scenario for it fails the fault-matrix lane.
+SITES: Dict[str, Tuple[str, ...]] = {
+    "imgbin.page": ("ioerror", "corrupt", "latency", "hang"),
+    "imgbin.record": ("corrupt",),
+    "csv.read": ("ioerror", "latency"),
+    "csv.row": ("corrupt",),
+    "libsvm.read": ("ioerror", "latency"),
+    "libsvm.row": ("corrupt",),
+    "text.read": ("ioerror", "latency"),
+    "prefetch.producer": ("latency", "hang"),
+    "checkpoint.write": ("ioerror", "latency"),
+    "checkpoint.read": ("ioerror", "latency"),
+    "serve.reload": ("ioerror", "latency"),
+    "serve.batch": ("ioerror", "latency", "hang"),
+}
+
+KINDS = ("ioerror", "corrupt", "latency", "hang")
+
+
+class InjectedFault(OSError):
+    """Injected transient I/O failure (an ``OSError``, so the retry
+    machinery treats it exactly like a real filesystem flake)."""
+
+
+class InjectedCorruption(ValueError):
+    """Injected record/page corruption at a site with no byte payload
+    to mutate (sites WITH a payload get real flipped bytes instead, so
+    the downstream parser fails the honest way)."""
+
+
+class WatchdogError(RuntimeError):
+    """A monitored worker made no progress within the watchdog timeout."""
+
+
+class BadDataError(RuntimeError):
+    """The ``max_bad_records`` skip budget was exceeded.
+
+    Carries the budget's summary; ``__cause__`` is the parse/decode
+    error of the record that broke the budget."""
+
+
+# ----------------------------------------------------------------------
+# fault injection
+class FaultSpec:
+    """One armed fault: ``site:kind:prob[:limit]``."""
+
+    def __init__(self, site: str, kind: str, prob: float,
+                 limit: int = 0) -> None:
+        self.site = site
+        self.kind = kind
+        self.prob = float(prob)
+        self.limit = int(limit)  # 0 = unlimited firings
+        self.fired = 0
+        self.visits = 0
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        lim = f":{self.limit}" if self.limit else ""
+        return f"<FaultSpec {self.site}:{self.kind}:{self.prob:g}{lim} fired={self.fired}>"
+
+
+def _corrupt_bytes(blob: bytes, rng: random.Random) -> bytes:
+    """Deterministically flip bytes in ``blob``.  Byte 0 always flips —
+    it kills format magics (JPEG SOI, page headers, float headers) so
+    the downstream parser reliably fails — plus a few rng positions."""
+    b = bytearray(blob)
+    if not b:
+        return bytes(b)
+    b[0] ^= 0xFF
+    for _ in range(min(3, len(b) - 1)):
+        b[rng.randrange(len(b))] ^= 0xFF
+    return bytes(b)
+
+
+def _corrupt_text(text: str, rng: random.Random) -> str:
+    """Corrupt a text record: make its leading field unparseable and
+    sprinkle a couple of junk bytes (deterministic positions).  ``~``
+    is not a comment character in any supported text format, so the
+    corruption is PARSED (and quarantined), never silently skipped."""
+    chars = list(text)
+    if not chars:
+        return "~"
+    chars[0] = "~"
+    for _ in range(min(2, len(chars) - 1)):
+        chars[rng.randrange(len(chars))] = "~"
+    return "".join(chars)
+
+
+class FaultInjector:
+    """Deterministic, seed-driven fault-injection registry.
+
+    One process-wide instance (module functions below) so config-driven
+    specs reach every instrumented layer without plumbing.  Thread-safe:
+    draws are serialized under a lock; per-spec RNGs are seeded from
+    ``(seed, site, kind)`` so a site's firing pattern depends only on
+    its own visit sequence, not on cross-site interleaving.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._by_site: Dict[str, List[FaultSpec]] = {}
+        self._rngs: Dict[Tuple[str, str], random.Random] = {}
+        self.seed = 0
+        self.latency_s = 0.05
+        self.hang_s = 3600.0
+        self._release = threading.Event()
+
+    # ------------------------------------------------------------------
+    def install(self, spec: str) -> FaultSpec:
+        """Arm one ``site:kind:prob[:limit]`` spec."""
+        parts = spec.split(":")
+        if len(parts) not in (3, 4):
+            raise ValueError(
+                f"fault_inject spec {spec!r}: want site:kind:prob[:limit]"
+            )
+        site, kind = parts[0], parts[1]
+        if site not in SITES:
+            raise ValueError(
+                f"fault_inject: unknown site {site!r}; known: "
+                f"{', '.join(sorted(SITES))}"
+            )
+        if kind not in SITES[site]:
+            raise ValueError(
+                f"fault_inject: site {site!r} supports kinds "
+                f"{SITES[site]}, not {kind!r}"
+            )
+        prob = float(parts[2])
+        if not 0.0 <= prob <= 1.0:
+            raise ValueError(f"fault_inject: prob must be in [0,1], got {prob}")
+        limit = int(parts[3]) if len(parts) == 4 else 0
+        fs = FaultSpec(site, kind, prob, limit)
+        with self._lock:
+            self._by_site.setdefault(site, []).append(fs)
+            self._rngs[(site, kind)] = random.Random(
+                (self.seed << 16) ^ zlib.crc32(f"{site}:{kind}".encode())
+            )
+        return fs
+
+    def configure(self, cfg: Sequence[Tuple[str, str]]) -> None:
+        """Arm specs from an ordered config stream.  Keys: ``fault_seed``
+        (read before any spec it should affect), ``fault_latency_ms``,
+        ``fault_hang_s``, and any number of ``fault_inject`` entries."""
+        for name, val in cfg:
+            if name == "fault_seed":
+                self.seed = int(val)
+            elif name == "fault_latency_ms":
+                self.latency_s = float(val) / 1e3
+            elif name == "fault_hang_s":
+                self.hang_s = float(val)
+            elif name == "fault_inject":
+                self.install(val)
+
+    def reset(self) -> None:
+        """Disarm everything and release any in-progress hangs (so
+        daemon threads blocked at a hang site unblock at teardown)."""
+        with self._lock:
+            self._by_site.clear()
+            self._rngs.clear()
+            self.seed = 0
+            self.latency_s = 0.05
+            self.hang_s = 3600.0
+            self._release.set()
+            self._release = threading.Event()
+
+    def active(self) -> bool:
+        return bool(self._by_site)
+
+    def armed(self, *sites: str) -> bool:
+        """Is any spec armed for one of ``sites``?  Lets a fast path
+        bypass instrumentation only when ITS sites are quiet, instead
+        of degrading for unrelated chaos configs."""
+        return any(self._by_site.get(s) for s in sites)
+
+    def specs(self) -> List[FaultSpec]:
+        with self._lock:
+            return [s for specs in self._by_site.values() for s in specs]
+
+    def fire_counts(self) -> Dict[str, int]:
+        return {f"{s.site}:{s.kind}": s.fired for s in self.specs()}
+
+    # ------------------------------------------------------------------
+    def fault_point(self, site: str, payload=None):
+        """The instrumentation hook: called at a named site with the
+        record payload (bytes/str) when one exists.  Returns the
+        (possibly corrupted) payload; may sleep, hang, or raise."""
+        if not self._by_site:  # fast path: injection disarmed
+            return payload
+        with self._lock:
+            specs = list(self._by_site.get(site, ()))
+            firing: List[Tuple[FaultSpec, random.Random]] = []
+            for fs in specs:
+                fs.visits += 1
+                if fs.limit and fs.fired >= fs.limit:
+                    continue
+                rng = self._rngs[(site, fs.kind)]
+                if fs.prob >= 1.0 or rng.random() < fs.prob:
+                    fs.fired += 1
+                    firing.append((fs, rng))
+            release = self._release
+        for fs, rng in firing:
+            if fs.kind == "latency":
+                time.sleep(self.latency_s)
+            elif fs.kind == "hang":
+                # block on the release event (reset() unblocks) rather
+                # than a bare sleep, so teardown never strands a thread
+                release.wait(self.hang_s)
+            elif fs.kind == "corrupt":
+                if isinstance(payload, (bytes, bytearray)):
+                    payload = _corrupt_bytes(bytes(payload), rng)
+                elif isinstance(payload, str):
+                    payload = _corrupt_text(payload, rng)
+                else:
+                    raise InjectedCorruption(
+                        f"injected corruption at {site}"
+                    )
+            else:  # ioerror
+                raise InjectedFault(f"injected I/O error at {site}")
+        return payload
+
+
+_INJECTOR = FaultInjector()
+
+
+def injector() -> FaultInjector:
+    return _INJECTOR
+
+
+def fault_point(site: str, payload=None):
+    """Module-level hook the instrumented layers call (near-zero cost
+    while no fault is armed)."""
+    return _INJECTOR.fault_point(site, payload)
+
+
+def install(spec: str) -> FaultSpec:
+    return _INJECTOR.install(spec)
+
+
+def configure(cfg: Sequence[Tuple[str, str]]) -> None:
+    _INJECTOR.configure(cfg)
+
+
+def reset() -> None:
+    _INJECTOR.reset()
+
+
+# ----------------------------------------------------------------------
+# retry
+def _cfg_get(cfg, name, default):
+    out = default
+    for n, v in cfg or ():
+        if n == name:
+            out = v
+    return out
+
+
+class RetryPolicy:
+    """Unified transient-failure retry: exponential backoff with
+    deterministic jitter and a **total deadline**.
+
+    ``attempts`` bounds the try count; ``deadline_s > 0`` additionally
+    bounds total time — the policy gives up (re-raising the last error)
+    rather than start a sleep that would cross the deadline, so a
+    hard-down dependency fails in bounded time no matter how many
+    attempts remain.  Jitter is drawn from an RNG seeded per policy, so
+    backoff schedules replay deterministically under test."""
+
+    #: the config keys :meth:`from_cfg` understands — iterators route
+    #: exactly these through ``set_param`` so every retry knob works
+    #: everywhere the policy does
+    CONFIG_KEYS = ("retry_attempts", "retry_base_delay", "retry_max_delay",
+                   "retry_jitter", "retry_deadline_s")
+
+    def __init__(
+        self,
+        attempts: int = 4,
+        base_delay: float = 0.05,
+        max_delay: float = 2.0,
+        jitter: float = 0.25,
+        deadline_s: float = 0.0,
+        exceptions: Tuple[type, ...] = (OSError,),
+        seed: int = 0,
+    ) -> None:
+        if attempts < 1:
+            raise ValueError("RetryPolicy: attempts must be >= 1")
+        self.attempts = int(attempts)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.jitter = float(jitter)
+        self.deadline_s = float(deadline_s)
+        self.exceptions = tuple(exceptions)
+        self.seed = int(seed)
+
+    @classmethod
+    def from_cfg(cls, cfg, **overrides) -> "RetryPolicy":
+        """Build from config keys ``retry_attempts``, ``retry_base_delay``
+        (seconds), ``retry_max_delay``, ``retry_jitter``,
+        ``retry_deadline_s`` — the knobs the old hard-coded ``retry_io``
+        call sites now expose."""
+        kw = dict(
+            attempts=int(_cfg_get(cfg, "retry_attempts", 4)),
+            base_delay=float(_cfg_get(cfg, "retry_base_delay", 0.05)),
+            max_delay=float(_cfg_get(cfg, "retry_max_delay", 2.0)),
+            jitter=float(_cfg_get(cfg, "retry_jitter", 0.25)),
+            deadline_s=float(_cfg_get(cfg, "retry_deadline_s", 0.0)),
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+    def delay_for(self, attempt: int, rng: random.Random) -> float:
+        """Backoff before retry ``attempt`` (1-based)."""
+        d = min(self.base_delay * (2 ** (attempt - 1)), self.max_delay)
+        if self.jitter > 0:
+            d *= 1.0 + self.jitter * rng.random()
+        return d
+
+    def run(
+        self,
+        fn: Callable,
+        what: str = "I/O",
+        silent: bool = False,
+        _sleep: Callable[[float], None] = time.sleep,
+        _clock: Callable[[], float] = time.monotonic,
+    ):
+        """Run ``fn()`` under the policy; the last failure propagates."""
+        rng = random.Random(self.seed ^ zlib.crc32(what.encode()))
+        t0 = _clock()
+        for k in range(1, self.attempts + 1):
+            try:
+                return fn()
+            except self.exceptions as e:
+                if k == self.attempts:
+                    raise
+                delay = self.delay_for(k, rng)
+                if (self.deadline_s > 0
+                        and _clock() - t0 + delay > self.deadline_s):
+                    if not silent:
+                        print(
+                            f"{what} failed ({type(e).__name__}: {e}); "
+                            f"retry deadline {self.deadline_s:.2f}s "
+                            "exhausted, giving up",
+                            flush=True,
+                        )
+                    raise
+                if not silent:
+                    print(
+                        f"{what} failed ({type(e).__name__}: {e}); "
+                        f"retry {k}/{self.attempts - 1} in {delay:.2f}s",
+                        flush=True,
+                    )
+                _sleep(delay)
+
+
+def retried_read_lines(path: str, site: str, retry_cfg,
+                       silent: bool = False) -> List[str]:
+    """Whole-file line read under the configured :class:`RetryPolicy`,
+    instrumented at ``site``.  ``errors='replace'``: a stray non-UTF8
+    byte corrupts ONE row (quarantinable by the caller's budget)
+    instead of aborting the whole-file read."""
+    def _read():
+        fault_point(site)
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            return f.readlines()
+
+    return RetryPolicy.from_cfg(retry_cfg).run(
+        _read, what=f"reading {path}", silent=silent)
+
+
+# ----------------------------------------------------------------------
+# watchdog
+class Watchdog:
+    """Fail-fast stall detector for a background worker.
+
+    The worker calls :meth:`beat` on every unit of progress; a blocked
+    consumer calls :meth:`check` (or :meth:`wait`) which raises
+    :class:`WatchdogError` — with the worker thread's current stack in
+    the message — once no beat has landed for ``timeout_s``.  A
+    ``timeout_s <= 0`` watchdog is disabled (all methods no-op)."""
+
+    def __init__(self, what: str = "worker", timeout_s: float = 600.0,
+                 thread: Optional[threading.Thread] = None) -> None:
+        self.what = what
+        self.timeout_s = float(timeout_s)
+        self.thread = thread
+        self._last = time.monotonic()
+
+    @property
+    def enabled(self) -> bool:
+        return self.timeout_s > 0
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+
+    def stalled_for(self) -> float:
+        return time.monotonic() - self._last
+
+    def diagnostic(self, dt: float) -> str:
+        msg = (f"{self.what} made no progress for {dt:.1f}s "
+               f"(watchdog_timeout_s={self.timeout_s:g}); failing fast "
+               "instead of blocking forever")
+        t = self.thread
+        if t is not None:
+            if not t.is_alive():
+                return msg + f"; thread {t.name!r} is DEAD"
+            import sys
+            import traceback
+
+            frame = sys._current_frames().get(t.ident)
+            if frame is not None:
+                stack = "".join(traceback.format_stack(frame))
+                msg += f"\nhung thread {t.name!r} stack:\n{stack}"
+        return msg
+
+    def check(self) -> None:
+        if not self.enabled:
+            return
+        dt = self.stalled_for()
+        if dt > self.timeout_s:
+            raise WatchdogError(self.diagnostic(dt))
+
+    def wait(self, event: threading.Event, poll: float = 0.2,
+             since: Optional[float] = None) -> None:
+        """Block on ``event`` with stall checks; raises on a stall.
+
+        ``since`` anchors the stall window for THIS waiter: progress is
+        ``max(last beat, since)``, so a worker that was legitimately
+        idle before this wait began is not mistaken for hung — without
+        the waiters themselves ever touching the shared beat clock
+        (which would let steady traffic mask a genuinely hung worker).
+        """
+        if not self.enabled:
+            event.wait()
+            return
+        if since is None:
+            since = time.monotonic()
+        while not event.wait(min(poll, self.timeout_s)):
+            dt = time.monotonic() - max(self._last, since)
+            if dt > self.timeout_s:
+                raise WatchdogError(self.diagnostic(dt))
+
+
+# ----------------------------------------------------------------------
+# circuit breaker
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker (closed → open → half-open).
+
+    ``failure_threshold`` consecutive failures OPEN the circuit:
+    :meth:`allow` returns False (callers skip the protected operation)
+    until ``cooldown_s`` elapses, then exactly one trial call passes
+    (HALF-OPEN); its success closes the circuit, its failure re-opens
+    and restarts the cooldown.  Thread-safe."""
+
+    def __init__(self, failure_threshold: int = 3, cooldown_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self.total_failures = 0
+        self.total_successes = 0
+        self.times_opened = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if (self._state == "open"
+                    and self._clock() - self._opened_at >= self.cooldown_s):
+                return "half-open"
+            return self._state
+
+    def allow(self) -> bool:
+        """May the protected operation run now?  The half-open trial is
+        claimed by the caller that observes it (one at a time)."""
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._clock() - self._opened_at >= self.cooldown_s:
+                # half-open: let one trial through; re-arm the cooldown
+                # so concurrent pollers don't all pile in
+                self._opened_at = self._clock()
+                self._state = "half-open"
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.total_successes += 1
+            self._consecutive = 0
+            self._state = "closed"
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.total_failures += 1
+            self._consecutive += 1
+            if (self._state == "half-open"
+                    or self._consecutive >= self.failure_threshold):
+                if self._state != "open":
+                    self.times_opened += 1
+                self._state = "open"
+                self._opened_at = self._clock()
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "state": self.state,
+            "consecutive_failures": self._consecutive,
+            "total_failures": self.total_failures,
+            "total_successes": self.total_successes,
+            "times_opened": self.times_opened,
+        }
+
+
+# ----------------------------------------------------------------------
+# skip-and-quarantine
+class BadRecordBudget:
+    """Skip-and-quarantine accounting for one data source.
+
+    ``max_bad_records`` bounds skips **per epoch** (``start_epoch``
+    resets the counter; a long run over data with a fixed set of bad
+    records does not bleed its budget dry across epochs).  Each skipped
+    record appends ``offset\\treason`` to a ``<source>.quarantine``
+    sidecar (deduped across epochs), so a repack tool can excise the
+    exact bad records later.  ``max_bad_records = 0`` keeps the strict
+    legacy behavior: the first bad record aborts (as
+    :class:`BadDataError` chaining the parse error)."""
+
+    def __init__(self, max_bad_records: int = 0, what: str = "data",
+                 silent: bool = False,
+                 quarantine_dir: Optional[str] = None) -> None:
+        self.max_bad_records = int(max_bad_records)
+        self.what = what
+        self.silent = silent
+        self.quarantine_dir = quarantine_dir
+        self.epoch_count = 0          # skips this epoch
+        self.total_count = 0
+        self.events: List[Tuple[str, object, str]] = []
+        self._seen: set = set()
+        self._sidecar_warned = False
+
+    def start_epoch(self) -> None:
+        self.epoch_count = 0
+
+    def _sidecar_path(self, source: str) -> str:
+        if self.quarantine_dir:
+            os.makedirs(self.quarantine_dir, exist_ok=True)
+            return os.path.join(
+                self.quarantine_dir,
+                os.path.basename(source) + ".quarantine",
+            )
+        return source + ".quarantine"
+
+    def record(self, source: str, offset, exc: BaseException,
+               note: str = "") -> None:
+        """Count one bad record/page; raise :class:`BadDataError` when
+        the budget is exhausted.  ``note`` carries collateral the event
+        implies (e.g. how many trailing records a skipped page drops) so
+        the loss is never under-reported."""
+        reason = f"{type(exc).__name__}: {exc}"
+        if note:
+            reason += f" [{note}]"
+        self.epoch_count += 1
+        self.total_count += 1
+        key = (source, offset)
+        if key not in self._seen:
+            self._seen.add(key)
+            self.events.append((source, offset, reason))
+            # strict mode (budget 0) aborts without the sidecar side
+            # effect — the pre-budget behavior left no files behind
+            if self.max_bad_records > 0:
+                try:
+                    with open(self._sidecar_path(source), "a",
+                              encoding="utf-8") as f:
+                        f.write(f"{offset}\t{reason}\n")
+                except OSError as e:
+                    if not self._sidecar_warned:
+                        self._sidecar_warned = True
+                        print(f"{self.what}: cannot write quarantine "
+                              f"sidecar ({e}); continuing without it",
+                              flush=True)
+        if self.epoch_count > self.max_bad_records:
+            raise BadDataError(
+                f"{self.what}: bad-record budget exceeded "
+                f"({self.epoch_count} bad records this epoch > "
+                f"max_bad_records={self.max_bad_records}); last: "
+                f"{source} @ {offset}: {reason}\n{self.summary()}"
+            ) from exc
+        if not self.silent:
+            print(f"{self.what}: skipped bad record {source} @ {offset} "
+                  f"({reason}) [{self.epoch_count}/"
+                  f"{self.max_bad_records} this epoch]", flush=True)
+
+    def summary(self) -> str:
+        srcs = sorted({s for s, _, _ in self.events})
+        return (f"{self.what}: {self.total_count} bad record(s) skipped "
+                f"({len(self.events)} distinct) across "
+                f"{len(srcs)} source(s): {', '.join(srcs) or '-'}")

@@ -47,6 +47,19 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running tests"
     )
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection chaos suite (tools/chaos_run.sh)"
+    )
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No test leaks an armed fault spec (or a thread blocked at a hang
+    site) into the next one: reset() also releases in-progress hangs."""
+    yield
+    from cxxnet_tpu.utils import faults
+
+    faults.reset()
 
 
 def run_cli(args, cwd, timeout=300, module=True):

@@ -1,0 +1,644 @@
+"""Chaos harness tests: deterministic injection, retry/watchdog/breaker
+semantics, and the full fault matrix (every registered site × kind).
+
+The matrix test is the contract ``tools/chaos_run.sh`` runs lane by
+lane: a triggered fault must end in **skip / retry / drain / degrade**
+per policy — never a hang, a silent drop, or an unhandled crash.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import create_iterator
+from cxxnet_tpu.utils import faults
+from cxxnet_tpu.utils.faults import (
+    SITES,
+    BadDataError,
+    BadRecordBudget,
+    CircuitBreaker,
+    InjectedFault,
+    RetryPolicy,
+    Watchdog,
+    WatchdogError,
+)
+
+
+# ----------------------------------------------------------------------
+# injector
+def test_install_validates_specs():
+    with pytest.raises(ValueError, match="unknown site"):
+        faults.install("nope.site:ioerror:1")
+    with pytest.raises(ValueError, match="supports kinds"):
+        faults.install("csv.row:ioerror:1")  # csv.row is corrupt-only
+    with pytest.raises(ValueError, match="prob"):
+        faults.install("csv.row:corrupt:1.5")
+    with pytest.raises(ValueError, match="site:kind:prob"):
+        faults.install("csv.row")
+
+
+def test_sites_registry_is_well_formed():
+    for site, kinds in SITES.items():
+        assert kinds, site
+        assert set(kinds) <= set(faults.KINDS), site
+
+
+def _corrupt_pattern(seed, n=80):
+    faults.reset()
+    faults.injector().seed = seed
+    faults.install("csv.row:corrupt:0.3")
+    pat = [faults.fault_point("csv.row", f"1,{i}").startswith("~")
+           for i in range(n)]
+    faults.reset()
+    return pat
+
+
+def test_deterministic_replay_of_injection_schedule():
+    """Same seed → the exact same firing pattern; a different seed
+    diverges.  This is what makes chaos failures reproducible."""
+    a, b = _corrupt_pattern(7), _corrupt_pattern(7)
+    assert a == b
+    assert any(a) and not all(a)  # prob 0.3 actually sampled
+    assert _corrupt_pattern(8) != a
+
+
+def test_limit_caps_firings():
+    faults.install("csv.row:corrupt:1:2")
+    hits = [faults.fault_point("csv.row", "1,2").startswith("~")
+            for _ in range(6)]
+    assert hits == [True, True, False, False, False, False]
+    assert faults.injector().fire_counts()["csv.row:corrupt"] == 2
+
+
+def test_ioerror_kind_raises_oserror():
+    faults.install("checkpoint.write:ioerror:1:1")
+    with pytest.raises(InjectedFault):
+        faults.fault_point("checkpoint.write")
+    faults.fault_point("checkpoint.write")  # limit spent: clean
+
+
+# ----------------------------------------------------------------------
+# retry policy
+def test_retry_succeeds_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    sleeps = []
+    out = RetryPolicy(attempts=5, base_delay=0.05, jitter=0.0).run(
+        flaky, what="t", silent=True, _sleep=sleeps.append)
+    assert out == "ok" and len(calls) == 3
+    assert sleeps == [0.05, 0.1]  # exponential backoff
+
+
+def test_retry_exhausts_attempts():
+    with pytest.raises(OSError, match="always"):
+        RetryPolicy(attempts=3, base_delay=0.0).run(
+            lambda: (_ for _ in ()).throw(OSError("always")),
+            what="t", silent=True, _sleep=lambda d: None)
+
+
+def test_retry_deadline_gives_up_early():
+    """With a total deadline, the policy refuses to start a sleep that
+    would cross it — even with attempts left."""
+    sleeps = []
+    t = {"now": 0.0}
+
+    def sleep(d):
+        sleeps.append(d)
+        t["now"] += d
+
+    with pytest.raises(OSError):
+        RetryPolicy(attempts=50, base_delay=0.05, max_delay=0.05,
+                    jitter=0.0, deadline_s=0.12).run(
+            lambda: (_ for _ in ()).throw(OSError("down")),
+            what="t", silent=True, _sleep=sleep,
+            _clock=lambda: t["now"])
+    assert len(sleeps) == 2  # 0.05 + 0.05, third sleep would cross 0.12
+
+
+def test_retry_jitter_is_deterministic():
+    p = RetryPolicy(attempts=4, base_delay=0.1, jitter=0.5, seed=3)
+    import random as _random
+
+    rng = _random.Random(3 ^ __import__("zlib").crc32(b"x"))
+    d1 = [p.delay_for(k, rng) for k in (1, 2)]
+    rng2 = _random.Random(3 ^ __import__("zlib").crc32(b"x"))
+    d2 = [p.delay_for(k, rng2) for k in (1, 2)]
+    assert d1 == d2
+
+
+def test_retry_from_cfg_reads_config_keys():
+    p = RetryPolicy.from_cfg([
+        ("retry_attempts", "7"), ("retry_base_delay", "0.5"),
+        ("retry_deadline_s", "9"), ("other", "x"),
+    ])
+    assert (p.attempts, p.base_delay, p.deadline_s) == (7, 0.5, 9.0)
+
+
+# ----------------------------------------------------------------------
+# watchdog
+def test_watchdog_beats_prevent_firing():
+    wd = Watchdog(what="w", timeout_s=0.2)
+    for _ in range(3):
+        time.sleep(0.1)
+        wd.beat()
+        wd.check()  # beats keep it quiet
+
+
+def test_watchdog_fires_with_thread_stack():
+    gate = threading.Event()
+    t = threading.Thread(target=gate.wait, name="hungling", daemon=True)
+    t.start()
+    wd = Watchdog(what="test worker", timeout_s=0.05, thread=t)
+    time.sleep(0.1)
+    with pytest.raises(WatchdogError, match="hungling") as e:
+        wd.check()
+    assert "gate.wait" in str(e.value) or "wait" in str(e.value)
+    gate.set()
+    t.join(1)
+
+
+def test_watchdog_disabled_at_zero():
+    wd = Watchdog(timeout_s=0)
+    time.sleep(0.05)
+    wd.check()  # never fires
+
+
+class _StallingIter:
+    """DataIter whose next() blocks until released (a hung source)."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.calls = 0
+
+    def supports_dist_shard(self):
+        return False
+
+    def set_param(self, name, val):
+        pass
+
+    def init(self):
+        pass
+
+    def before_first(self):
+        pass
+
+    def next(self):
+        self.calls += 1
+        if self.calls > 1:
+            self.release.wait(60)
+            return False
+        return True
+
+    def value(self):
+        from cxxnet_tpu.io.data import DataBatch
+
+        return DataBatch(data=np.zeros((2, 4), np.float32),
+                         label=np.zeros((2, 1), np.float32))
+
+    def close(self):
+        self.release.set()
+
+
+def test_watchdog_fires_on_stalled_producer():
+    """The satellite contract: a prefetch producer stuck inside the
+    wrapped iterator fails the consumer fast with a diagnostic instead
+    of blocking next() forever."""
+    from cxxnet_tpu.io.prefetch import ThreadBufferIterator
+
+    base = _StallingIter()
+    it = ThreadBufferIterator(base)
+    it.set_param("silent", "1")
+    it.set_param("watchdog_timeout_s", "0.4")
+    it.init()
+    it.before_first()
+    assert it.next()  # first batch flows
+    t0 = time.monotonic()
+    with pytest.raises(WatchdogError, match="prefetch producer"):
+        while it.next():
+            pass
+    assert time.monotonic() - t0 < 10  # failed fast, not a 60s hang
+    base.release.set()
+    it.close()
+    assert it._thread is None
+
+
+def test_threadbuffer_close_joins_producer_and_base():
+    """Satellite: close() must drain, join the producer, and close the
+    wrapped iterator — no daemon-thread accumulation across tests."""
+    closed = []
+
+    class _Base(_StallingIter):
+        def next(self):
+            self.calls += 1
+            return self.calls <= 3
+
+        def close(self):
+            closed.append(1)
+
+    from cxxnet_tpu.io.prefetch import ThreadBufferIterator
+
+    before = threading.active_count()
+    its = []
+    for _ in range(4):
+        it = ThreadBufferIterator(_Base())
+        it.set_param("silent", "1")
+        it.init()
+        it.before_first()
+        while it.next():
+            pass
+        its.append(it)
+    for it in its:
+        it.close()
+        it.close()  # idempotent
+    assert closed == [1, 1, 1, 1]
+    deadline = time.monotonic() + 5
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before
+
+
+# ----------------------------------------------------------------------
+# circuit breaker
+def test_circuit_breaker_transitions():
+    t = {"now": 0.0}
+    cb = CircuitBreaker(failure_threshold=2, cooldown_s=10.0,
+                        clock=lambda: t["now"])
+    assert cb.allow() and cb.state == "closed"
+    cb.record_failure()
+    assert cb.state == "closed" and cb.allow()
+    cb.record_failure()
+    assert cb.state == "open" and not cb.allow()
+    t["now"] = 5.0
+    assert not cb.allow()  # still cooling down
+    t["now"] = 10.0
+    assert cb.allow()  # half-open: one trial passes
+    assert not cb.allow()  # ...and only one (cooldown re-armed)
+    cb.record_failure()  # trial failed: back to open
+    assert cb.state == "open"
+    t["now"] = 20.0
+    assert cb.allow()
+    cb.record_success()
+    assert cb.state == "closed" and cb.allow()
+    snap = cb.snapshot()
+    assert snap["total_failures"] == 3 and snap["times_opened"] == 2
+
+
+# ----------------------------------------------------------------------
+# bad-record budget
+def test_budget_quarantine_and_abort(tmp_path):
+    src = str(tmp_path / "data.bin")
+    open(src, "w").close()
+    b = BadRecordBudget(2, what="t", silent=True)
+    b.record(src, 3, ValueError("x"))
+    b.record(src, 9, ValueError("y"))
+    with pytest.raises(BadDataError, match="max_bad_records=2") as e:
+        b.record(src, 11, ValueError("z"))
+    assert isinstance(e.value.__cause__, ValueError)
+    offsets = [ln.split("\t")[0]
+               for ln in open(src + ".quarantine").read().splitlines()]
+    assert offsets == ["3", "9", "11"]
+    # per-epoch budget: a new epoch resets the skip counter but the
+    # sidecar does not duplicate already-quarantined offsets
+    b.start_epoch()
+    b.record(src, 3, ValueError("x again"))
+    offsets = [ln.split("\t")[0]
+               for ln in open(src + ".quarantine").read().splitlines()]
+    assert offsets == ["3", "9", "11"]
+
+
+def test_budget_zero_keeps_strict_behavior(tmp_path):
+    src = str(tmp_path / "d")
+    b = BadRecordBudget(0, what="t", silent=True)
+    with pytest.raises(BadDataError):
+        b.record(src, 0, ValueError("first bad record aborts"))
+
+
+# ======================================================================
+# the fault matrix: every registered site × kind, one lane each
+def _make_imgbin(tmp_path, shards=2, per=4):
+    from cxxnet_tpu.io.imgbin import BinPageWriter, encode_raw
+
+    rng = np.random.RandomState(0)
+    paths = []
+    for s in range(shards):
+        bin_p, lst_p = str(tmp_path / f"sh{s}.bin"), str(tmp_path / f"sh{s}.lst")
+        w = BinPageWriter(bin_p)
+        with open(lst_p, "w") as f:
+            for r in range(per):
+                img = rng.rand(4, 4, 3).astype(np.float32)
+                w.push(encode_raw(img))
+                f.write(f"{s * per + r}\t{float(r % 2)}\t/x_{r}.jpg\n")
+        w.close()
+        paths.append((bin_p, lst_p))
+    return paths
+
+
+def _imgbin_iter(paths, **extra):
+    from cxxnet_tpu.io.imgbin import ImageBinIterator
+
+    it = ImageBinIterator()
+    for b, l in paths:
+        it.set_param("image_bin", b)
+        it.set_param("image_list", l)
+    it.set_param("raw_pixels", "1")
+    it.set_param("native_decoder", "0")
+    it.set_param("silent", "1")
+    for k, v in extra.items():
+        it.set_param(k, str(v))
+    it.init()
+    return it
+
+
+def _count_insts(it):
+    it.before_first()
+    n = 0
+    while it.next():
+        n += 1
+    return n
+
+
+def _scn_imgbin_page(kind, tmp_path):
+    paths = _make_imgbin(tmp_path)
+    if kind == "hang":
+        # page read hangs inside the prefetch producer → the consumer's
+        # watchdog fails fast instead of blocking the train loop
+        entries = [("iter", "imgbin")]
+        for b, l in paths:
+            entries += [("image_bin", b), ("image_list", l)]
+        entries += [
+            ("raw_pixels", "1"), ("native_decoder", "0"), ("silent", "1"),
+            ("batch_size", "2"), ("input_shape", "3,4,4"),
+            ("iter", "threadbuffer"), ("watchdog_timeout_s", "0.8"),
+            ("silent", "1"),
+        ]
+        it = create_iterator(entries)
+        it.init()
+        faults.install("imgbin.page:hang:1:1")
+        with pytest.raises(WatchdogError):
+            it.before_first()
+            while it.next():
+                pass
+        faults.reset()  # release the hung producer so close() can join
+        it.close()
+        return
+    it = _imgbin_iter(paths, max_bad_records=8)
+    faults.install(f"imgbin.page:{kind}:1:1")
+    served = _count_insts(it)
+    if kind == "latency":
+        assert served == 8  # only slowed down, nothing lost
+    else:
+        # first page of shard 0 poisoned → shard skipped, shard 1 intact
+        assert served == 4
+        assert it._budget.epoch_count == 1
+        q = open(paths[0][0] + ".quarantine").read()
+        assert "4 trailing record(s)" in q  # dropped tail is reported
+    assert faults.injector().fire_counts()[f"imgbin.page:{kind}"] == 1
+
+
+def _scn_imgbin_record(kind, tmp_path):
+    assert kind == "corrupt"
+    paths = _make_imgbin(tmp_path)
+    it = _imgbin_iter(paths, max_bad_records=4)
+    faults.install("imgbin.record:corrupt:1:2")
+    served = _count_insts(it)
+    assert served == 6  # records 0 and 1 of shard 0 skipped
+    offsets = [ln.split("\t")[0] for ln in
+               open(paths[0][0] + ".quarantine").read().splitlines()]
+    assert offsets == ["0", "1"]  # exact quarantine offsets
+    # next epoch: same corruption already spent (limit), full data flows
+    assert _count_insts(it) == 8
+
+
+def _write_csv(tmp_path, n=6):
+    p = str(tmp_path / "d.csv")
+    with open(p, "w") as f:
+        for i in range(n):
+            f.write(f"{i % 2},{i},{i + 1},{i + 2},{i + 3}\n")
+    return p
+
+
+def _scn_csv(site, kind, tmp_path):
+    from cxxnet_tpu.io.csv import CSVIterator
+
+    p = _write_csv(tmp_path)
+    it = CSVIterator()
+    it.set_param("filename", p)
+    it.set_param("input_shape", "1,1,4")
+    it.set_param("silent", "1")
+    if site == "csv.read":
+        it.set_param("retry_attempts", "5")
+        it.set_param("retry_base_delay", "0.01")
+        faults.install(f"csv.read:{kind}:1:2")
+        it.init()  # retried past the injected failures
+        assert len(it._rows) == 6
+    else:
+        it.set_param("max_bad_records", "3")
+        faults.install("csv.row:corrupt:1:2")
+        it.init()
+        assert len(it._rows) == 4
+        offsets = [ln.split("\t")[0] for ln in
+                   open(p + ".quarantine").read().splitlines()]
+        assert offsets == ["line1", "line2"]
+
+
+def _scn_libsvm(site, kind, tmp_path):
+    from cxxnet_tpu.io.libsvm import LibSVMIterator
+
+    p = str(tmp_path / "d.libsvm")
+    with open(p, "w") as f:
+        for i in range(6):
+            f.write(f"{i % 2} 0:{i}.0 2:1.5\n")
+    it = LibSVMIterator()
+    it.set_param("data_path", p)
+    it.set_param("batch_size", "2")
+    it.set_param("silent", "1")
+    if site == "libsvm.read":
+        it.set_param("retry_attempts", "5")
+        it.set_param("retry_base_delay", "0.01")
+        faults.install(f"libsvm.read:{kind}:1:2")
+        it.init()
+        assert it.num_inst == 6
+    else:
+        it.set_param("max_bad_records", "3")
+        faults.install("libsvm.row:corrupt:1:2")
+        it.init()
+        assert it.num_inst == 4
+        offsets = [ln.split("\t")[0] for ln in
+                   open(p + ".quarantine").read().splitlines()]
+        assert offsets == ["line1", "line2"]
+
+
+def _scn_text(kind, tmp_path):
+    from cxxnet_tpu.io.text import TextIterator
+
+    p = str(tmp_path / "t.txt")
+    with open(p, "wb") as f:
+        f.write(b"abcdefgh" * 32)
+    it = TextIterator()
+    it.set_param("filename", p)
+    it.set_param("seq_len", "8")
+    it.set_param("batch_size", "4")
+    it.set_param("silent", "1")
+    it.set_param("retry_attempts", "5")
+    it.set_param("retry_base_delay", "0.01")
+    faults.install(f"text.read:{kind}:1:2")
+    it.init()
+    assert it._raw is not None and len(it._raw) == 256
+
+
+def _scn_prefetch(kind, tmp_path):
+    p = _write_csv(tmp_path)
+    entries = [
+        ("iter", "csv"), ("filename", p), ("batch_size", "2"),
+        ("input_shape", "1,1,4"), ("silent", "1"),
+        ("iter", "threadbuffer"), ("watchdog_timeout_s", "0.8"),
+        ("silent", "1"),
+    ]
+    it = create_iterator(entries)
+    it.init()
+    if kind == "latency":
+        faults.install("prefetch.producer:latency:1:2")
+        it.before_first()
+        n = 0
+        while it.next():
+            n += 1
+        assert n == 3  # slowed, complete
+        it.close()
+        return
+    faults.install("prefetch.producer:hang:1:1")
+    with pytest.raises(WatchdogError, match="prefetch producer"):
+        it.before_first()
+        while it.next():
+            pass
+    faults.reset()
+    it.close()
+
+
+def _scn_checkpoint(site, kind, tmp_path):
+    from cxxnet_tpu.utils import checkpoint as ckpt
+
+    if site == "checkpoint.write":
+        faults.install(f"checkpoint.write:{kind}:1:2")
+        path = str(tmp_path / "0001.model")
+        ckpt.write_checkpoint(path, b"payload-bytes", round_=1,
+                              retry=True, silent=True)
+        assert ckpt.validate_checkpoint(path) is None  # retried to done
+        return
+    for r in (1, 2):
+        ckpt.write_checkpoint(str(tmp_path / f"{r:04d}.model"),
+                              f"blob{r}".encode(), round_=r, silent=True)
+    faults.install(f"checkpoint.read:{kind}:1:1")
+    found = ckpt.find_latest_valid(str(tmp_path), silent=True)
+    assert found is not None
+    if kind == "ioerror":
+        assert found[0] == 1  # newest unreadable → skipped, not fatal
+    else:
+        assert found[0] == 2
+
+
+def _scn_serve_reload(kind, tmp_path):
+    from cxxnet_tpu import serve
+    from test_serve import MLP_CFG, _save_round, make_trainer, toy_rows
+
+    mdir = str(tmp_path / "models")
+    _save_round(make_trainer(seed=1), mdir, 1)
+    eng = serve.Engine(cfg=MLP_CFG, model_dir=mdir, max_batch_size=8,
+                       batch_timeout_ms=0, reload_breaker_threshold=2,
+                       reload_breaker_cooldown_s=30.0)
+    try:
+        _save_round(make_trainer(seed=2), mdir, 2)
+        if kind == "latency":
+            faults.install("serve.reload:latency:1:1")
+            assert eng.try_reload() and eng.round == 2
+            assert eng.healthz()["status"] == "ok"
+            return
+        faults.install("serve.reload:ioerror:1")
+        assert not eng.try_reload()
+        assert not eng.try_reload()
+        # breaker open: old model serves, health degrades, polls skipped
+        assert eng.reload_breaker.state == "open"
+        assert eng.healthz()["status"] == "degraded"
+        assert eng.round == 1
+        assert eng.predict(toy_rows(2)).shape[0] == 2
+        st = eng.snapshot_stats()
+        assert st["reload_failures"] == 2 and st["last_reload_ok"] is False
+        fired = faults.injector().fire_counts()["serve.reload:ioerror"]
+        assert not eng.try_reload()  # skipped entirely while open
+        assert faults.injector().fire_counts()["serve.reload:ioerror"] == fired
+        # recovery: fault gone, cooldown elapsed → swap lands, health ok
+        faults.reset()
+        eng.reload_breaker.cooldown_s = 0.0
+        assert eng.try_reload() and eng.round == 2
+        assert eng.healthz()["status"] == "ok"
+    finally:
+        eng.close()
+
+
+def _scn_serve_batch(kind, tmp_path):
+    from cxxnet_tpu import serve
+    from test_serve import make_trainer, toy_rows
+
+    eng = serve.Engine(
+        trainer=make_trainer(), max_batch_size=8, batch_timeout_ms=0,
+        watchdog_timeout_s=0.8 if kind == "hang" else 600.0,
+    )
+    x = toy_rows(2)
+    try:
+        eng.predict(x)  # warm the bucket BEFORE arming the fault
+        faults.install(f"serve.batch:{kind}:1:1")
+        if kind == "hang":
+            with pytest.raises(WatchdogError):
+                eng.predict(x)
+            faults.reset()  # unblock the worker so close() can join
+            return
+        if kind == "ioerror":
+            with pytest.raises(OSError):
+                eng.predict(x)
+            st = eng.snapshot_stats()
+            assert st["errors"] == 1
+        # the engine survives and keeps serving
+        assert eng.predict(x).shape[0] == 2
+    finally:
+        eng.close()
+
+
+MATRIX = [
+    pytest.param(site, kind, id=f"{site}-{kind}",
+                 marks=[pytest.mark.chaos])
+    for site, kinds in SITES.items() for kind in kinds
+]
+
+
+@pytest.mark.parametrize("site,kind", MATRIX)
+def test_fault_matrix(site, kind, tmp_path):
+    """Acceptance: every registered site × kind resolves per policy —
+    skip / retry / drain / degrade — never a hang or unhandled crash."""
+    if site == "imgbin.page":
+        _scn_imgbin_page(kind, tmp_path)
+    elif site == "imgbin.record":
+        _scn_imgbin_record(kind, tmp_path)
+    elif site.startswith("csv."):
+        _scn_csv(site, kind, tmp_path)
+    elif site.startswith("libsvm."):
+        _scn_libsvm(site, kind, tmp_path)
+    elif site == "text.read":
+        _scn_text(kind, tmp_path)
+    elif site == "prefetch.producer":
+        _scn_prefetch(kind, tmp_path)
+    elif site.startswith("checkpoint."):
+        _scn_checkpoint(site, kind, tmp_path)
+    elif site == "serve.reload":
+        _scn_serve_reload(kind, tmp_path)
+    elif site == "serve.batch":
+        _scn_serve_batch(kind, tmp_path)
+    else:  # a new site without a scenario must fail the matrix
+        pytest.fail(f"no chaos scenario for registered site {site!r}")

@@ -330,6 +330,31 @@ def test_engine_reload_warms_served_buckets(tmp_path):
     eng.close()
 
 
+def test_engine_startup_falls_back_past_unloadable_checkpoint(tmp_path):
+    """A garbage payload with a self-consistent manifest passes CRC
+    validation but fails load_model; engine startup must fall back to
+    the older loadable round instead of refusing to serve."""
+    from cxxnet_tpu.utils import checkpoint as ckpt
+
+    mdir = str(tmp_path / "models")
+    _save_round(make_trainer(seed=1), mdir, 1)
+    ckpt.write_checkpoint(os.path.join(mdir, "0002.model"),
+                          b"garbage but manifested", round_=2, silent=True)
+    eng = serve.Engine(cfg=MLP_CFG, model_dir=mdir, max_batch_size=8,
+                       batch_timeout_ms=0)
+    try:
+        assert eng.round == 1
+        assert eng.predict(toy_rows(2)).shape[0] == 2
+    finally:
+        eng.close()
+    # nothing loadable at all → ModelLoadError naming the last failure
+    only_bad = str(tmp_path / "bad_only")
+    ckpt.write_checkpoint(os.path.join(only_bad, "0001.model"),
+                          b"garbage", round_=1, silent=True)
+    with pytest.raises(serve.ModelLoadError, match="no loadable"):
+        serve.Engine(cfg=MLP_CFG, model_dir=only_bad)
+
+
 def test_engine_rejects_invalid_model_in(tmp_path):
     bad = str(tmp_path / "bad.model")
     with open(bad, "wb") as f:
@@ -496,6 +521,115 @@ def test_cli_serve_smoke(tmp_path):
     out = "".join(lines)
     assert proc.returncode == 0, out
     assert "shutdown complete" in out
+
+
+# ----------------------------------------------------------------------
+# resilience: graceful drain + reload circuit breaker
+@pytest.mark.chaos
+def test_drain_under_load_completes_inflight_requests(tmp_path):
+    """SIGTERM-equivalent shutdown while requests are mid-flight: the
+    server stops accepting but every admitted request still gets its
+    200 before serve_forever returns (drain_timeout_s window).  The
+    model is slowed via the serve.batch latency injection so requests
+    are reliably in flight at shutdown time."""
+    from cxxnet_tpu.utils import faults
+
+    tr = make_trainer()
+    eng = serve.Engine(trainer=tr, max_batch_size=8, batch_timeout_ms=50,
+                       queue_limit=64)
+    eng.predict(toy_rows(1))  # warm the compile path first
+    faults.injector().latency_s = 0.3
+    faults.install("serve.batch:latency:1")
+    box = {}
+    ready = threading.Event()
+
+    def _run():
+        serve.serve_forever(
+            eng, port=0, drain_timeout_s=10.0,
+            ready_fn=lambda h: (box.update(httpd=h), ready.set()),
+        )
+        box["returned"] = True
+
+    srv = threading.Thread(target=_run, daemon=True)
+    srv.start()
+    assert ready.wait(10)
+    httpd = box["httpd"]
+    port = httpd.server_port
+    n = 8
+    results, errors = [None] * n, [None] * n
+
+    def _req(i):
+        try:
+            results[i] = _post(port, "/predict",
+                               {"data": toy_rows(1, seed=i).tolist()})
+        except Exception as e:  # noqa: BLE001 - recorded for the assert
+            errors[i] = e
+
+    threads = [threading.Thread(target=_req, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    # wait until every request is admitted and in flight, then shut down
+    deadline = time.time() + 5
+    while time.time() < deadline and httpd.inflight.count < n:
+        time.sleep(0.005)
+    assert httpd.inflight.count > 0, "requests never went in flight"
+    httpd.shutdown()
+    for t in threads:
+        t.join(timeout=15)
+    srv.join(timeout=15)
+    assert box.get("returned"), "serve_forever did not return"
+    assert errors == [None] * n, f"dropped in-flight requests: {errors}"
+    assert all(r is not None and len(r["pred"]) == 1 for r in results)
+    faults.reset()
+    eng.close()
+
+
+def test_reload_breaker_keeps_old_model_serving(tmp_path):
+    """A checkpoint that validates (CRC-correct) but fails to LOAD must
+    not take the server down: the breaker opens after the configured
+    consecutive failures, the old model keeps answering, /healthz turns
+    degraded and /statsz counts the failures; a later good checkpoint
+    recovers through the half-open trial."""
+    from cxxnet_tpu.utils import checkpoint as ckpt
+
+    mdir = str(tmp_path / "models")
+    tr1 = make_trainer(seed=1)
+    _save_round(tr1, mdir, 1)
+    eng = serve.Engine(cfg=MLP_CFG, model_dir=mdir, max_batch_size=8,
+                       batch_timeout_ms=0, reload_breaker_threshold=2,
+                       reload_breaker_cooldown_s=30.0)
+    try:
+        x = toy_rows(4)
+        p1 = eng.submit(x, kind="scores")
+        # round 2: garbage payload WITH a consistent manifest — passes
+        # validation, explodes in load_model
+        os.makedirs(mdir, exist_ok=True)
+        ckpt.write_checkpoint(os.path.join(mdir, "0002.model"),
+                              b"not a model at all", round_=2, silent=True)
+        assert not eng.try_reload()
+        assert eng.reload_breaker.state == "closed"  # 1 of 2 failures
+        assert not eng.try_reload()
+        assert eng.reload_breaker.state == "open"
+        h = eng.healthz()
+        assert h["status"] == "degraded" and h["round"] == 1
+        np.testing.assert_array_equal(eng.submit(x, kind="scores"), p1)
+        st = eng.snapshot_stats()
+        assert st["reload_failures"] == 2
+        assert st["last_reload_ok"] is False
+        assert st["reload_breaker"]["state"] == "open"
+        # while open, polls don't even attempt the reload
+        assert not eng.try_reload()
+        assert st["reload_failures"] == eng.snapshot_stats()["reload_failures"]
+        # a good round 3 lands; cooldown expires → half-open trial swaps
+        _save_round(make_trainer(seed=3), mdir, 3)
+        eng.reload_breaker.cooldown_s = 0.0
+        assert eng.try_reload()
+        assert eng.round == 3
+        assert eng.healthz()["status"] == "ok"
+        assert eng.snapshot_stats()["reload_swaps"] == 1
+        assert not np.array_equal(eng.submit(x, kind="scores"), p1)
+    finally:
+        eng.close()
 
 
 # ----------------------------------------------------------------------

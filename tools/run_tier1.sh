@@ -5,6 +5,8 @@
 # the full suite instead.
 #
 # Usage: tools/run_tier1.sh [extra pytest args...]
+#        CHAOS=1 tools/run_tier1.sh   # also run the fault-matrix chaos
+#                                     # suite (tools/chaos_run.sh) after
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
@@ -13,4 +15,8 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
   "$@" 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+if [ "${CHAOS:-0}" = "1" ]; then
+  echo "=== opt-in chaos stage (CHAOS=1) ==="
+  tools/chaos_run.sh || rc=1
+fi
 exit $rc
