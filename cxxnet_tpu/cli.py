@@ -174,9 +174,12 @@ class LearnTask:
         maybe_init_distributed(self.cfg)
         # arm the chaos harness (no-op without fault_inject keys); the
         # instrumented sites live in io/, utils/checkpoint.py and serve/
-        from .utils import faults
+        from .utils import compile_cache, faults
 
         faults.configure(self.cfg)
+        # persistent XLA compile cache (compile_cache_dir): enabled
+        # before ANY jit of this run so every task's programs hit it
+        compile_cache.configure(self.cfg, silent=bool(self.silent))
         if self.task not in ("train", "finetune", "pred", "pred_raw",
                              "extract", "generate", "summary", "serve"):
             raise ValueError(f"unknown task {self.task!r}")
@@ -597,12 +600,20 @@ class LearnTask:
             print(f"update round {self.start_counter - 1}", flush=True)
         from .parallel.distributed import process_info
 
+        from .utils.profiler import pipeline_stats
+
         check_preempt = process_info()[1] == 1
         preempted = False
         sample_counter = 0
         self.net_trainer.start_round(self.start_counter)
         self.itr_train.before_first()
+        # anchor the augmentation epoch to the ROUND counter (after the
+        # rewind, overriding the process-local epoch count): a resumed
+        # run's round r then draws the identical stream an uninterrupted
+        # run drew at round r (io/augment.py `augment_epoch`)
+        self.itr_train.set_param("augment_epoch", str(self.start_counter))
         timer.clear()
+        pipeline_stats().reset()  # per-round stage breakdown
         pipe_mark = time.perf_counter()  # last fence (lap start)
         pending: List = []  # scan_steps>1: batches staged for ONE dispatch
         in_flight: List = []  # async (handle, n_steps) chunks in flight
@@ -626,7 +637,12 @@ class LearnTask:
 
             while len(in_flight) > (0 if drain_all else 1):
                 handle, ns = in_flight.pop(0)
+                t0 = time.perf_counter()
                 _jx.block_until_ready(handle)
+                pipeline_stats().add(
+                    "device_wait", time.perf_counter() - t0,
+                    rows=ns * self.net_trainer.batch_size,
+                )
                 _lap(ns)
 
         def _flush_pending() -> None:
@@ -660,7 +676,12 @@ class LearnTask:
                     _DB(data=pending[0][0], label=pending[0][1])
                 )
                 if not sync_mode:
+                    t0 = time.perf_counter()
                     self.net_trainer.sync()
+                    pipeline_stats().add(
+                        "device_wait", time.perf_counter() - t0,
+                        rows=self.net_trainer.batch_size,
+                    )
                     _lap(1)
             else:
                 import numpy as _np
@@ -739,6 +760,16 @@ class LearnTask:
         _drain_in_flight()  # round/preemption boundary: queue empty
         if preempted:
             return False
+        stage_line = pipeline_stats().report()
+        if not self.silent and stage_line:
+            # per-stage host-pipeline breakdown (decode/augment/batch/
+            # h2d/device_wait) — prints in test_io dry-runs too, where
+            # it IS the measurement
+            print(
+                f"round {self.start_counter - 1:8d} pipeline: "
+                + stage_line,
+                flush=True,
+            )
         if self.test_io == 0:
             if not self.silent and timer.count:
                 print(

@@ -12,19 +12,137 @@ Channel-order note: the reference decodes with OpenCV (BGR) and parses
 ``mean_value = b,g,r``; this framework stores RGB, and ``mean_value`` is
 applied in the file order to channels ``(2, 1, 0)`` so the same config
 subtracts the same per-channel values.
+
+Determinism contract (doc/performance.md "Host input pipeline"): every
+random draw for a record comes from a private ``RandomState`` seeded by
+``(seed_data, epoch, record index)`` — there is NO shared mutable RNG.
+The augmentation stream therefore depends only on the record sequence,
+never on decode worker count, buffer depth, chunking, or where within
+an epoch a run was resumed: serial and parallel pipelines produce
+bitwise-identical batches (``tests/test_host_pipeline.py``).
+
+The no-affine common case additionally has a whole-batch vectorized
+fast path (:meth:`AugmentIterator.augment_batch`): crop / mirror /
+mean-subtract / contrast / illumination / scale as batch-level numpy
+ops over a uniform ``(N, H, W, C)`` stack, bitwise-identical to the
+per-record path.  The parallel decode pool (``io/pipeline.py``) and
+the first-run mean-image pass both run through it.
 """
 
 from __future__ import annotations
 
 import math
 import os
-from typing import List, Optional
+import time
+from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..utils.profiler import pipeline_stats
 from .batch import DataInst, InstIterator
 
 _RAND_MAGIC = 111
+
+#: epoch index for draws made outside the training epoch sequence (the
+#: first-run mean-image pass).  Training epochs start at 1 (the first
+#: ``before_first`` of the chain), so 0 never collides.
+MEAN_PASS_EPOCH = 0
+
+_M64 = (1 << 64) - 1
+_SLOT_ODD = 0x9E3779B97F4A7C15  # golden-ratio odd constant
+
+
+def _splitmix64(z: int) -> int:
+    """SplitMix64 finalizer (python-int form, exact 64-bit wrap)."""
+    z = (z + 0x9E3779B97F4A7C15) & _M64
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _M64
+    return z ^ (z >> 31)
+
+
+def _splitmix64_vec(z: np.ndarray) -> np.ndarray:
+    """SplitMix64 over a uint64 array (wrapping arithmetic)."""
+    z = (z + np.uint64(0x9E3779B97F4A7C15))
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+def record_key(seed_base: int, epoch: int, index: int) -> int:
+    """The record's 64-bit RNG key: a SplitMix64 chain over
+    ``(seed_data, epoch, record index)``."""
+    h = _splitmix64(seed_base & _M64)
+    h = _splitmix64(h ^ (epoch & _M64))
+    return _splitmix64(h ^ (index & _M64))
+
+
+def record_key_vec(seed_base: int, epoch: int,
+                   indices: np.ndarray) -> np.ndarray:
+    """Vectorized :func:`record_key` over an index array."""
+    h = _splitmix64(seed_base & _M64)
+    h = _splitmix64(h ^ (epoch & _M64))
+    return _splitmix64_vec(np.uint64(h) ^ indices.astype(np.uint64))
+
+
+def _slot_hash_vec(keys: np.ndarray, slot: int) -> np.ndarray:
+    return _splitmix64_vec(keys ^ np.uint64((slot * _SLOT_ODD) & _M64))
+
+
+def _u53(h) -> np.ndarray:
+    """uint64 hash → uniform float64 in [0, 1) (53 mantissa bits)."""
+    return (h >> np.uint64(11)) * (1.0 / (1 << 53))
+
+
+# Fixed draw-slot assignments: every random decision of a record has a
+# NAMED slot, so any pipeline stage — serial loop, vectorized batch,
+# PIL-side decode worker, consumer-side float tail — can (re)compute
+# exactly the draw it needs from ``(seed_data, epoch, index, slot)``
+# without any other stage having run first.
+S_CROP_Y = 0
+S_CROP_X = 1
+S_CONTRAST = 2
+S_ILLUM = 3
+S_MIRROR = 4
+S_AFF_ANGLE = 8
+S_AFF_ROTPICK = 9
+S_AFF_SHEAR = 10
+S_AFF_SCALE = 11
+S_AFF_RATIO = 12
+S_AFF_CSIZE = 13
+S_AFF_CS_Y = 14
+S_AFF_CS_X = 15
+
+
+class RecordRNG:
+    """Stateless per-record RNG: draw ``slot`` of record ``r`` is a pure
+    hash of ``(seed_data, epoch, record index, slot)`` — no shared or
+    sequential state, ~1 µs per draw (a seeded ``RandomState``/
+    ``Philox`` object costs 30-150 µs to CONSTRUCT, which at JPEG-decode
+    rates was itself a pipeline stage).  Slot draws vectorize exactly
+    (:func:`_slot_hash_vec`), and fixed slot numbers mean different
+    pipeline stages can recompute each other's draws independently."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key: int) -> None:
+        self.key = key
+
+    def _hash(self, slot: int) -> int:
+        return _splitmix64(self.key ^ ((slot * _SLOT_ODD) & _M64))
+
+    def rand(self, slot: int) -> float:
+        """Uniform float64 in [0, 1)."""
+        return (self._hash(slot) >> 11) * (1.0 / (1 << 53))
+
+    def uniform(self, slot: int, lo: float = 0.0, hi: float = 1.0) -> float:
+        return lo + (hi - lo) * self.rand(slot)
+
+    def randint(self, slot: int, lo: int, hi: Optional[int] = None) -> int:
+        """Integer in [lo, hi) (or [0, lo) with one argument) — modulo
+        reduction; the negligible bias is part of the defined stream."""
+        if hi is None:
+            lo, hi = 0, lo
+        return lo + self._hash(slot) % (hi - lo)
 
 
 class AugmentIterator(InstIterator):
@@ -58,7 +176,8 @@ class AugmentIterator(InstIterator):
         self.min_img_size = 0.0
         self.max_img_size = 1e10
         self.fill_value = 255
-        self._rng = np.random.RandomState(_RAND_MAGIC)
+        self._seed_base = _RAND_MAGIC
+        self._epoch = 0          # bumped by every before_first()
         self._meanimg: Optional[np.ndarray] = None
         self._out: Optional[DataInst] = None
 
@@ -68,7 +187,15 @@ class AugmentIterator(InstIterator):
             c, h, w = (int(t) for t in val.split(","))
             self.shape = (c, h, w)
         elif name == "seed_data":
-            self._rng = np.random.RandomState(_RAND_MAGIC + int(val))
+            self._seed_base = (_RAND_MAGIC + int(val)) & 0xFFFFFFFF
+        elif name == "augment_epoch":
+            # absolute epoch anchor: the task driver re-issues this
+            # AFTER each round's before_first() with the ROUND counter,
+            # so a preemption resume at round r draws the exact same
+            # augmentation stream as an uninterrupted run's round r —
+            # epochs are then a property of training progress, not of
+            # how many times this process happened to rewind
+            self._epoch = int(val)
         elif name == "rand_crop":
             self.rand_crop = int(val)
         elif name == "rand_mirror":
@@ -120,6 +247,50 @@ class AugmentIterator(InstIterator):
             self.silent = int(val)
 
     # ------------------------------------------------------------------
+    # deterministic per-record RNG
+    @property
+    def epoch(self) -> int:
+        """Current epoch index (count of ``before_first`` calls)."""
+        return self._epoch
+
+    def record_rng(self, epoch: int, index: int) -> RecordRNG:
+        """The record's private RNG: keyed by ``(seed_data, epoch,
+        record index)``, so the same record in the same epoch draws the
+        same augmentation no matter which worker processes it, in what
+        order, or whether the epoch was restarted mid-way."""
+        return RecordRNG(record_key(self._seed_base, epoch, index))
+
+    def _affine_active(self) -> bool:
+        """True when :meth:`_affine` would do work (and draw from the
+        record RNG) — the inverse of its early-return condition."""
+        return not (
+            self.max_rotate_angle <= 0
+            and self.max_shear_ratio <= 0
+            and self.max_aspect_ratio <= 0
+            and self.rotate < 0
+            and not self.rotate_list
+            and self.min_random_scale == 1.0
+            and self.max_random_scale == 1.0
+            and self.min_crop_size <= 0
+        )
+
+    def _stochastic(self) -> bool:
+        """Does augmenting a record consume any random draw?"""
+        return (
+            self._affine_active()
+            or bool(self.rand_crop)
+            or bool(self.rand_mirror)
+            or self.max_random_contrast > 0
+            or self.max_random_illumination > 0
+        )
+
+    def vectorizable(self) -> bool:
+        """True when the whole-batch fast path applies: no affine warp
+        (everything else — crop / mirror / mean / contrast /
+        illumination / scale — vectorizes exactly)."""
+        return not self._affine_active()
+
+    # ------------------------------------------------------------------
     def init(self):
         self.base.init()
         if self.name_meanimg:
@@ -132,14 +303,31 @@ class AugmentIterator(InstIterator):
                 self._create_mean_img()
 
     def _create_mean_img(self):
+        """First-run mean image, computed through the vectorized batch
+        path in ONE pre-pool pass (chunks of decoded records are
+        augmented as a stack), so ``image_mean`` creation does not
+        serialize the first epoch record by record.  The per-record
+        float64 accumulation order matches the legacy serial loop."""
         if not self.silent:
             print(f"cannot find {self.name_meanimg}: creating mean image...")
         total, cnt = None, 0
+        chunk = 64
         self.base.before_first()
-        while self.base.next():
-            d = self._augmented(self.base.value(), apply_mean=False)
-            total = d.data.astype(np.float64) if total is None else total + d.data
-            cnt += 1
+        more = True
+        while more:
+            insts: List[DataInst] = []
+            while len(insts) < chunk:
+                if not self.base.next():
+                    more = False
+                    break
+                insts.append(self.base.value())
+            if not insts:
+                break
+            for d in self.augment_insts(insts, MEAN_PASS_EPOCH,
+                                        apply_mean=False):
+                total = (d.data.astype(np.float64) if total is None
+                         else total + d.data)
+                cnt += 1
         if total is None:
             raise ValueError("AugmentIterator: empty input, cannot build mean image")
         self._meanimg = (total / cnt).astype(np.float32)
@@ -149,12 +337,18 @@ class AugmentIterator(InstIterator):
         self.base.before_first()
 
     def before_first(self):
+        self._epoch += 1
         self.base.before_first()
 
     def next(self) -> bool:
         if not self.base.next():
             return False
-        self._out = self._augmented(self.base.value(), apply_mean=True)
+        d = self.base.value()
+        t0 = time.perf_counter()
+        rng = (self.record_rng(self._epoch, d.index)
+               if self._stochastic() else None)
+        self._out = self._augmented(d, apply_mean=True, rng=rng)
+        pipeline_stats().add("augment", time.perf_counter() - t0)
         return True
 
     def value(self) -> DataInst:
@@ -165,32 +359,29 @@ class AugmentIterator(InstIterator):
         self.base.close()
 
     # ------------------------------------------------------------------
-    def _affine(self, img: np.ndarray) -> np.ndarray:
+    def _affine(self, img: np.ndarray, rng) -> np.ndarray:
         """Rotation/shear/scale/aspect as one warp (image_augmenter:75-123)."""
-        if (
-            self.max_rotate_angle <= 0
-            and self.max_shear_ratio <= 0
-            and self.max_aspect_ratio <= 0
-            and self.rotate < 0
-            and not self.rotate_list
-            and self.min_random_scale == 1.0
-            and self.max_random_scale == 1.0
-            and self.min_crop_size <= 0
-        ):
+        if not self._affine_active():
             return img
         from PIL import Image
 
-        rng = self._rng
         angle = 0.0
         if self.max_rotate_angle > 0:
-            angle = rng.uniform(-self.max_rotate_angle, self.max_rotate_angle)
+            angle = rng.uniform(S_AFF_ANGLE, -self.max_rotate_angle,
+                                self.max_rotate_angle)
         if self.rotate > 0:
             angle = self.rotate
         if self.rotate_list:
-            angle = float(self.rotate_list[rng.randint(len(self.rotate_list))])
-        s = rng.uniform(-self.max_shear_ratio, self.max_shear_ratio) if self.max_shear_ratio > 0 else 0.0
-        scale = rng.uniform(self.min_random_scale, self.max_random_scale)
-        ratio = rng.uniform(-self.max_aspect_ratio, self.max_aspect_ratio) + 1.0 if self.max_aspect_ratio > 0 else 1.0
+            angle = float(self.rotate_list[
+                rng.randint(S_AFF_ROTPICK, len(self.rotate_list))])
+        s = (rng.uniform(S_AFF_SHEAR, -self.max_shear_ratio,
+                         self.max_shear_ratio)
+             if self.max_shear_ratio > 0 else 0.0)
+        scale = rng.uniform(S_AFF_SCALE, self.min_random_scale,
+                            self.max_random_scale)
+        ratio = (rng.uniform(S_AFF_RATIO, -self.max_aspect_ratio,
+                             self.max_aspect_ratio) + 1.0
+                 if self.max_aspect_ratio > 0 else 1.0)
         hs = 2.0 * scale / (1.0 + ratio)
         ws = ratio * hs
         a = math.cos(math.radians(angle))
@@ -231,10 +422,11 @@ class AugmentIterator(InstIterator):
             out = out[..., None]
         # random crop-size: crop a random square then resize back (bowl.conf)
         if self.min_crop_size > 0 and self.max_crop_size >= self.min_crop_size:
-            cs = rng.randint(self.min_crop_size, self.max_crop_size + 1)
+            cs = rng.randint(S_AFF_CSIZE, self.min_crop_size,
+                             self.max_crop_size + 1)
             cs = min(cs, out.shape[0], out.shape[1])
-            yy = rng.randint(out.shape[0] - cs + 1)
-            xx = rng.randint(out.shape[1] - cs + 1)
+            yy = rng.randint(S_AFF_CS_Y, out.shape[0] - cs + 1)
+            xx = rng.randint(S_AFF_CS_X, out.shape[1] - cs + 1)
             patch = out[yy : yy + cs, xx : xx + cs]
             if mode == "RGB":
                 pim2 = Image.fromarray(np.clip(patch, 0, 255).astype(np.uint8), "RGB")
@@ -245,23 +437,26 @@ class AugmentIterator(InstIterator):
                 out = np.asarray(pim2, np.float32)[..., None]
         return out
 
-    def _augmented(self, d: DataInst, *, apply_mean: bool) -> DataInst:
-        """SetData parity (iter_augment_proc-inl.hpp:98-162), HWC layout."""
+    def _augmented(self, d: DataInst, *, apply_mean: bool,
+                   rng=None) -> DataInst:
+        """SetData parity (iter_augment_proc-inl.hpp:98-162), HWC layout.
+
+        ``rng`` is the record's private RandomState (None when no random
+        augmentation is armed — no draw then happens)."""
         c, th, tw = self.shape
         data = d.data.astype(np.float32)
         if c == 1 and th == 1:
             return DataInst(d.index, data.reshape(-1) * self.scale, d.label)
         if data.ndim == 2:
             data = data[..., None]
-        data = self._affine(data)
-        rng = self._rng
+        data = self._affine(data, rng)
         h, w = data.shape[:2]
         if h < th or w < tw:
             raise ValueError("data size must be at least the net input size")
         yy_max, xx_max = h - th, w - tw
         if self.rand_crop and (yy_max or xx_max):
-            yy = rng.randint(yy_max + 1)
-            xx = rng.randint(xx_max + 1)
+            yy = rng.randint(S_CROP_Y, yy_max + 1)
+            xx = rng.randint(S_CROP_X, xx_max + 1)
         else:
             yy, xx = yy_max // 2, xx_max // 2
         if h != th and self.crop_y_start != -1:
@@ -271,12 +466,15 @@ class AugmentIterator(InstIterator):
         contrast = 1.0
         illumination = 0.0
         if self.max_random_contrast > 0:
-            contrast = rng.uniform(1 - self.max_random_contrast, 1 + self.max_random_contrast)
+            contrast = rng.uniform(S_CONTRAST, 1 - self.max_random_contrast,
+                                   1 + self.max_random_contrast)
         if self.max_random_illumination > 0:
             illumination = rng.uniform(
-                -self.max_random_illumination, self.max_random_illumination
+                S_ILLUM, -self.max_random_illumination,
+                self.max_random_illumination,
             )
-        do_mirror = self.mirror == 1 or (self.rand_mirror and rng.rand() < 0.5)
+        do_mirror = self.mirror == 1 or (
+            self.rand_mirror and rng.rand(S_MIRROR) < 0.5)
 
         if apply_mean and self.mean_value is not None:
             data = data - self.mean_value[: data.shape[2]]
@@ -295,3 +493,247 @@ class AugmentIterator(InstIterator):
         if do_mirror:
             img = img[:, ::-1]
         return DataInst(d.index, np.ascontiguousarray(img) * self.scale, d.label)
+
+    # ------------------------------------------------------------------
+    # whole-batch vectorized fast path
+    def augment_insts(self, insts: Sequence[DataInst], epoch: int, *,
+                      apply_mean: bool = True) -> List[DataInst]:
+        """Augment a window of records, vectorized when possible.
+
+        Uses :meth:`augment_batch` when no affine warp is armed and the
+        decoded images share one shape; falls back to the per-record
+        path otherwise.  Either way the output is bitwise-identical to
+        calling :meth:`_augmented` record by record — the random draws
+        come from the same per-record RNGs."""
+        if not insts:
+            return []
+        c, th, tw = self.shape
+        flat = c == 1 and th == 1
+        shapes = {tuple(d.data.shape) for d in insts}
+        if (not flat and len(shapes) == 1 and self.vectorizable()
+                and len(next(iter(shapes))) >= 2):
+            # native dtype (uint8 from the decoder): float32 conversion
+            # happens during the crop copy — exact, 4x less bandwidth
+            stack = np.stack([
+                d.data if d.data.ndim == 3 else d.data[..., None]
+                for d in insts
+            ])
+            out = self.augment_batch(
+                stack, [d.index for d in insts], epoch,
+                apply_mean=apply_mean,
+            )
+            return [DataInst(d.index, out[i], d.label)
+                    for i, d in enumerate(insts)]
+        out_insts = []
+        for d in insts:
+            rng = (self.record_rng(epoch, d.index)
+                   if self._stochastic() else None)
+            out_insts.append(self._augmented(d, apply_mean=apply_mean,
+                                             rng=rng))
+        return out_insts
+
+    def augment_batch(self, stack: np.ndarray, indices: Sequence[int],
+                      epoch: int, *, apply_mean: bool = True) -> np.ndarray:
+        """Vectorized ``_augmented`` over a uniform ``(N, H, W, C)``
+        stack (uint8 or float32) — the no-affine fast path: crop,
+        mirror, mean-subtract, contrast, illumination and scale as
+        batch-level numpy ops, float32 out.  Bitwise-identical to the
+        per-record path: the draws come from the same per-record slot
+        hashes (vectorized here), uint8→float32 conversion is exact on
+        either side of the crop, and every float op is the same
+        elementwise float32 operation in the same order."""
+        assert self.vectorizable(), "affine warp has no batch path"
+        n, h, w, cdim = stack.shape
+        _, th, tw = self.shape
+        if h < th or w < tw:
+            raise ValueError("data size must be at least the net input size")
+        yy_max, xx_max = h - th, w - tw
+        yy = np.full(n, yy_max // 2, np.intp)
+        xx = np.full(n, xx_max // 2, np.intp)
+        contrast = None
+        illum = None
+        do_mirror = np.full(n, self.mirror == 1)
+        # per-record fixed-slot draws, vectorized — the same hashes the
+        # per-record RecordRNG computes in _augmented
+        if self._stochastic():
+            keys = record_key_vec(
+                self._seed_base, epoch,
+                np.asarray(indices, np.int64).astype(np.uint64),
+            )
+            if self.rand_crop and (yy_max or xx_max):
+                yy = (_slot_hash_vec(keys, S_CROP_Y)
+                      % np.uint64(yy_max + 1)).astype(np.intp)
+                xx = (_slot_hash_vec(keys, S_CROP_X)
+                      % np.uint64(xx_max + 1)).astype(np.intp)
+            if self.max_random_contrast > 0:
+                lo, hi = (1 - self.max_random_contrast,
+                          1 + self.max_random_contrast)
+                contrast = lo + (hi - lo) * _u53(
+                    _slot_hash_vec(keys, S_CONTRAST))
+            if self.max_random_illumination > 0:
+                lo, hi = (-self.max_random_illumination,
+                          self.max_random_illumination)
+                illum = lo + (hi - lo) * _u53(_slot_hash_vec(keys, S_ILLUM))
+            if self.mirror != 1 and self.rand_mirror:
+                do_mirror = _u53(_slot_hash_vec(keys, S_MIRROR)) < 0.5
+        if h != th and self.crop_y_start != -1:
+            yy[:] = self.crop_y_start
+        if w != tw and self.crop_x_start != -1:
+            xx[:] = self.crop_x_start
+
+        # crop + mirror in ONE cast-copy per record: the mirrored
+        # records read their window with a reversed W stride, so the
+        # uint8→float32 conversion, the crop copy, and the flip are a
+        # single pass (an in-place ``out[m] = out[m, :, ::-1]`` is ~6x
+        # slower — overlapping-buffer reversal takes numpy's buffered
+        # path).  Mirroring commutes with every elementwise op below,
+        # so doing it first is bitwise-identical to the per-record
+        # order (jitter, then flip).
+        out = np.empty((n, th, tw, cdim), np.float32)
+        for i in range(n):
+            win = stack[i, yy[i]: yy[i] + th, xx[i]: xx[i] + tw]
+            out[i] = win[:, ::-1] if do_mirror[i] else win
+
+        jitter = False
+        if apply_mean and self.mean_value is not None:
+            out -= self.mean_value[:cdim]  # per-channel: flip-invariant
+            jitter = True
+        elif apply_mean and self._meanimg is not None:
+            if self._meanimg.shape == stack.shape[1:]:
+                # mean is full-size: subtract each record's crop
+                # window, mirrored along with the record
+                for i in range(n):
+                    mwin = self._meanimg[yy[i]: yy[i] + th,
+                                         xx[i]: xx[i] + tw]
+                    out[i] -= mwin[:, ::-1] if do_mirror[i] else mwin
+            elif self._meanimg.shape == out.shape[1:]:
+                for i in range(n):
+                    out[i] -= (self._meanimg[:, ::-1] if do_mirror[i]
+                               else self._meanimg)
+            jitter = True
+        if jitter:
+            # float32-cast per-record scalars: elementwise identical to
+            # the serial path's python-float (weak-promotion) arithmetic
+            if contrast is not None:
+                out *= contrast.astype(np.float32)[:, None, None, None]
+            if illum is not None:
+                out += illum.astype(np.float32)[:, None, None, None]
+        if self.scale != 1.0:  # x * 1.0 is a bitwise identity
+            out *= np.float32(self.scale)
+        return out
+
+    # ------------------------------------------------------------------
+    # split decode-worker fast path: PIL-side crop+mirror, float tail
+    # on the consumer (io/pipeline.py).  Rationale: a decode worker that
+    # only runs PIL C ops (decode, crop, flip — all GIL-releasing) and
+    # hands back the small uint8 window scales across cores; the float32
+    # arithmetic runs once, vectorized, on the consumer thread.
+    def pil_path_ok(self, apply_mean: bool = True) -> bool:
+        """Can a decode worker run :meth:`augment_pil`?  Static per
+        config: no affine warp, a real 2-D crop target, and no
+        full-image mean (its subtract window needs the pre-crop image
+        size, which the split path no longer has)."""
+        c, th, tw = self.shape
+        if c == 1 and th == 1:
+            return False  # flat vectors never touch PIL
+        if not self.vectorizable():
+            return False
+        if (apply_mean and self._meanimg is not None
+                and self._meanimg.shape != (th, tw, c)):
+            return False
+        return True
+
+    def tail_identity(self, apply_mean: bool = True) -> bool:
+        """True when the post-crop float tail does nothing: the uint8
+        crop IS the augmented record (the batch collator's store-cast
+        to float32 is exact), so nobody pays for a float pass."""
+        return (self.scale == 1.0
+                and self.max_random_contrast <= 0
+                and self.max_random_illumination <= 0
+                and not (apply_mean and (self.mean_value is not None
+                                         or self._meanimg is not None)))
+
+    def augment_pil(self, im, index: int, labels, epoch: int) -> DataInst:
+        """Worker half of the split path: crop + mirror as PIL C-level
+        ops on the decoded uint8 image (bit-exact vs numpy slicing),
+        returning a uint8 ``DataInst``.  Run :meth:`augment_tail` on
+        the result unless :meth:`tail_identity`."""
+        from PIL import Image
+
+        _, th, tw = self.shape
+        w, h = im.size
+        if h < th or w < tw:
+            raise ValueError("data size must be at least the net input size")
+        rng = (self.record_rng(epoch, index) if self._stochastic() else None)
+        yy_max, xx_max = h - th, w - tw
+        if self.rand_crop and (yy_max or xx_max):
+            yy = rng.randint(S_CROP_Y, yy_max + 1)
+            xx = rng.randint(S_CROP_X, xx_max + 1)
+        else:
+            yy, xx = yy_max // 2, xx_max // 2
+        if h != th and self.crop_y_start != -1:
+            yy = self.crop_y_start
+        if w != tw and self.crop_x_start != -1:
+            xx = self.crop_x_start
+        do_mirror = self.mirror == 1 or (
+            self.rand_mirror and rng.rand(S_MIRROR) < 0.5)
+        if (yy, xx) != (0, 0) or (h, w) != (th, tw):
+            im = im.crop((xx, yy, xx + tw, yy + th))
+        if do_mirror:
+            im = im.transpose(Image.FLIP_LEFT_RIGHT)
+        return DataInst(index, np.asarray(im), labels)
+
+    def augment_tail(self, insts: Sequence[DataInst], epoch: int, *,
+                     apply_mean: bool = True) -> List[DataInst]:
+        """Consumer half of the split path: the float32 tail
+        (mean-subtract, contrast/illumination, scale) vectorized over
+        the uniform uint8 crops :meth:`augment_pil` produced.  Bitwise
+        equal to the serial ``_augmented`` tail: the crops are already
+        mirrored, and every tail op commutes with the flip (the
+        crop-sized mean window is flipped to compensate)."""
+        if not insts or self.tail_identity(apply_mean):
+            return list(insts)
+        n = len(insts)
+        out = np.stack([d.data for d in insts]).astype(np.float32)
+        jitter = False
+        if apply_mean and self.mean_value is not None:
+            out -= self.mean_value[: out.shape[3]]
+            jitter = True
+        elif apply_mean and self._meanimg is not None:
+            if self._meanimg.shape == out.shape[1:]:
+                if self.mirror == 1 or self.rand_mirror:
+                    keys = record_key_vec(
+                        self._seed_base, epoch,
+                        np.asarray([d.index for d in insts],
+                                   np.int64).astype(np.uint64),
+                    )
+                    mirrored = (np.full(n, True) if self.mirror == 1
+                                else _u53(_slot_hash_vec(keys, S_MIRROR))
+                                < 0.5)
+                    for i in range(n):
+                        out[i] -= (self._meanimg[:, ::-1] if mirrored[i]
+                                   else self._meanimg)
+                else:
+                    out -= self._meanimg
+            jitter = True
+        if jitter and (self.max_random_contrast > 0
+                       or self.max_random_illumination > 0):
+            keys = record_key_vec(
+                self._seed_base, epoch,
+                np.asarray([d.index for d in insts],
+                           np.int64).astype(np.uint64),
+            )
+            if self.max_random_contrast > 0:
+                lo, hi = (1 - self.max_random_contrast,
+                          1 + self.max_random_contrast)
+                c = lo + (hi - lo) * _u53(_slot_hash_vec(keys, S_CONTRAST))
+                out *= c.astype(np.float32)[:, None, None, None]
+            if self.max_random_illumination > 0:
+                lo, hi = (-self.max_random_illumination,
+                          self.max_random_illumination)
+                v = lo + (hi - lo) * _u53(_slot_hash_vec(keys, S_ILLUM))
+                out += v.astype(np.float32)[:, None, None, None]
+        if self.scale != 1.0:
+            out *= np.float32(self.scale)
+        return [DataInst(d.index, out[i], d.label)
+                for i, d in enumerate(insts)]

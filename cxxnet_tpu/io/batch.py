@@ -20,10 +20,12 @@ iter_batch_proc-inl.hpp:16-128``):
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional
 
 import numpy as np
 
+from ..utils.profiler import pipeline_stats
 from .data import DataBatch, DataIter
 
 
@@ -70,6 +72,7 @@ class BatchAdaptIterator(DataIter):
         self.test_skipread = 0
         self.silent = 0
         self._shape: Optional[tuple] = None  # (C,H,W) net convention
+        self._t_build = 0.0
         self._num_overflow = 0
         self._head = 1
         self._out: Optional[DataBatch] = None
@@ -129,10 +132,16 @@ class BatchAdaptIterator(DataIter):
         self._head = 0
         if self._num_overflow:
             return False
+        # batch-build stage accounting: the time spent collating /
+        # copying instances into the batch buffers, EXCLUDING the base
+        # pulls (those bill to the decode/augment stages)
+        self._t_build = 0.0
         padd = 0
         top = 0
         while self.base.next():
+            t0 = time.perf_counter()
             self._store(top, self.base.value())
+            self._t_build += time.perf_counter() - t0
             top += 1
             if top >= self.batch_size:
                 self._emit(0)
@@ -144,7 +153,9 @@ class BatchAdaptIterator(DataIter):
                 while top < self.batch_size:
                     if not self.base.next():
                         raise ValueError("number of instances must exceed batch size")
+                    t0 = time.perf_counter()
                     self._store(top, self.base.value())
+                    self._t_build += time.perf_counter() - t0
                     top += 1
                     self._num_overflow += 1
                 padd = self._num_overflow
@@ -155,11 +166,17 @@ class BatchAdaptIterator(DataIter):
         return False
 
     def _emit(self, padd: int) -> None:
+        t0 = time.perf_counter()
         self._out = DataBatch(
             data=self._data.copy(),
             label=self._label.copy(),
             inst_index=self._inst.copy(),
             num_batch_padd=padd,
+        )
+        pipeline_stats().add(
+            "batch",
+            self._t_build + (time.perf_counter() - t0),
+            rows=self.batch_size,
         )
 
     def value(self) -> DataBatch:

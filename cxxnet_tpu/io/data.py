@@ -129,6 +129,7 @@ def create_iterator(cfg: Sequence[ConfigEntry]) -> DataIter:
     from .imgbin import ImageBinIterator
     from .membuffer import MemBufferIterator
     from .mnist import MNISTIterator
+    from .pipeline import ParallelAugmentIterator
     from .prefetch import ThreadBufferIterator
     from .synth import SyntheticIterator
     from .attach_txt import AttachTxtIterator
@@ -145,11 +146,16 @@ def create_iterator(cfg: Sequence[ConfigEntry]) -> DataIter:
             elif val in ("imgbin", "imgbinx"):
                 if it is not None:
                     raise ValueError("imgbin cannot chain over another iterator")
-                it = BatchAdaptIterator(AugmentIterator(ImageBinIterator()))
+                # the decode+augment stage parallelizes when the section
+                # sets num_decode_workers > 1 (io/pipeline.py); it is a
+                # transparent pass-through otherwise
+                it = BatchAdaptIterator(ParallelAugmentIterator(
+                    AugmentIterator(ImageBinIterator())))
             elif val == "img":
                 if it is not None:
                     raise ValueError("img cannot chain over another iterator")
-                it = BatchAdaptIterator(AugmentIterator(ImageIterator()))
+                it = BatchAdaptIterator(ParallelAugmentIterator(
+                    AugmentIterator(ImageIterator())))
             elif val == "csv":
                 if it is not None:
                     raise ValueError("csv cannot chain over another iterator")

@@ -36,6 +36,7 @@ from __future__ import annotations
 import io as _io
 import os
 import struct
+import time
 import warnings
 from typing import IO, List, Optional, Tuple
 
@@ -43,6 +44,7 @@ import numpy as np
 
 from ..utils import faults
 from ..utils.faults import BadRecordBudget
+from ..utils.profiler import pipeline_stats
 from .batch import DataInst, InstIterator
 
 PAGE_MAGIC = 0x43584250  # "CXBP"
@@ -155,7 +157,10 @@ def detect_bin_format(path: str) -> str:
 
 
 def iter_ref_bin_pages(path: str):
-    """Yield lists of blobs from a reference-format ``.bin`` (io.h layout)."""
+    """Yield lists of blobs from a reference-format ``.bin`` (io.h layout).
+
+    Page-granular: one 64 MiB read per page; each blob is a zero-copy
+    ``memoryview`` slice of the page buffer (no per-instance copy)."""
     with open(path, "rb") as f:
         while True:
             page = f.read(REF_PAGE_BYTES)
@@ -171,15 +176,23 @@ def iter_ref_bin_pages(path: str):
                 int(offs[-1]) + (nrec + 2) * 4 > REF_PAGE_BYTES
             ):
                 raise ValueError(f"{path}: corrupt page offsets")
+            mv = memoryview(page)
             yield [
-                page[REF_PAGE_BYTES - int(offs[r + 1]):
-                     REF_PAGE_BYTES - int(offs[r])]
+                mv[REF_PAGE_BYTES - int(offs[r + 1]):
+                   REF_PAGE_BYTES - int(offs[r])]
                 for r in range(nrec)
             ]
 
 
 def iter_cxbp_pages(path: str):
-    """Yield lists of blobs, one list per CXBP page (sequential reads)."""
+    """Yield lists of blobs, one list per CXBP page.
+
+    Page-granular: header + length table + ONE read for the whole blob
+    region, then zero-copy ``memoryview`` slices — the old per-blob
+    ``f.read(l)`` did one syscall and one bytes allocation per instance.
+    A truncated final page yields short tail blobs (the downstream
+    decoder fails on them record by record), matching the short-read
+    behavior of the per-blob reads."""
     with open(path, "rb") as f:
         while True:
             hdr = f.read(8)
@@ -188,8 +201,16 @@ def iter_cxbp_pages(path: str):
             magic, nrec = struct.unpack("<II", hdr)
             if magic != PAGE_MAGIC:
                 raise ValueError(f"{path}: bad page magic {magic:#x}")
-            lens = struct.unpack(f"<{nrec}I", f.read(4 * nrec))
-            yield [f.read(l) for l in lens]
+            lens_raw = f.read(4 * nrec)
+            if len(lens_raw) < 4 * nrec:
+                raise ValueError(f"{path}: truncated page length table")
+            lens = struct.unpack(f"<{nrec}I", lens_raw)
+            mv = memoryview(f.read(sum(lens)))
+            out, off = [], 0
+            for l in lens:
+                out.append(mv[off: off + l])
+                off += l
+            yield out
 
 
 def iter_bin_pages(path: str):
@@ -214,15 +235,41 @@ def parse_lst_line(line: str) -> Tuple[int, np.ndarray, str]:
     return idx, labels, parts[-1]
 
 
-def decode_image(blob: bytes) -> np.ndarray:
-    """JPEG/PNG blob → HWC RGB float32 (values 0..255, like the reference's
-    raw decode; scaling is the augmenter's job via ``divideby``/``scale``)."""
+def decode_image(blob) -> np.ndarray:
+    """JPEG/PNG blob (bytes-like) → HWC RGB float32 (values 0..255, like
+    the reference's raw decode; scaling is the augmenter's job via
+    ``divideby``/``scale``)."""
+    return decode_image_u8(blob).astype(np.float32)
+
+
+def decode_image_u8(blob) -> np.ndarray:
+    """JPEG/PNG blob → HWC RGB **uint8**.  The hot decode path: the
+    float32 conversion is deferred to the augmenter (uint8 → float32 is
+    exact, so converting after the crop instead of before it changes no
+    values while moving 4x less memory per record)."""
     from PIL import Image
 
     img = Image.open(_io.BytesIO(blob))
     if img.mode != "RGB":
         img = img.convert("RGB")
-    return np.asarray(img, np.float32)
+    return np.asarray(img)
+
+
+class RawRecord:
+    """One undecoded record: the unit of work the parallel decode pool
+    (``io/pipeline.py``) hands to a worker.  ``source``/``offset`` are
+    the quarantine coordinates for :meth:`ImageBinIterator.record_bad`
+    when the worker's decode fails."""
+
+    __slots__ = ("index", "labels", "payload", "source", "offset")
+
+    def __init__(self, index: int, labels: np.ndarray, payload,
+                 source: str, offset) -> None:
+        self.index = index
+        self.labels = labels
+        self.payload = payload
+        self.source = source
+        self.offset = offset
 
 
 def _count_lst_rows(lst_path: str) -> int:
@@ -511,8 +558,33 @@ class ImageBinIterator(InstIterator):
 
     def _next_python(self) -> bool:
         while True:
-            if self._page_iter is None:
+            rec = self._raw_next()
+            if rec is None:
                 return False
+            try:
+                # float32 here (the iterator's long-standing instance
+                # contract for direct consumers); the pool's worker
+                # paths decode to uint8 instead and convert after the
+                # crop — both are exact, so the streams stay identical
+                t0 = time.perf_counter()
+                data = (self._decode_raw(rec.payload) if self._raw
+                        else decode_image(rec.payload))
+                pipeline_stats().add("decode", time.perf_counter() - t0)
+            except Exception as e:  # noqa: BLE001 - untrusted bytes
+                # corrupt record: quarantine + skip; BadDataError
+                # aborts with a summary once the budget is exhausted
+                self._budget.record(rec.source, rec.offset, e)
+                continue
+            self._out = DataInst(rec.index, data, rec.labels)
+            return True
+
+    def _raw_next(self) -> Optional[RawRecord]:
+        """Next undecoded record of the Python reader (page/shard
+        advance, page-level quarantine, ``imgbin.record`` fault point —
+        everything except the decode).  None at epoch end."""
+        while True:
+            if self._page_iter is None:
+                return None
             bin_path = self._shards[self._shard_pos][0]
             if self._page_pos < len(self._page):
                 blob = self._page[self._page_pos]
@@ -520,19 +592,11 @@ class ImageBinIterator(InstIterator):
                 rec = self._rec_pos
                 idx, labels = self._records[rec]
                 self._rec_pos += 1
-                try:
-                    blob = faults.fault_point("imgbin.record", blob)
-                    if self._raw:
-                        data = self._decode_raw(blob)
-                    else:
-                        data = decode_image(blob)
-                except Exception as e:  # noqa: BLE001 - untrusted bytes
-                    # corrupt record: quarantine + skip; BadDataError
-                    # aborts with a summary once the budget is exhausted
-                    self._budget.record(bin_path, rec, e)
-                    continue
-                self._out = DataInst(idx, data, labels)
-                return True
+                # the fault draw happens HERE, on the consumer thread in
+                # record order, so chaos schedules replay independently
+                # of decode worker count/interleaving
+                blob = faults.fault_point("imgbin.record", blob)
+                return RawRecord(idx, labels, blob, bin_path, rec)
             try:
                 faults.fault_point("imgbin.page")
                 self._page = next(self._page_iter)
@@ -541,7 +605,7 @@ class ImageBinIterator(InstIterator):
                 self._shard_pos += 1
                 self._open_shard(self._shard_pos)
                 if self._shard_pos >= len(self._shards):
-                    return False
+                    return None
             except (OSError, ValueError) as e:
                 # corrupt/unreadable page: past this point the shard's
                 # blob↔label alignment is unrecoverable, so quarantine
@@ -555,7 +619,84 @@ class ImageBinIterator(InstIterator):
                 self._shard_pos += 1
                 self._open_shard(self._shard_pos)
                 if self._shard_pos >= len(self._shards):
-                    return False
+                    return None
+
+    # ------------------------------------------------------------------
+    # raw-record API for the parallel decode pool (io/pipeline.py)
+    def raw_available(self) -> bool:
+        """True when :meth:`next_raw` can feed the pool: the pure-Python
+        reader path (the native reader decodes on its own C++ pool and
+        yields only decoded instances)."""
+        return self._native is None
+
+    @property
+    def epoch_cap(self) -> int:
+        """Distributed equal-steps cap on instances per epoch (0 = no
+        cap).  In raw mode the POOL enforces it on decoded successes —
+        the exact semantics of the serial ``next()`` counter."""
+        return self._epoch_cap
+
+    def next_raw(self) -> Optional[RawRecord]:
+        """Pool-facing: next undecoded record, or None at source end.
+        The epoch skip summary is NOT printed here — decode failures
+        from in-flight chunks are still unaccounted at raw exhaustion;
+        the pool calls :meth:`note_epoch_end` once fully drained."""
+        return self._raw_next()
+
+    def note_epoch_end(self) -> None:
+        """Pool-facing epoch close: print the skip/quarantine summary
+        (the serial ``next()`` contract) now that every worker decode
+        failure has been recorded by the consumer."""
+        if (self._budget is not None and self._budget.epoch_count
+                and not self.silent):
+            print(self._budget.summary(), flush=True)
+
+    def next_raw_block(self, k: int) -> List[RawRecord]:
+        """Up to ``k`` raw records in one call (the pool's chunk fetch
+        — one method dispatch per chunk instead of per record)."""
+        out: List[RawRecord] = []
+        while len(out) < k:
+            rec = self.next_raw()
+            if rec is None:
+                break
+            out.append(rec)
+        return out
+
+    def decode_record(self, rec: RawRecord) -> np.ndarray:
+        """Decode one raw record — a pure function of the payload, safe
+        to call concurrently from pool workers."""
+        t0 = time.perf_counter()
+        if self._raw:
+            data = self._decode_raw(rec.payload)
+        else:
+            # uint8: the augmenter converts (exactly) after cropping
+            data = decode_image_u8(rec.payload)
+        pipeline_stats().add("decode", time.perf_counter() - t0)
+        return data
+
+    def pil_available(self) -> bool:
+        """True when records are encoded images :meth:`decode_pil` can
+        produce (raw float blobs have no PIL form)."""
+        return not self._raw
+
+    def decode_pil(self, rec: RawRecord):
+        """Decode one record to a loaded RGB PIL image (the split
+        worker path: crop/flip then happen as PIL C ops).  Pure
+        function of the payload — pool-worker safe.  The caller times
+        the whole chunk (one stats add per chunk, not per record)."""
+        from PIL import Image
+
+        im = Image.open(_io.BytesIO(rec.payload))
+        if im.mode != "RGB":
+            im = im.convert("RGB")
+        im.load()
+        return im
+
+    def record_bad(self, source: str, offset, exc: BaseException) -> None:
+        """Quarantine accounting for a worker-side decode failure;
+        called by the pool CONSUMER in record order (the budget is
+        single-threaded by design)."""
+        self._budget.record(source, offset, exc)
 
     @staticmethod
     def _decode_raw(blob: bytes) -> np.ndarray:

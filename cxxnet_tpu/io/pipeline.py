@@ -1,0 +1,437 @@
+"""Parallel host data pipeline: ordered multi-worker decode + augment.
+
+The device hot path is one fused, donated, optionally scanned SPMD
+program (``nnet/trainer.py``); past ~2000 img/s the bottleneck is the
+HOST — a single Python thread doing per-instance JPEG decode + augment
+behind the ``iter = threadbuffer`` producer.  This stage parallelizes
+exactly that work, the way the TensorFlow paper's parallel input
+pipelines do (PAPERS.md, Abadi et al. 2016 §4.2), while keeping the
+augmentation stream **bitwise deterministic**:
+
+* ``num_decode_workers = N`` (N > 1) starts N daemon worker threads.
+  PIL's JPEG decode and numpy's array ops release the GIL, so a thread
+  pool — not processes — already scales across cores with zero IPC.
+* Records are fetched from the source ON THE CONSUMER thread in epoch
+  order (so fault-injection draws, quarantine accounting, and the
+  distributed epoch cap replay exactly like the serial path), grouped
+  into chunks, and decoded+augmented by the pool; chunk results are
+  consumed strictly in submission order with a bounded in-flight
+  window (``decode_queue_depth`` chunks), so memory stays bounded and
+  output order never depends on worker scheduling.
+* Every record's augmentation draws come from a private RNG seeded by
+  ``(seed_data, epoch, record index)`` (``io/augment.py``), so worker
+  count, chunking, buffer depth, and mid-epoch rewinds cannot change
+  the stream: serial and parallel runs produce bitwise-identical
+  batches (``tests/test_host_pipeline.py``).
+* For encoded-image sources with no affine warp, the work is SPLIT:
+  workers run only GIL-releasing PIL C ops (decode, crop, flip) and
+  return small uint8 windows; the float tail (mean / jitter / scale)
+  runs once, vectorized, on the consumer
+  (``AugmentIterator.augment_pil`` / ``augment_tail``).  Other
+  sources take the array path: workers decode and run the vectorized
+  whole-batch augment (``augment_insts``).
+* A :class:`~cxxnet_tpu.utils.faults.Watchdog` guards the pool: a hung
+  worker (I/O stall, poisoned decode) raises ``WatchdogError`` with
+  the workers' stacks instead of blocking the train loop forever, and
+  the ``pipeline.worker`` fault site makes that path chaos-testable.
+
+With ``num_decode_workers <= 1`` (the default) the stage is a
+transparent pass-through to the serial augment chain.
+
+Wiring (``io/data.py``): ``imgbin``/``img`` chains build
+``BatchAdapt(ParallelAugment(Augment(source)))``; ``iter =
+threadbuffer`` still double-buffers whole batches on top, overlapping
+the whole host stage with device compute.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import sys
+import threading
+import time
+import traceback
+from typing import List, Optional
+
+from ..utils import faults
+from ..utils.faults import Watchdog, WatchdogError
+from ..utils.profiler import pipeline_stats
+from .augment import AugmentIterator
+from .batch import DataInst, InstIterator
+
+
+class _BadRecord:
+    """A worker-side decode failure, relayed to the consumer so the
+    skip-and-quarantine budget stays single-threaded and in order."""
+
+    __slots__ = ("source", "offset", "exc")
+
+    def __init__(self, source, offset, exc) -> None:
+        self.source = source
+        self.offset = offset
+        self.exc = exc
+
+
+class _WorkerError:
+    """A non-record failure inside a worker (bug, injected I/O error):
+    re-raised in the consumer's ``next()``."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException) -> None:
+        self.exc = exc
+
+
+class ParallelAugmentIterator(InstIterator):
+    """Ordered decode+augment pool over an :class:`AugmentIterator`.
+
+    Two source modes, picked at ``init()``:
+
+    * **raw mode** — the augmenter's base exposes the raw-record API
+      (``next_raw``/``decode_record``/``record_bad``; the pure-Python
+      imgbin reader): workers decode AND augment.
+    * **instance mode** — any other base (native reader, ``iter=img``,
+      custom iterators): instances are pulled serially (already
+      decoded) and workers parallelize the augmentation only.
+    """
+
+    def __init__(self, aug: AugmentIterator) -> None:
+        self.aug = aug
+        self.num_workers = 0        # <= 1: serial pass-through
+        self.chunk_size = 24        # records per worker task (measured
+        # knee: big enough to amortize consumer wakeups, small enough
+        # not to churn the cache with idle in-flight output)
+        self.queue_depth = 0        # in-flight chunks; 0 = per-core default
+        self.watchdog_timeout_s = 600.0
+        self.silent = 0
+        self._threads: List[threading.Thread] = []
+        self._in_q: Optional[queue.Queue] = None
+        self._results = {}
+        self._cond = threading.Condition()
+        self._stop = False
+        self._gen = 0
+        self._seq_submit = 0        # next chunk seq to submit
+        self._seq_take = 0          # next chunk seq to consume
+        self._exhausted = False
+        self._pending: List[object] = []
+        self._pending_pos = 0
+        self._yielded = 0           # successes this epoch (epoch_cap)
+        self._raw_source = None     # base when raw mode is active
+        self._pil_mode = False      # split decode-worker/float-tail layout
+        self._cap = 0               # cached epoch_cap (set per epoch)
+        self._watchdog: Optional[Watchdog] = None
+        self._out: Optional[DataInst] = None
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    def supports_dist_shard(self) -> bool:
+        return self.aug.supports_dist_shard()
+
+    def set_param(self, name, val):
+        self.aug.set_param(name, val)
+        if name == "num_decode_workers":
+            self.num_workers = int(val)
+        elif name == "decode_chunk":
+            self.chunk_size = max(1, int(val))
+        elif name == "decode_queue_depth":
+            self.queue_depth = int(val)
+        elif name == "watchdog_timeout_s":
+            self.watchdog_timeout_s = float(val)
+        elif name == "silent":
+            self.silent = int(val)
+
+    @property
+    def parallel(self) -> bool:
+        return self.num_workers > 1
+
+    def init(self):
+        self.aug.init()
+        if not self.parallel:
+            return
+        src = self.aug.base
+        if (getattr(src, "next_raw", None) is not None
+                and getattr(src, "raw_available", lambda: False)()):
+            self._raw_source = src
+        # split layout when possible: workers run only GIL-releasing
+        # PIL C ops (decode/crop/flip) and return small uint8 windows;
+        # the float tail runs vectorized on the consumer.  Keeping the
+        # numpy float passes out of the workers is what lets the pool
+        # scale — interleaved GIL-held float ops across many workers
+        # convoy the whole pool on small hosts.
+        self._pil_mode = (
+            self._raw_source is not None
+            and getattr(src, "pil_available", lambda: False)()
+            and self.aug.pil_path_ok()
+        )
+        if self.queue_depth <= 0:
+            # in-flight chunks should cover the cores that can actually
+            # run workers (plus pipeline slack), not the worker count —
+            # a window much larger than the hardware just churns the
+            # allocator and cache with chunks nobody is consuming yet
+            self.queue_depth = max(
+                2, min(self.num_workers, os.cpu_count() or self.num_workers)
+            )
+        self._in_q = queue.Queue()
+        self._watchdog = Watchdog(
+            what="decode pool", timeout_s=self.watchdog_timeout_s,
+        )
+        for i in range(self.num_workers):
+            t = threading.Thread(
+                target=self._worker, daemon=True,
+                name=f"decode-worker-{i}",
+            )
+            t.start()
+            self._threads.append(t)
+        if not self.silent:
+            mode = ("decode+crop (split float tail)" if self._pil_mode
+                    else "decode+augment" if self._raw_source
+                    else "augment")
+            print(f"ParallelAugmentIterator: {self.num_workers} workers "
+                  f"({mode}), chunk={self.chunk_size}, "
+                  f"window={self.queue_depth} chunks")
+
+    # ------------------------------------------------------------------
+    # worker side
+    def _worker(self) -> None:
+        while True:
+            task = self._in_q.get()
+            if task is None:
+                return
+            gen, seq, epoch, mode, items = task
+            try:
+                faults.fault_point("pipeline.worker")
+                result = self._process(epoch, mode, items)
+            except BaseException as e:  # noqa: BLE001 - relayed to consumer
+                result = _WorkerError(e)
+            with self._cond:
+                if gen == self._gen and not self._stop:
+                    self._results[seq] = result
+                    if self._watchdog is not None:
+                        self._watchdog.beat()
+                    self._cond.notify_all()
+
+    def _process(self, epoch: int, mode: str, items):
+        """One chunk's worker work, preserving record order; failures
+        become in-place :class:`_BadRecord` markers.  Returns
+        ``(kind, epoch, results)`` where kind ``"tail"`` means the
+        consumer still owes the records the vectorized float tail."""
+        if mode == "pil":
+            src = self._raw_source
+            out: List[object] = []
+            t0 = time.perf_counter()
+            for rec in items:
+                try:
+                    im = src.decode_pil(rec)
+                except Exception as e:  # noqa: BLE001 - untrusted bytes
+                    # only DECODE failures are quarantinable data; an
+                    # augment error (e.g. image smaller than the crop)
+                    # propagates like the serial path's ValueError
+                    out.append(_BadRecord(rec.source, rec.offset, e))
+                    continue
+                out.append(self.aug.augment_pil(
+                    im, rec.index, rec.labels, epoch))
+            pipeline_stats().add("decode", time.perf_counter() - t0,
+                                 rows=len(items))
+            return ("tail", epoch, out)
+        if mode == "raw":
+            src = self._raw_source
+            decoded: List[object] = []
+            for rec in items:
+                try:
+                    decoded.append(
+                        DataInst(rec.index, src.decode_record(rec),
+                                 rec.labels)
+                    )
+                except Exception as e:  # noqa: BLE001 - untrusted bytes
+                    decoded.append(_BadRecord(rec.source, rec.offset, e))
+        else:
+            decoded = list(items)
+        ok = [d for d in decoded if isinstance(d, DataInst)]
+        t0 = time.perf_counter()
+        augmented = iter(self.aug.augment_insts(ok, epoch, apply_mean=True))
+        pipeline_stats().add("augment", time.perf_counter() - t0,
+                             rows=len(ok))
+        return ("final", epoch,
+                [next(augmented) if isinstance(d, DataInst) else d
+                 for d in decoded])
+
+    # ------------------------------------------------------------------
+    # consumer side
+    def _fetch_chunk(self):
+        """Pull up to ``chunk_size`` work items from the source (consumer
+        thread, epoch order).  Returns ``(mode, items)`` or None."""
+        items: List[object] = []
+        if self._raw_source is not None:
+            fetch_block = getattr(self._raw_source, "next_raw_block", None)
+            if fetch_block is not None:
+                items = fetch_block(self.chunk_size)
+                if len(items) < self.chunk_size:
+                    self._exhausted = True
+            else:
+                while len(items) < self.chunk_size:
+                    rec = self._raw_source.next_raw()
+                    if rec is None:
+                        self._exhausted = True
+                        break
+                    items.append(rec)
+            mode = "pil" if self._pil_mode else "raw"
+            return (mode, items) if items else None
+        src = self.aug.base
+        while len(items) < self.chunk_size:
+            if not src.next():
+                self._exhausted = True
+                break
+            items.append(src.value())
+        return ("inst", items) if items else None
+
+    def _refill(self) -> None:
+        while (not self._exhausted
+               and self._seq_submit - self._seq_take < self.queue_depth):
+            chunk = self._fetch_chunk()
+            if chunk is None:
+                break
+            mode, items = chunk
+            self._in_q.put(
+                (self._gen, self._seq_submit, self.aug.epoch, mode, items)
+            )
+            self._seq_submit += 1
+        if self._watchdog is not None:
+            self._watchdog.beat()  # submission is progress too
+
+    def _stall_diagnostic(self, dt: float) -> str:
+        msg = self._watchdog.diagnostic(dt)
+        frames = sys._current_frames()
+        for t in self._threads:
+            if not t.is_alive():
+                msg += f"\nworker {t.name!r} is DEAD"
+                continue
+            frame = frames.get(t.ident)
+            if frame is not None:
+                stack = "".join(traceback.format_stack(frame))
+                msg += f"\nworker {t.name!r} stack:\n{stack}"
+        return msg
+
+    def _wait_result(self, seq: int):
+        """Block until chunk ``seq`` lands, with stall detection."""
+        wd = self._watchdog
+        since = time.monotonic()
+        with self._cond:
+            while seq not in self._results:
+                self._cond.wait(0.2)
+                if wd is not None and wd.enabled:
+                    # progress = the newer of the pool's last beat and
+                    # the start of THIS wait (a legitimately idle pool
+                    # must not look hung the moment a wait begins)
+                    dt = min(wd.stalled_for(),
+                             time.monotonic() - since)
+                    if dt > wd.timeout_s:
+                        raise WatchdogError(self._stall_diagnostic(dt))
+            return self._results.pop(seq)
+
+    def before_first(self):
+        if not self.parallel:
+            self.aug.before_first()
+            return
+        with self._cond:
+            self._gen += 1
+            self._results.clear()
+        # drain queued-but-unstarted tasks of the old generation so the
+        # workers don't burn time decoding records nobody will consume
+        try:
+            while True:
+                self._in_q.get_nowait()
+        except queue.Empty:
+            pass
+        self._seq_submit = 0
+        self._seq_take = 0
+        self._exhausted = False
+        self._pending = []
+        self._pending_pos = 0
+        self._yielded = 0
+        self._cap = (getattr(self._raw_source, "epoch_cap", 0)
+                     if self._raw_source is not None else 0)
+        self.aug.before_first()
+        if self._watchdog is not None:
+            self._watchdog.beat()
+
+    def next(self) -> bool:
+        if not self.parallel:
+            if not self.aug.next():
+                return False
+            self._out = self.aug.value()
+            return True
+        cap = self._cap
+        while True:
+            if cap and self._yielded >= cap:
+                return False
+            if self._pending_pos < len(self._pending):
+                item = self._pending[self._pending_pos]
+                self._pending_pos += 1
+                if isinstance(item, _BadRecord):
+                    # budget accounting on the consumer, in record
+                    # order — raises BadDataError past the budget
+                    self._raw_source.record_bad(
+                        item.source, item.offset, item.exc
+                    )
+                    continue
+                self._out = item
+                self._yielded += 1
+                return True
+            self._refill()
+            if self._seq_take >= self._seq_submit:
+                # exhausted and fully drained: every in-flight decode
+                # failure has passed through record_bad by now, so the
+                # source's epoch skip summary is finally accurate
+                note = getattr(self._raw_source, "note_epoch_end", None)
+                if note is not None:
+                    note()
+                return False
+            result = self._wait_result(self._seq_take)
+            self._seq_take += 1
+            # the consumed chunk freed a window slot: hand the workers
+            # their next task BEFORE draining these records, so the
+            # pool never sits idle while the consumer yields
+            self._refill()
+            if isinstance(result, _WorkerError):
+                raise result.exc
+            kind, chunk_epoch, records = result
+            if kind == "tail":
+                # the vectorized float tail (mean/jitter/scale) runs
+                # HERE, once, off the workers' GIL footprint
+                ok = [d for d in records if isinstance(d, DataInst)]
+                t0 = time.perf_counter()
+                done = iter(self.aug.augment_tail(ok, chunk_epoch))
+                pipeline_stats().add("augment", time.perf_counter() - t0,
+                                     rows=len(ok))
+                records = [next(done) if isinstance(d, DataInst) else d
+                           for d in records]
+            self._pending = records
+            self._pending_pos = 0
+
+    def value(self) -> DataInst:
+        assert self._out is not None
+        return self._out
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._in_q is not None:
+            for _ in self._threads:
+                self._in_q.put(None)
+        for t in self._threads:
+            if t.is_alive():
+                t.join(timeout=2.0)  # daemons: a hung decode never
+                # blocks interpreter exit
+        self._threads = []
+        self.aug.close()
+
+    def __del__(self):  # pragma: no cover - GC-order dependent
+        try:
+            self.close()
+        except Exception:
+            pass

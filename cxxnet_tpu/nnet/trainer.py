@@ -100,6 +100,12 @@ class NetTrainer:
             # reference: SGD runs on the PS (nnet_ps_server.cpp); here the
             # optimizer state is ZeRO-1-sharded over the data axis instead
             self.update_on_server = int(val)
+        elif name == "compile_cache_dir":
+            # persistent XLA compilation cache: restarts/reloads reuse
+            # compiled programs instead of re-jitting (utils/compile_cache)
+            from ..utils import compile_cache
+
+            compile_cache.enable(val, silent=bool(self.silent))
         elif name == "save_ustate":
             # opt-in exact resume: checkpoint updater state (momentum /
             # adam moments) too.  Default 0 keeps reference parity —
@@ -503,7 +509,7 @@ class NetTrainer:
         step0 = jnp.asarray(first_epoch, jnp.int32)
         (self.params, self.ustates, self.aux, self._rng_key, _end, ys) = fn(
             self.params, self.ustates, self.aux,
-            self._stage_scan(data, per_step),
+            self._stage_scan(data, per_step, count_rows=True),
             self._stage_scan(labels, per_step),
             self._next_rng(), step0,
         )
@@ -530,13 +536,13 @@ class NetTrainer:
                 return losses  # async: device array, queue not drained
         return np.asarray(jax.device_get(losses))
 
-    def _stage_scan(self, x, per_step: bool):
+    def _stage_scan(self, x, per_step: bool, count_rows: bool = False):
         """Host stack → device array for update_scan; multi-process runs
         assemble the global array from per-process shards ([K, B, ...]
         step-stacks shard on batch axis 1; one staged batch is exactly
         the _to_device case)."""
         if not per_step:
-            return self._to_device(x)
+            return self._to_device(x, count_rows=count_rows)
         if jax.process_count() == 1:
             return jnp.asarray(x)
         return jax.make_array_from_process_local_data(
@@ -863,7 +869,7 @@ class NetTrainer:
         self._rng_key, sub = jax.random.split(self._rng_key)
         return sub
 
-    def _to_device(self, x: np.ndarray) -> jax.Array:
+    def _to_device(self, x: np.ndarray, count_rows: bool = False) -> jax.Array:
         """Batch-major host array → (possibly multi-process) global array.
 
         Single process: plain transfer, jit's in_shardings places it.
@@ -871,12 +877,27 @@ class NetTrainer:
         shard of the global batch; assemble the global array over the
         data axis (the DCN-spanning-mesh analog of the reference's
         per-worker data sharding, SURVEY §2.8).
+
+        Timed as the ``h2d`` pipeline stage (dispatch + host-side copy;
+        the device-side completion overlaps async and is billed to
+        ``device_wait`` at the next fence).  ``count_rows`` is set only
+        for THE data tensor of a batch — labels/mask/extras bill their
+        time but no rows, so the stage's rows/sec stays the true batch
+        rate instead of 3-4x it.
         """
+        from ..utils.profiler import pipeline_stats
+        import time as _time
+
+        t0 = _time.perf_counter()
         if jax.process_count() == 1:
-            return jnp.asarray(x)
-        return jax.make_array_from_process_local_data(
-            self.mesh_plan.data_sharding(), np.asarray(x)
-        )
+            out = jnp.asarray(x)
+        else:
+            out = jax.make_array_from_process_local_data(
+                self.mesh_plan.data_sharding(), np.asarray(x)
+            )
+        rows = (x.shape[0] if count_rows and getattr(x, "ndim", 0) else 0)
+        pipeline_stats().add("h2d", _time.perf_counter() - t0, rows=rows)
+        return out
 
     def _pad_train_batch(self, batch: DataBatch):
         """Zero-pad a short final train batch to the compiled batch size.
@@ -974,7 +995,7 @@ class NetTrainer:
         data_np, label_np, extras_np, mask_np, n_real = (
             self._pad_train_batch(batch)
         )
-        data = self._to_device(data_np)
+        data = self._to_device(data_np, count_rows=True)
         labels = self._to_device(label_np)
         mask = self._to_device(mask_np)
         extras = tuple(self._to_device(e) for e in extras_np)
@@ -1062,7 +1083,7 @@ class NetTrainer:
                 for e in extras
             )
         out = fetch_local_rows(
-            fn(self.params, self.aux, self._to_device(data),
+            fn(self.params, self.aux, self._to_device(data, count_rows=True),
                tuple(self._to_device(e) for e in extras))
         )
         return out[:n] if pad else out

@@ -90,6 +90,12 @@ class Engine:
         self._cfg = _parse_cfg(cfg)
         self.model_dir = model_dir
         self.silent = silent
+        # persistent XLA compile cache BEFORE the warmup compiles (and
+        # before any hot-reload's fresh-trainer warm), so serve restarts
+        # and reload warms reuse on-disk programs instead of re-jitting
+        from ..utils import compile_cache
+
+        compile_cache.configure(self._cfg, silent=silent)
         self.default_deadline_ms = float(default_deadline_ms)
         # unified transient-I/O retry (doc/robustness.md): the old
         # hard-coded retry_io site, now driven by retry_* config keys

@@ -1,0 +1,83 @@
+"""Persistent XLA compilation cache (``compile_cache_dir``).
+
+Every jitted program in this framework — the fused train step, the
+``update_scan`` body, eval/predict programs, the serving engine's
+shape-bucket cache entries — is re-compiled from scratch on process
+start.  On the v5e AOT runtime a GoogLeNet scan step alone costs ~47 s
+of XLA time (doc/performance.md), so a restart, a preemption resume, or
+a serve reload stalls exactly that long before the first step runs.
+
+Setting ``compile_cache_dir = <dir>`` (global config key, any task)
+points JAX's persistent compilation cache at an on-disk directory:
+compiled executables are keyed by (HLO, compile options, backend) and
+reloaded on later runs, so warm restarts skip XLA entirely.  The
+thresholds are dropped to zero — this framework's programs are few and
+large, so caching everything is strictly better than re-jitting.
+
+The cache directory is shared safely between concurrent processes
+(JAX writes entries atomically), and a stale entry is just a miss:
+an XLA/jaxlib upgrade changes the cache key, never loads wrong code.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+_enabled_dir: Optional[str] = None
+
+
+def enabled_dir() -> Optional[str]:
+    """The directory the cache was pointed at, or None."""
+    return _enabled_dir
+
+
+def enable(path: str, silent: bool = True) -> bool:
+    """Point JAX's persistent compilation cache at ``path`` (created if
+    missing).  Idempotent; returns True when newly enabled.  Must run
+    before the programs it should serve are compiled — config parsing
+    order guarantees that for the CLI and the serving engine."""
+    global _enabled_dir
+    if not path:
+        return False
+    path = os.path.abspath(os.path.expanduser(path))
+    if _enabled_dir == path:
+        return False
+    os.makedirs(path, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    for knob, val in (
+        # cache every program no matter how small/fast to compile —
+        # the program count here is tiny and restart latency is the
+        # thing being bought
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+    ):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # pragma: no cover - older jax: defaults apply
+            pass
+    try:
+        # jax initializes the cache backend lazily ONCE; if anything
+        # compiled before this point (cache disabled then), the dir
+        # update alone would never take effect in this process
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - internal API moved
+        pass
+    _enabled_dir = path
+    if not silent:
+        print(f"compile cache: persistent XLA cache at {path}", flush=True)
+    return True
+
+
+def configure(cfg: Sequence[Tuple[str, str]], silent: bool = True) -> bool:
+    """Scan an ordered config stream for ``compile_cache_dir`` (last
+    one wins) and enable it.  No-op without the key."""
+    path = ""
+    for name, val in cfg or ():
+        if name == "compile_cache_dir":
+            path = val
+    return enable(path, silent=silent) if path else False

@@ -76,6 +76,7 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "libsvm.row": ("corrupt",),
     "text.read": ("ioerror", "latency"),
     "prefetch.producer": ("latency", "hang"),
+    "pipeline.worker": ("latency", "hang"),
     "checkpoint.write": ("ioerror", "latency"),
     "checkpoint.read": ("ioerror", "latency"),
     "serve.reload": ("ioerror", "latency"),
@@ -272,7 +273,7 @@ class FaultInjector:
                 # than a bare sleep, so teardown never strands a thread
                 release.wait(self.hang_s)
             elif fs.kind == "corrupt":
-                if isinstance(payload, (bytes, bytearray)):
+                if isinstance(payload, (bytes, bytearray, memoryview)):
                     payload = _corrupt_bytes(bytes(payload), rng)
                 elif isinstance(payload, str):
                     payload = _corrupt_text(payload, rng)

@@ -84,6 +84,90 @@ class PercentileTracker:
         return out
 
 
+class PipelineStats:
+    """Per-stage input-pipeline timing: decode / augment / batch / h2d /
+    device_wait (plus any custom stage name), each on a
+    :class:`PercentileTracker` with total-time and row accounting.
+
+    One process-wide instance (:func:`pipeline_stats`) so the io/ chain,
+    the trainer's transfer path, and the CLI's round loop all record
+    into the same registry without plumbing.  Thread-safe — decode pool
+    workers record concurrently.  A stage's ``rows_per_sec`` is its
+    LOCAL rate (rows / time spent inside the stage), i.e. what the
+    stage could sustain if it were the only bottleneck; comparing
+    stages shows where the host pipeline's time actually goes
+    (``tools/io_bench.py`` emits the same snapshot as JSON).
+    """
+
+    STAGES = ("decode", "augment", "batch", "h2d", "device_wait")
+
+    def __init__(self, window: int = 2048) -> None:
+        self._window = window
+        self._lock = threading.Lock()
+        self._stages: Dict[str, list] = {}  # name -> [tracker, total_s, rows]
+
+    def add(self, stage: str, dt_s: float, rows: int = 1) -> None:
+        with self._lock:
+            ent = self._stages.get(stage)
+            if ent is None:
+                ent = [PercentileTracker(self._window), 0.0, 0]
+                self._stages[stage] = ent
+            ent[1] += float(dt_s)
+            ent[2] += int(rows)
+        ent[0].add(dt_s)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stages.clear()
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """``{stage: {count, rows, total_s, rows_per_sec, mean_ms,
+        p50_ms, p95_ms, p99_ms}}`` — every canonical stage is present
+        (zeroed when it never ran) so consumers can rely on the schema."""
+        with self._lock:
+            items = {k: (ent[0], ent[1], ent[2])
+                     for k, ent in self._stages.items()}
+        out: Dict[str, Dict[str, float]] = {}
+        for name in (*self.STAGES, *sorted(set(items) - set(self.STAGES))):
+            if name not in items:
+                out[name] = {"count": 0, "rows": 0, "total_s": 0.0,
+                             "rows_per_sec": 0.0}
+                continue
+            tracker, total_s, rows = items[name]
+            row = {
+                "count": float(tracker.count),
+                "rows": float(rows),
+                "total_s": total_s,
+                "rows_per_sec": rows / total_s if total_s > 0 else 0.0,
+            }
+            summ = tracker.summary(scale=1e3)
+            for k, v in summ.items():
+                if k != "count":
+                    row[f"{k}_ms"] = v
+            out[name] = row
+        return out
+
+    def report(self) -> str:
+        """One line per active stage: local rows/sec + mean ms/op."""
+        parts = []
+        for name, row in self.snapshot().items():
+            if not row["count"]:
+                continue
+            parts.append(
+                f"{name} {row['rows_per_sec']:.0f} rows/s "
+                f"({row.get('mean_ms', 0.0):.2f} ms/op)"
+            )
+        return " | ".join(parts)
+
+
+_PIPELINE_STATS = PipelineStats()
+
+
+def pipeline_stats() -> PipelineStats:
+    """The process-wide per-stage pipeline timing registry."""
+    return _PIPELINE_STATS
+
+
 class StepTimer:
     """Wall-clock statistics over training steps (one round at a time)."""
 

@@ -545,6 +545,49 @@ def _scn_checkpoint(site, kind, tmp_path):
         assert found[0] == 2
 
 
+def _write_raw_imgbin(tmp_path, n=6):
+    from cxxnet_tpu.io.imgbin import BinPageWriter, encode_raw
+
+    rng = np.random.RandomState(0)
+    binp = str(tmp_path / "p.bin")
+    w = BinPageWriter(binp)
+    for _ in range(n):
+        w.push(encode_raw(rng.rand(8, 8, 3).astype(np.float32) * 255))
+    w.close()
+    lst = tmp_path / "p.lst"
+    lst.write_text("".join(f"{i}\t{i % 2}\tx.jpg\n" for i in range(n)))
+    return binp, str(lst)
+
+
+def _scn_pipeline(kind, tmp_path):
+    binp, lst = _write_raw_imgbin(tmp_path)
+    entries = [
+        ("iter", "imgbin"), ("image_bin", binp), ("image_list", lst),
+        ("raw_pixels", "1"), ("input_shape", "3,8,8"),
+        ("batch_size", "2"), ("silent", "1"),
+        ("num_decode_workers", "2"), ("decode_chunk", "2"),
+        ("watchdog_timeout_s", "0.8"),
+    ]
+    it = create_iterator(entries)
+    it.init()
+    if kind == "latency":
+        faults.install("pipeline.worker:latency:1:2")
+        it.before_first()
+        n = 0
+        while it.next():
+            n += 1
+        assert n == 3  # slowed, complete
+        it.close()
+        return
+    faults.install("pipeline.worker:hang:1:1")
+    with pytest.raises(WatchdogError, match="decode pool"):
+        it.before_first()
+        while it.next():
+            pass
+    faults.reset()  # release the hung worker so close() can join
+    it.close()
+
+
 def _scn_serve_reload(kind, tmp_path):
     from cxxnet_tpu import serve
     from test_serve import MLP_CFG, _save_round, make_trainer, toy_rows
@@ -634,6 +677,8 @@ def test_fault_matrix(site, kind, tmp_path):
         _scn_text(kind, tmp_path)
     elif site == "prefetch.producer":
         _scn_prefetch(kind, tmp_path)
+    elif site == "pipeline.worker":
+        _scn_pipeline(kind, tmp_path)
     elif site.startswith("checkpoint."):
         _scn_checkpoint(site, kind, tmp_path)
     elif site == "serve.reload":

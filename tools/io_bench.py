@@ -1,21 +1,38 @@
-"""Input-pipeline throughput benchmark (host JPEG decode rate).
+"""Input-pipeline throughput benchmark with per-stage breakdown.
 
-Generates a synthetic JPEG imgbin (+ .lst), then drives the CLI
-``test_io = 1`` path — the reference's IO-isolation mode
-(``cxxnet_main.cpp`` ``test_io``) — through the full chain
-imgbin → native C++ decode pool → augment (crop + mirror) →
-batch → threadbuffer, sweeping ``decode_thread``.
+Generates a synthetic JPEG imgbin (+ .lst), then measures the host data
+pipeline two ways per mode:
 
-Prints one ``img/s`` line per thread count; results are recorded in
-``doc/io.md``.  The pipeline's job is to out-run the device step rate
-(SURVEY §7 hard part (c)): compare against bench.py's images/sec/chip.
+* **decode+augment rows/sec** — the instance-level rate of the
+  decode/augment stage itself (imgbin → ParallelAugment chain driven
+  record by record), the number the parallel pool exists to raise;
+* **img/sec to batches** — the full train chain (… → batch →
+  threadbuffer), i.e. what the train loop actually sees.
 
-Usage: python tools/io_bench.py [n_images] [size] [threads,threads,...]
+Modes: the serial path and a ``num_decode_workers`` sweep (python
+decode pool, ``io/pipeline.py``); ``--native`` adds the C++ reader
+sweep over ``decode_thread`` when the native extension builds.
+
+``--json out.json`` writes the machine-readable report: one entry per
+mode with both rates plus the :class:`~cxxnet_tpu.utils.profiler.
+PipelineStats` snapshot (decode / augment / batch / h2d / device_wait
+rows-per-sec and percentiles).  ``--smoke`` runs a tiny set and
+validates the JSON schema — the ``PERF=1`` lane of
+``tools/run_tier1.sh`` (no throughput assertions in CI: rates are
+hardware-dependent, the schema is not).
+
+Usage:
+  python tools/io_bench.py [n_images] [size] [workers,workers,...]
+  python tools/io_bench.py --json /tmp/io.json
+  python tools/io_bench.py --smoke
 """
 
 from __future__ import annotations
 
+import argparse
 import io
+import json
+import math
 import os
 import sys
 import time
@@ -23,6 +40,8 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STAGES = ("decode", "augment", "batch", "h2d", "device_wait")
 
 
 def generate_imgbin(workdir: str, n: int, size: int) -> None:
@@ -56,72 +75,216 @@ def generate_imgbin(workdir: str, n: int, size: int) -> None:
     writer.close()
 
 
-def run_epoch(workdir: str, n: int, size: int, threads: int,
-              native: int = 1) -> float:
-    """One full pass of the train iterator chain; returns images/sec."""
-    from cxxnet_tpu import config as cfgmod
-    from cxxnet_tpu.io.data import create_iterator
-
+def _iter_params(workdir: str, size: int, workers: int, native: int,
+                 decode_thread: int):
     crop = size - size // 8
-    conf = f"""
-data = train
-iter = imgbin
-  image_bin = {workdir}/bench.bin
-  image_list = {workdir}/bench.lst
-  native_decoder = {native}
-  decode_thread = {threads}
-  silent = 1
-  rand_crop = 1
-  rand_mirror = 1
-  input_shape = 3,{crop},{crop}
-  batch_size = 32
-  round_batch = 0
-  label_width = 1
-iter = threadbuffer
-iter = end
-"""
-    sec = cfgmod.split_sections(cfgmod.parse_pairs(conf)).find("data")[0]
-    it = create_iterator(sec.entries)
+    return [
+        ("image_bin", f"{workdir}/bench.bin"),
+        ("image_list", f"{workdir}/bench.lst"),
+        ("native_decoder", str(native)),
+        ("decode_thread", str(decode_thread)),
+        ("num_decode_workers", str(workers)),
+        ("silent", "1"),
+        ("rand_crop", "1"),
+        ("rand_mirror", "1"),
+        ("input_shape", f"3,{crop},{crop}"),
+        ("batch_size", "32"),
+        ("round_batch", "0"),
+        ("label_width", "1"),
+    ]
+
+
+def run_instances(workdir: str, size: int, workers: int,
+                  native: int = 0, decode_thread: int = 1) -> float:
+    """Decode+augment stage rate: drive the instance-level chain
+    (imgbin → parallel/serial augment) directly; rows/sec."""
+    from cxxnet_tpu.io.augment import AugmentIterator
+    from cxxnet_tpu.io.imgbin import ImageBinIterator
+    from cxxnet_tpu.io.pipeline import ParallelAugmentIterator
+
+    it = ParallelAugmentIterator(AugmentIterator(ImageBinIterator()))
+    for k, v in _iter_params(workdir, size, workers, native, decode_thread):
+        it.set_param(k, v)
     it.init()
-    # warm one epoch (library build, page cache)
     it.before_first()
-    while it.next():
+    while it.next():  # warm epoch (page cache, pool spin-up)
         pass
     it.before_first()
     t0 = time.perf_counter()
     got = 0
     while it.next():
-        got += it.value().data.shape[0]
+        got += 1
     dt = time.perf_counter() - t0
-    if hasattr(it, "close"):
-        it.close()
+    it.close()
     return got / dt
 
 
-def main() -> None:
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
-    size = int(sys.argv[2]) if len(sys.argv) > 2 else 256
-    threads = (
-        [int(t) for t in sys.argv[3].split(",")]
-        if len(sys.argv) > 3
-        else [1, 2, 4, 8, 0]
+def run_epoch(workdir: str, size: int, workers: int, native: int = 0,
+              decode_thread: int = 1, h2d: bool = False):
+    """Full-chain rate (imgbin → augment → batch → threadbuffer) plus
+    the per-stage snapshot; optionally transfers every batch to the
+    JAX device so the ``h2d`` stage is exercised."""
+    from cxxnet_tpu import config as cfgmod
+    from cxxnet_tpu.io.data import create_iterator
+    from cxxnet_tpu.utils.profiler import pipeline_stats
+
+    entries = (
+        [("iter", "imgbin")]
+        + _iter_params(workdir, size, workers, native, decode_thread)
+        + [("iter", "threadbuffer"), ("silent", "1"), ("iter", "end")]
     )
+    del cfgmod  # parsing not needed for an explicit entry list
+    it = create_iterator(entries)
+    it.init()
+    it.before_first()
+    while it.next():  # warm epoch
+        pass
+    if h2d:
+        import jax
+        import jax.numpy as jnp
+
+        jax.block_until_ready(jnp.zeros(8))  # backend + transfer warmup
+    pipeline_stats().reset()
+    it.before_first()
+    t0 = time.perf_counter()
+    got = 0
+    while it.next():
+        batch = it.value()
+        got += batch.data.shape[0]
+        if h2d:
+            th0 = time.perf_counter()
+            arr = jnp.asarray(batch.data)
+            pipeline_stats().add("h2d", time.perf_counter() - th0,
+                                 rows=batch.data.shape[0])
+            tw0 = time.perf_counter()
+            jax.block_until_ready(arr)
+            pipeline_stats().add("device_wait", time.perf_counter() - tw0,
+                                 rows=batch.data.shape[0])
+    dt = time.perf_counter() - t0
+    it.close()
+    return got / dt, pipeline_stats().snapshot()
+
+
+def validate_report(doc: dict) -> None:
+    """Schema check for the JSON report; raises ValueError on drift.
+    This is what the CI smoke lane asserts — not throughput."""
+    for key in ("n_images", "size", "results"):
+        if key not in doc:
+            raise ValueError(f"io_bench report: missing key {key!r}")
+    if not doc["results"]:
+        raise ValueError("io_bench report: empty results")
+    for row in doc["results"]:
+        for key in ("mode", "img_per_sec", "decode_augment_per_sec",
+                    "stages"):
+            if key not in row:
+                raise ValueError(
+                    f"io_bench report: result missing {key!r}: {row}"
+                )
+        for rate_key in ("img_per_sec", "decode_augment_per_sec"):
+            v = row[rate_key]
+            if not (isinstance(v, (int, float)) and math.isfinite(v)
+                    and v >= 0):
+                raise ValueError(
+                    f"io_bench report: bad {rate_key}: {v!r}")
+        for stage in STAGES:
+            if stage not in row["stages"]:
+                raise ValueError(
+                    f"io_bench report: stage {stage!r} missing in "
+                    f"{row['mode']}")
+            srow = row["stages"][stage]
+            for field in ("count", "rows", "total_s", "rows_per_sec"):
+                v = srow.get(field)
+                if not (isinstance(v, (int, float)) and math.isfinite(v)
+                        and v >= 0):
+                    raise ValueError(
+                        f"io_bench report: stage {stage}.{field} bad: "
+                        f"{v!r}")
+    if "speedup_vs_serial" in doc:
+        for k, v in doc["speedup_vs_serial"].items():
+            if not (isinstance(v, (int, float)) and math.isfinite(v)):
+                raise ValueError(f"io_bench report: bad speedup {k}={v!r}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("n_images", nargs="?", type=int, default=2000)
+    ap.add_argument("size", nargs="?", type=int, default=256)
+    ap.add_argument("workers", nargs="?", default="0,1,2,4,8",
+                    help="num_decode_workers sweep (0 = serial path)")
+    ap.add_argument("--json", dest="json_path", default="",
+                    help="write the machine-readable report here")
+    ap.add_argument("--h2d", action="store_true",
+                    help="also measure host->device transfer per batch")
+    ap.add_argument("--native", action="store_true",
+                    help="additionally sweep the native C++ decoder")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny set + schema validation (CI lane)")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.n_images, args.size, args.workers = 48, 48, "0,2"
+        args.h2d = True
+
     import tempfile
 
+    sweep = [int(t) for t in str(args.workers).split(",")]
+    doc = {"n_images": args.n_images, "size": args.size, "results": []}
     with tempfile.TemporaryDirectory() as workdir:
         t0 = time.perf_counter()
-        generate_imgbin(workdir, n, size)
+        generate_imgbin(workdir, args.n_images, args.size)
+        doc["generated_s"] = time.perf_counter() - t0
         print(
-            f"# generated {n} JPEGs ({size}x{size}) in "
-            f"{time.perf_counter() - t0:.1f}s",
+            f"# generated {args.n_images} JPEGs ({args.size}x{args.size}) "
+            f"in {doc['generated_s']:.1f}s",
             flush=True,
         )
-        rate_py = run_epoch(workdir, n, size, 1, native=0)
-        print(f"python-decode fallback: {rate_py:8.1f} img/s", flush=True)
-        for t in threads:
-            rate = run_epoch(workdir, n, size, t)
-            label = "auto" if t == 0 else str(t)
-            print(f"decode_thread = {label:>4}: {rate:8.1f} img/s", flush=True)
+        serial_da = None
+        for w in sweep:
+            da = run_instances(workdir, args.size, w)
+            rate, stages = run_epoch(workdir, args.size, w, h2d=args.h2d)
+            # w=0 is THE serial path; w=1 is the pool disabled by
+            # count (identical code path, labeled distinctly)
+            mode = "serial" if w == 0 else f"workers={w}"
+            if w <= 1 and serial_da is None:
+                serial_da = da
+            doc["results"].append({
+                "mode": mode, "img_per_sec": rate,
+                "decode_augment_per_sec": da, "stages": stages,
+            })
+            print(f"{mode:>12}: decode+augment {da:8.1f} rows/s, "
+                  f"chain {rate:8.1f} img/s", flush=True)
+        if args.native:
+            for t in (1, 2, 4, 0):
+                try:
+                    da = run_instances(workdir, args.size, 0, native=1,
+                                       decode_thread=t)
+                    rate, stages = run_epoch(
+                        workdir, args.size, 0, native=1, decode_thread=t)
+                except Exception as e:  # noqa: BLE001 - no native build
+                    print(f"# native decoder unavailable: {e}", flush=True)
+                    break
+                label = "auto" if t == 0 else str(t)
+                doc["results"].append({
+                    "mode": f"native={label}", "img_per_sec": rate,
+                    "decode_augment_per_sec": da, "stages": stages,
+                })
+                print(f"native={label:>4}: decode+augment {da:8.1f} "
+                      f"rows/s, chain {rate:8.1f} img/s", flush=True)
+    if serial_da:
+        doc["speedup_vs_serial"] = {
+            r["mode"]: r["decode_augment_per_sec"] / serial_da
+            for r in doc["results"] if r["mode"].startswith("workers=")
+        }
+        for mode, s in doc["speedup_vs_serial"].items():
+            print(f"# decode+augment speedup {mode}: {s:.2f}x vs serial",
+                  flush=True)
+    validate_report(doc)
+    if args.json_path:
+        with open(args.json_path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2)
+        print(f"# report -> {args.json_path}", flush=True)
+    if args.smoke:
+        print("io_bench smoke: schema OK", flush=True)
 
 
 if __name__ == "__main__":

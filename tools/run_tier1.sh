@@ -7,6 +7,10 @@
 # Usage: tools/run_tier1.sh [extra pytest args...]
 #        CHAOS=1 tools/run_tier1.sh   # also run the fault-matrix chaos
 #                                     # suite (tools/chaos_run.sh) after
+#        PERF=1 tools/run_tier1.sh    # also run the io_bench smoke lane
+#                                     # (tiny synthetic imgbin, validates
+#                                     # the per-stage JSON schema only —
+#                                     # no flaky throughput assertions)
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
@@ -18,5 +22,10 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 if [ "${CHAOS:-0}" = "1" ]; then
   echo "=== opt-in chaos stage (CHAOS=1) ==="
   tools/chaos_run.sh || rc=1
+fi
+if [ "${PERF:-0}" = "1" ]; then
+  echo "=== opt-in perf smoke (PERF=1) ==="
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python tools/io_bench.py --smoke || rc=1
 fi
 exit $rc
