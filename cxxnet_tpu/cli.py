@@ -73,6 +73,8 @@ class LearnTask:
         self.reload_breaker_threshold = 3
         self.reload_breaker_cooldown_s = 30.0
         self.watchdog_timeout_s = 600.0  # serve batcher stall guard
+        self.telemetry = 0  # per-round JSONL records (doc/observability.md)
+        self.telemetry_path = "telemetry.jsonl"
         self.cfg: List[tuple] = []
 
     # ------------------------------------------------------------------
@@ -155,6 +157,10 @@ class LearnTask:
             self.reload_breaker_cooldown_s = float(val)
         elif name == "watchdog_timeout_s":
             self.watchdog_timeout_s = float(val)
+        elif name == "telemetry":
+            self.telemetry = int(val)
+        elif name == "telemetry_path":
+            self.telemetry_path = val
         self.cfg.append((name, val))
 
     # ------------------------------------------------------------------
@@ -177,6 +183,13 @@ class LearnTask:
         from .utils import compile_cache, faults
 
         faults.configure(self.cfg)
+        # observability (doc/observability.md): host-span tracing
+        # (trace_dir/trace_steps) and the structured event log
+        # (event_log*) — both default off; the metrics registry needs
+        # no arming, layers write into it unconditionally
+        from . import obs
+
+        obs.configure(self.cfg)
         # persistent XLA compile cache (compile_cache_dir): enabled
         # before ANY jit of this run so every task's programs hit it
         compile_cache.configure(self.cfg, silent=bool(self.silent))
@@ -325,6 +338,9 @@ class LearnTask:
             )
         self.net_trainer = self._load_trainer(path)
         self.start_counter = round_ + 1
+        from .obs import emit as obs_emit
+
+        obs_emit("checkpoint.restore", round=round_, path=path)
         return True
 
     def _load_model(self) -> None:
@@ -472,11 +488,15 @@ class LearnTask:
             return
         if self.test_io:
             print("start I/O test")
+        from .obs import emit as obs_emit
+        from .obs import trace as obs_trace
         from .utils.profiler import StepTimer, TraceController
 
         timer = StepTimer()
         tracer = TraceController()
         tracer.configure(self.cfg)
+        obs_emit("train.start", task=self.task, round=self.start_counter,
+                 num_round=self.num_round)
         self._global_step = 0
         self._divergence_retries = 0
         self._lr_scale = 1.0
@@ -494,7 +514,9 @@ class LearnTask:
             while self.start_counter <= self.num_round and cc > 0:
                 cc -= 1
                 try:
-                    completed = self._train_one_round(timer, tracer)
+                    with obs_trace.span("train.round",
+                                        round=self.start_counter):
+                        completed = self._train_one_round(timer, tracer)
                 except DivergenceError as e:
                     if self._handle_divergence(e):
                         cc += 1  # the aborted attempt keeps its budget
@@ -518,8 +540,11 @@ class LearnTask:
         finally:
             self._preempt.uninstall()
         tracer.close()
+        obs_trace.tracer().flush_window(self._global_step)
         if preempted:
             last = self.start_counter - 1
+            obs_emit("train.preempted", round=last,
+                     snapshotted=snapshotted)
             if snapshotted:
                 print(
                     f"preemption: state saved through round {last} "
@@ -530,6 +555,8 @@ class LearnTask:
                 print("preemption: exiting (checkpointing disabled, "
                       "save_model=0)", flush=True)
             return
+        obs_emit("train.end", rounds=self.start_counter - 1,
+                 elapsed_s=time.time() - self._train_start)
         if not self.silent:
             print(f"\nupdating end, "
                   f"{int(time.time() - self._train_start)} sec in all")
@@ -542,6 +569,11 @@ class LearnTask:
         up to ``divergence_max_retries`` consecutive failures.  Returns
         True when training should continue; False aborts (the default
         ``abort`` policy: stop rather than train on corrupt weights)."""
+        from .obs import emit as obs_emit
+
+        obs_emit("divergence.trip", error=str(e),
+                 policy=self.divergence_policy or "abort",
+                 retries=self._divergence_retries)
         print(f"DIVERGENCE: {e}", flush=True)
         if self.divergence_policy != "rollback":
             return False
@@ -583,6 +615,9 @@ class LearnTask:
             tr.scale_learning_rate(self._lr_scale)
         self.net_trainer = tr
         self.start_counter = round_ + 1
+        obs_emit("divergence.rollback", round=round_, path=path,
+                 lr_scale=self._lr_scale,
+                 retry=self._divergence_retries)
         print(
             f"divergence: rolled back to round {round_} ({path}), "
             f"lr scale now {self._lr_scale:g} "
@@ -600,6 +635,7 @@ class LearnTask:
             print(f"update round {self.start_counter - 1}", flush=True)
         from .parallel.distributed import process_info
 
+        from .obs import trace as obs_trace
         from .utils.profiler import pipeline_stats
 
         check_preempt = process_info()[1] == 1
@@ -638,7 +674,8 @@ class LearnTask:
             while len(in_flight) > (0 if drain_all else 1):
                 handle, ns = in_flight.pop(0)
                 t0 = time.perf_counter()
-                _jx.block_until_ready(handle)
+                with obs_trace.span("train.device_wait", steps=ns):
+                    _jx.block_until_ready(handle)
                 pipeline_stats().add(
                     "device_wait", time.perf_counter() - t0,
                     rows=ns * self.net_trainer.batch_size,
@@ -664,6 +701,7 @@ class LearnTask:
             if not pending:
                 return
             tracer.step(self._global_step)
+            obs_trace.step(self._global_step)
             sync_mode = bool(self.net_trainer.eval_train)
             if sync_mode:
                 timer.start()
@@ -686,15 +724,17 @@ class LearnTask:
             else:
                 import numpy as _np
 
-                handle = self.net_trainer.update_scan(
-                    _np.stack([d for d, _ in pending]),
-                    _np.stack([l for _, l in pending]),
-                    sync=sync_mode,
-                    # sharded iterators guarantee equal K per process
-                    # (equal-steps contract) — skip the collective
-                    # K-check so the async overlap stays unbroken
-                    check_steps=False,
-                )
+                with obs_trace.span("train.dispatch",
+                                    steps=len(pending)):
+                    handle = self.net_trainer.update_scan(
+                        _np.stack([d for d, _ in pending]),
+                        _np.stack([l for _, l in pending]),
+                        sync=sync_mode,
+                        # sharded iterators guarantee equal K per process
+                        # (equal-steps contract) — skip the collective
+                        # K-check so the async overlap stays unbroken
+                        check_steps=False,
+                    )
                 if not sync_mode:
                     in_flight.append((handle, len(pending)))
                     _fence(drain_all=False)
@@ -737,6 +777,7 @@ class LearnTask:
                     _fence(drain_all=True)  # update()'s sync would
                     # fence leftovers inside the timed span otherwise
                     tracer.step(self._global_step)
+                    obs_trace.step(self._global_step)
                     timer.start()
                     self.net_trainer.update(batch)
                     if not self.net_trainer.eval_train:
@@ -778,12 +819,15 @@ class LearnTask:
                     flush=True,
                 )
             sys.stderr.write(f"[{self.start_counter}]")
+            eval_text = ""
             if not self.itr_evals:
-                sys.stderr.write(self.net_trainer.evaluate(None, "train"))
+                eval_text += self.net_trainer.evaluate(None, "train")
             for it, nm in zip(self.itr_evals, self.eval_names):
-                sys.stderr.write(self.net_trainer.evaluate(it, nm))
+                eval_text += self.net_trainer.evaluate(it, nm)
+            sys.stderr.write(eval_text)
             sys.stderr.write("\n")
             sys.stderr.flush()
+            self._write_telemetry(timer, eval_text, sample_counter)
             if self.test_on_server:
                 dev = self.net_trainer.check_weight_sync()
                 sys.stderr.write(
@@ -792,6 +836,56 @@ class LearnTask:
                 )
                 sys.stderr.flush()
         return True
+
+    def _write_telemetry(self, timer, eval_text: str,
+                         n_batches: int) -> None:
+        """Append one per-round JSONL record to ``telemetry_path``
+        (``telemetry = 1``; doc/observability.md).  The record carries
+        what the human-facing round lines print — eval metrics, step
+        timing, samples/sec, learning rate, per-stage pipeline timers —
+        as one machine-parseable object.  Never raises: a full disk
+        must not abort training (failures are event-logged once)."""
+        if not self.telemetry:
+            return
+        import json
+        import re
+
+        from .obs import log_exception_once
+        from .utils.profiler import pipeline_stats
+
+        metrics = {
+            m.group(1): float(m.group(2))
+            for m in re.finditer(
+                r"(\S+?):([-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?)",
+                eval_text or "",
+            )
+        }
+        lr = None
+        try:
+            up = next(iter(self.net_trainer.updaters.values()))
+            lr = float(up.param.base_lr)
+        except (StopIteration, AttributeError):
+            pass
+        record = {
+            "ts": time.time(),
+            "round": self.start_counter - 1,
+            "steps": timer.count,
+            "batches": n_batches,
+            "elapsed_s": time.time() - self._train_start,
+            "lr": lr,
+            "eval": metrics,
+            "step": timer.summary(self.net_trainer.batch_size),
+            "stages": pipeline_stats().snapshot(),
+        }
+        try:
+            d = os.path.dirname(self.telemetry_path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(self.telemetry_path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(record, separators=(",", ":")) + "\n")
+        except (OSError, ValueError, TypeError) as e:
+            log_exception_once("cli.telemetry", e, kind="telemetry.error",
+                               path=self.telemetry_path)
 
     def task_predict(self, raw: bool = False) -> None:
         """``task=pred``: one argmax/value per line.  ``task=pred_raw``:

@@ -326,6 +326,10 @@ class ParallelAugmentIterator(InstIterator):
                     dt = min(wd.stalled_for(),
                              time.monotonic() - since)
                     if dt > wd.timeout_s:
+                        from ..obs import emit as obs_emit
+
+                        obs_emit("watchdog.fire", what=wd.what,
+                                 stalled_s=dt, timeout_s=wd.timeout_s)
                         raise WatchdogError(self._stall_diagnostic(dt))
             return self._results.pop(seq)
 

@@ -33,6 +33,8 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from ..nnet.trainer import NetTrainer
+from ..obs import events as obs_events
+from ..obs import trace as obs_trace
 from ..utils import checkpoint as ckpt
 from ..utils import faults
 from ..utils.faults import CircuitBreaker, RetryPolicy
@@ -175,6 +177,9 @@ class Engine:
             watchdog_timeout_s=watchdog_timeout_s,
         )
         self._closed = False
+        obs_events.emit("serve.start", round=self._round,
+                        model=self._model_path,
+                        max_batch_size=self.max_batch_size)
 
     # ------------------------------------------------------------------
     # loading
@@ -259,11 +264,12 @@ class Engine:
             cache = self._cache
         n = data.shape[0]
         self.stats.record_batch(n, cache.bucket_for(n))
-        if kind == "extract":
-            return cache.extract(data, node)
-        if kind == "scores":
-            return cache.scores(data)
-        return cache.predict(data)
+        with obs_trace.span("serve.batch", kind=kind, rows=n):
+            if kind == "extract":
+                return cache.extract(data, node)
+            if kind == "scores":
+                return cache.scores(data)
+            return cache.predict(data)
 
     def submit(
         self,
@@ -340,10 +346,13 @@ class Engine:
         cache = ShapeBucketCache(tr, self._cache.max_batch_size)
         self._warm(cache)
         with self._model_lock:
+            old_round = self._round
             self._trainer = tr
             self._cache = cache
             self._row_shapes = self._allowed_row_shapes(tr)
             self._set_model(path, round_)
+        obs_events.emit("serve.reload", ok=True, swapped=True,
+                        round=round_, old_round=old_round, path=path)
         if not self.silent:
             print(f"serve: hot-reloaded round {round_} from {path}",
                   flush=True)
@@ -366,6 +375,9 @@ class Engine:
             self.reload_breaker.record_failure()
             self.stats.record_reload(ok=False)
             state = self.reload_breaker.state
+            obs_events.emit("serve.reload", ok=False,
+                            error=f"{type(e).__name__}: {e}",
+                            breaker=state, serving_round=self._round)
             if not self.silent:
                 print(f"serve: reload failed ({type(e).__name__}: {e}); "
                       f"breaker {state}, serving round {self._round}",

@@ -2,9 +2,29 @@
 
 One :class:`ServingStats` per engine, shared by the batcher (queue and
 batch accounting), the request paths (latency, outcome counters), and
-the HTTP front-end (``/statsz`` renders :meth:`snapshot`).  Latency uses
-:class:`~cxxnet_tpu.utils.profiler.PercentileTracker` — the serving-side
-sibling of the train loop's ``StepTimer``.
+the HTTP front-end (``/statsz`` renders :meth:`snapshot`).
+
+Since the observability subsystem (doc/observability.md) this is a thin
+facade over two sinks kept in lock-step:
+
+* **per-engine fields** — what they always were; ``/statsz`` keeps its
+  shape, with three deliberate changes (doc/serving.md):
+  ``latency_ms["mean"]`` is now the WINDOW mean (consistent with the
+  percentiles beside it; the old lifetime mean moved to an explicit
+  ``lifetime_mean``), ``queue_depth`` is absent (not ``-1``) when the
+  gauge fails, and ``queue_depth_errors`` counts those failures;
+* **process-wide registry metrics** — every ``record_*`` call also
+  bumps the shared :mod:`cxxnet_tpu.obs.registry` counters/histograms
+  (``serve_requests_total``, ``serve_request_outcomes_total{outcome}``,
+  ``serve_request_latency_seconds`` buckets,
+  ``serve_model_reloads_total{result}``, ...), which is what
+  ``GET /metricsz`` scrapes as Prometheus text.
+
+The live queue-depth gauge is sampled at snapshot/scrape time; a
+raising gauge callable no longer yields the ``-1`` sentinel — the
+exception is event-logged once (``serve.queue_depth`` key) and counted
+in ``queue_depth_errors`` / ``serve_queue_depth_errors_total`` instead,
+and the ``queue_depth`` key is simply absent from that snapshot.
 """
 
 from __future__ import annotations
@@ -13,9 +33,70 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..obs import events as obs_events
+from ..obs.registry import DEFAULT_BUCKETS, registry as obs_registry
 from ..utils.profiler import PercentileTracker
 
-__all__ = ["ServingStats"]
+__all__ = ["ServingStats", "serve_metrics"]
+
+#: request-latency buckets (seconds): the registry default already
+#: spans the 1ms-1s micro-batched predict band plus cold-compile tails
+LATENCY_BUCKETS = DEFAULT_BUCKETS
+
+
+class _ServeMetrics:
+    """The process-wide registry families for the serving subsystem
+    (shared across engines in one process — Prometheus counters are
+    per-process facts; per-engine detail stays in ``/statsz``)."""
+
+    def __init__(self) -> None:
+        reg = obs_registry()
+        self.requests = reg.counter(
+            "serve_requests_total", "Requests accepted into the engine.")
+        self.rows_in = reg.counter(
+            "serve_request_rows_total", "Instance rows across requests.")
+        self.outcomes = reg.counter(
+            "serve_request_outcomes_total",
+            "Request outcomes: ok / shed (429) / expired (504) / error.",
+            labelnames=("outcome",),
+        )
+        self.batches = reg.counter(
+            "serve_batches_total", "Coalesced batches executed.")
+        self.batch_rows = reg.counter(
+            "serve_batch_rows_total", "Real rows in executed batches.")
+        self.bucket_rows = reg.counter(
+            "serve_bucket_rows_total",
+            "Padded bucket rows computed (fill ratio denominator).",
+        )
+        self.latency = reg.histogram(
+            "serve_request_latency_seconds",
+            "End-to-end request latency (enqueue to result).",
+            buckets=LATENCY_BUCKETS,
+        )
+        self.reloads = reg.counter(
+            "serve_model_reloads_total",
+            "Hot-reload attempts by result: swapped / noop / failed.",
+            labelnames=("result",),
+        )
+        self.queue_depth = reg.gauge(
+            "serve_queue_depth", "Live micro-batcher queue depth.")
+        self.queue_depth_errors = reg.counter(
+            "serve_queue_depth_errors_total",
+            "Queue-depth gauge sampling failures.",
+        )
+
+
+_METRICS: Optional[_ServeMetrics] = None
+_METRICS_LOCK = threading.Lock()
+
+
+def serve_metrics() -> _ServeMetrics:
+    """Lazily build (once) the serving metric families."""
+    global _METRICS
+    with _METRICS_LOCK:
+        if _METRICS is None:
+            _METRICS = _ServeMetrics()
+        return _METRICS
 
 
 class ServingStats:
@@ -33,6 +114,7 @@ class ServingStats:
 
     def __init__(self, latency_window: int = 2048) -> None:
         self._lock = threading.Lock()
+        self._m = serve_metrics()
         self.started = time.time()
         self.requests = 0
         self.rows_in = 0
@@ -50,18 +132,24 @@ class ServingStats:
         self.reload_failures = 0
         self.reload_swaps = 0
         self.last_reload_ok: Optional[bool] = None
+        self.queue_depth_errors = 0
         self.latency = PercentileTracker(latency_window)
         self._queue_depth: Optional[Callable[[], int]] = None
 
     # ------------------------------------------------------------------
     def bind_queue_depth(self, fn: Callable[[], int]) -> None:
-        """Register the live queue-depth gauge (the batcher's)."""
+        """Register the live queue-depth gauge (the batcher's).  Also
+        bound into the registry gauge so ``/metricsz`` samples it live
+        (last engine bound wins in a multi-engine process)."""
         self._queue_depth = fn
+        self._m.queue_depth.set_function(fn)
 
     def record_request(self, rows: int) -> None:
         with self._lock:
             self.requests += 1
             self.rows_in += rows
+        self._m.requests.inc()
+        self._m.rows_in.inc(rows)
 
     def record_outcome(self, outcome: str,
                        latency_s: Optional[float] = None) -> None:
@@ -74,14 +162,20 @@ class ServingStats:
                 self.expired += 1
             else:
                 self.errors += 1
+        label = outcome if outcome in ("ok", "shed", "expired") else "error"
+        self._m.outcomes.labels(outcome=label).inc()
         if latency_s is not None:
             self.latency.add(latency_s)
+            self._m.latency.observe(latency_s)
 
     def record_batch(self, rows: int, bucket_rows: int) -> None:
         with self._lock:
             self.batches += 1
             self.batch_rows += rows
             self.bucket_rows += bucket_rows
+        self._m.batches.inc()
+        self._m.batch_rows.inc(rows)
+        self._m.bucket_rows.inc(bucket_rows)
 
     def record_reload(self, ok: bool, swapped: bool = False) -> None:
         with self._lock:
@@ -91,6 +185,8 @@ class ServingStats:
                 self.reload_failures += 1
             elif swapped:
                 self.reload_swaps += 1
+        result = "failed" if not ok else "swapped" if swapped else "noop"
+        self._m.reloads.labels(result=result).inc()
 
     # ------------------------------------------------------------------
     def snapshot(self) -> Dict[str, object]:
@@ -122,6 +218,13 @@ class ServingStats:
         if self._queue_depth is not None:
             try:
                 out["queue_depth"] = int(self._queue_depth())
-            except Exception:
-                out["queue_depth"] = -1
+            except Exception as e:  # noqa: BLE001 - counted, not sentineled
+                with self._lock:
+                    self.queue_depth_errors += 1
+                self._m.queue_depth_errors.inc()
+                obs_events.log_exception_once(
+                    "serve.queue_depth", e, kind="serve.gauge_error",
+                    gauge="queue_depth",
+                )
+        out["queue_depth_errors"] = self.queue_depth_errors
         return out

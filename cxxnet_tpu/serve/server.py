@@ -11,6 +11,10 @@ the batching opportunity.  Endpoints:
   ``{"features": [[...], ...]}``
 * ``GET  /healthz``  — liveness + model identity (round, fingerprint)
 * ``GET  /statsz``   — serving metrics (see ``metrics.py``)
+* ``GET  /metricsz`` — Prometheus text exposition of the process-wide
+  metrics registry (``cxxnet_tpu/obs/registry.py``): request outcomes,
+  batch fill/coalescing, latency histogram, reload counters, pipeline
+  stages — the scrape target (doc/observability.md)
 
 Errors map to JSON bodies with meaningful statuses: 400 malformed
 request, 404 unknown route, 429 load shed, 503 shutting down, 504
@@ -90,9 +94,12 @@ class _Handler(BaseHTTPRequestHandler):
             BaseHTTPRequestHandler.log_message(self, fmt, *args)
 
     def _reply(self, status: int, payload: dict) -> None:
-        body = json.dumps(payload).encode("utf-8")
+        self._reply_text(status, json.dumps(payload), "application/json")
+
+    def _reply_text(self, status: int, text: str, content_type: str) -> None:
+        body = text.encode("utf-8")
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -122,6 +129,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, self.engine.healthz())
             elif self.path == "/statsz":
                 self._reply(200, self.engine.snapshot_stats())
+            elif self.path == "/metricsz":
+                from ..obs import registry as obs_registry
+
+                self._reply_text(
+                    200, obs_registry().render_prometheus(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
             else:
                 self._reply(404, {"error": f"unknown route {self.path}"})
 

@@ -202,13 +202,25 @@ def write_checkpoint(
         write_manifest(path, round_=round_, net_fp=net_fp,
                        save_ustate=save_ustate, blob=blob)
 
-    if retry:
-        retry_io(_write, what=f"writing {path}", silent=silent)
-        retry_io(_manifest, what=f"writing {manifest_path(path)}",
-                 silent=silent)
-    else:
-        _write()
-        _manifest()
+    from ..obs import emit as obs_emit
+    from ..obs import trace as obs_trace
+
+    try:
+        with obs_trace.span("checkpoint.write", path=path,
+                            bytes=len(blob)):
+            if retry:
+                retry_io(_write, what=f"writing {path}", silent=silent)
+                retry_io(_manifest, what=f"writing {manifest_path(path)}",
+                         silent=silent)
+            else:
+                _write()
+                _manifest()
+    except Exception as e:
+        obs_emit("checkpoint.save", ok=False, path=path, round=round_,
+                 error=f"{type(e).__name__}: {e}")
+        raise
+    obs_emit("checkpoint.save", ok=True, path=path, round=round_,
+             bytes=len(blob))
 
 
 def read_manifest(model_path: str) -> Optional[dict]:
@@ -319,6 +331,14 @@ def find_latest_valid(
         reason = validate_checkpoint(path, net_fp=net_fp)
         if reason is None:
             return round_, path
+        from ..obs.events import emit_once
+
+        # once per (path, reason): the serve hot-reload poll calls this
+        # every period, and an invalid-but-newer checkpoint would
+        # otherwise emit the identical event forever
+        emit_once(f"checkpoint.skipped:{path}:{reason}",
+                  "checkpoint.skipped", path=path, round=round_,
+                  reason=reason)
         if not silent:
             print(f"checkpoint {path} skipped: {reason}", flush=True)
     return None

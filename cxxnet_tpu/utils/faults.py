@@ -43,6 +43,9 @@ import time
 import zlib
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..obs import events as obs_events
+from ..obs.registry import registry as obs_registry
+
 __all__ = [
     "SITES",
     "KINDS",
@@ -265,6 +268,16 @@ class FaultInjector:
                     fs.fired += 1
                     firing.append((fs, rng))
             release = self._release
+        for fs, _rng in firing:
+            # every injection is a lifecycle fact: chaos runs become
+            # auditable post-hoc from the event log + /metricsz
+            obs_events.emit("fault.injected", site=fs.site,
+                            fault_kind=fs.kind, fired=fs.fired,
+                            visits=fs.visits)
+            obs_registry().counter(
+                "faults_injected_total", "Chaos-harness fault firings.",
+                labelnames=("site", "kind"),
+            ).labels(site=fs.site, kind=fs.kind).inc()
         for fs, rng in firing:
             if fs.kind == "latency":
                 time.sleep(self.latency_s)
@@ -478,12 +491,17 @@ class Watchdog:
                 msg += f"\nhung thread {t.name!r} stack:\n{stack}"
         return msg
 
+    def _fire(self, dt: float) -> WatchdogError:
+        obs_events.emit("watchdog.fire", what=self.what, stalled_s=dt,
+                        timeout_s=self.timeout_s)
+        return WatchdogError(self.diagnostic(dt))
+
     def check(self) -> None:
         if not self.enabled:
             return
         dt = self.stalled_for()
         if dt > self.timeout_s:
-            raise WatchdogError(self.diagnostic(dt))
+            raise self._fire(dt)
 
     def wait(self, event: threading.Event, poll: float = 0.2,
              since: Optional[float] = None) -> None:
@@ -503,7 +521,7 @@ class Watchdog:
         while not event.wait(min(poll, self.timeout_s)):
             dt = time.monotonic() - max(self._last, since)
             if dt > self.timeout_s:
-                raise WatchdogError(self.diagnostic(dt))
+                raise self._fire(dt)
 
 
 # ----------------------------------------------------------------------
@@ -631,6 +649,9 @@ class BadRecordBudget:
             reason += f" [{note}]"
         self.epoch_count += 1
         self.total_count += 1
+        obs_events.emit("data.quarantined", what=self.what, source=source,
+                        offset=offset, reason=reason,
+                        epoch_count=self.epoch_count)
         key = (source, offset)
         if key not in self._seen:
             self._seen.add(key)
@@ -649,6 +670,10 @@ class BadRecordBudget:
                               f"sidecar ({e}); continuing without it",
                               flush=True)
         if self.epoch_count > self.max_bad_records:
+            obs_events.emit("data.budget_exceeded", what=self.what,
+                            epoch_count=self.epoch_count,
+                            max_bad_records=self.max_bad_records,
+                            source=source, offset=offset)
             raise BadDataError(
                 f"{self.what}: bad-record budget exceeded "
                 f"({self.epoch_count} bad records this epoch > "

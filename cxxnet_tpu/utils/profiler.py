@@ -20,68 +20,25 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..obs.registry import PercentileWindow, registry as _obs_registry
+
 ConfigEntry = Tuple[str, str]
 
 
-class PercentileTracker:
+class PercentileTracker(PercentileWindow):
     """Thread-safe sliding-window percentile estimator (serving latency).
 
-    Keeps the newest ``window`` samples in a ring buffer; percentiles are
-    computed over that window on demand.  Unlike :class:`StepTimer` (one
-    round of a single-threaded train loop) this is written for many
-    concurrent request threads recording into one tracker for the whole
-    server lifetime, so it is locked and bounded."""
+    A thin facade over :class:`cxxnet_tpu.obs.registry.PercentileWindow`
+    — the shared observability primitive — kept under its historical
+    name so serving and pipeline call sites read unchanged.  Unlike
+    :class:`StepTimer` (one round of a single-threaded train loop) this
+    is written for many concurrent request threads recording into one
+    tracker for the whole server lifetime, so it is locked and bounded.
 
-    def __init__(self, window: int = 2048) -> None:
-        self._window = max(1, int(window))
-        self._buf: List[float] = []
-        self._pos = 0
-        self._count = 0
-        self._total = 0.0
-        self._lock = threading.Lock()
-
-    def add(self, value: float) -> None:
-        with self._lock:
-            if len(self._buf) < self._window:
-                self._buf.append(float(value))
-            else:
-                self._buf[self._pos] = float(value)
-                self._pos = (self._pos + 1) % self._window
-            self._count += 1
-            self._total += float(value)
-
-    @property
-    def count(self) -> int:
-        return self._count
-
-    def percentiles(
-        self, qs: Sequence[float] = (50.0, 95.0, 99.0)
-    ) -> Dict[str, float]:
-        """``{"p50": ..., "p95": ...}`` over the current window (empty
-        dict when no samples); nearest-rank on the sorted window."""
-        with self._lock:
-            snap = sorted(self._buf)
-        if not snap:
-            return {}
-        n = len(snap)
-        out = {}
-        for q in qs:
-            idx = min(n - 1, max(0, int(round(q / 100.0 * n)) - 1))
-            out[f"p{q:g}"] = snap[idx]
-        return out
-
-    def summary(self, scale: float = 1.0) -> Dict[str, float]:
-        """count / mean / p50 / p95 / p99, each multiplied by ``scale``
-        (pass 1e3 to report seconds as milliseconds)."""
-        with self._lock:
-            count, total = self._count, self._total
-        if not count:
-            return {"count": 0}
-        out = {"count": float(count), "mean": total / count * scale}
-        out.update(
-            {k: v * scale for k, v in self.percentiles().items()}
-        )
-        return out
+    ``summary()`` reports a window-consistent ``mean`` (same samples as
+    p50/p95/p99) plus the all-time ``lifetime_mean``/``count`` — the old
+    mixed report (lifetime mean next to window percentiles) read as a
+    contradiction whenever behavior shifted mid-run."""
 
 
 class PipelineStats:
@@ -107,6 +64,10 @@ class PipelineStats:
         self._stages: Dict[str, list] = {}  # name -> [tracker, total_s, rows]
 
     def add(self, stage: str, dt_s: float, rows: int = 1) -> None:
+        # the whole record happens under the lock: a concurrent reset()
+        # swaps the stage dict, and an add must land entirely in one
+        # epoch's dict — recording the tracker outside the lock let a
+        # reset discard the entry between the totals and the sample
         with self._lock:
             ent = self._stages.get(stage)
             if ent is None:
@@ -114,11 +75,17 @@ class PipelineStats:
                 self._stages[stage] = ent
             ent[1] += float(dt_s)
             ent[2] += int(rows)
-        ent[0].add(dt_s)
+            ent[0].add(dt_s)
 
     def reset(self) -> None:
+        """Start a new accounting epoch.  Swap-atomic: the old stage
+        dict is replaced wholesale under the lock, so an ``add()``
+        racing from a decode-pool worker lands either entirely in the
+        discarded epoch or entirely in the new one — never half in
+        each, and never into a tracker the snapshot can no longer
+        reach."""
         with self._lock:
-            self._stages.clear()
+            self._stages = {}
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         """``{stage: {count, rows, total_s, rows_per_sec, mean_ms,
@@ -159,8 +126,38 @@ class PipelineStats:
             )
         return " | ".join(parts)
 
+    def collect(self):
+        """Scrape-time exporter for the metrics registry (registered on
+        the process-wide instance), labeled ``{stage=...}`` —
+        ``/metricsz`` coverage without double-writing every sample.
+        Everything exports as GAUGES: the totals are per-epoch (the
+        round loop calls :meth:`reset` each round), and a counter that
+        sawtooths to zero would poison ``rate()``/``increase()`` on any
+        Prometheus-compatible scraper."""
+        snap = self.snapshot()
+        fams = []
+        for name, kind, help_, field in (
+            ("pipeline_stage_rows", "gauge",
+             "Rows processed per host-pipeline stage (current epoch; "
+             "resets each round).", "rows"),
+            ("pipeline_stage_seconds", "gauge",
+             "Seconds spent inside each host-pipeline stage "
+             "(current epoch; resets each round).", "total_s"),
+            ("pipeline_stage_mean_ms", "gauge",
+             "Window-mean milliseconds per operation, per stage.",
+             "mean_ms"),
+            ("pipeline_stage_p99_ms", "gauge",
+             "Window p99 milliseconds per operation, per stage.",
+             "p99_ms"),
+        ):
+            samples = [({"stage": st}, row[field])
+                       for st, row in snap.items() if field in row]
+            fams.append((name, kind, help_, samples))
+        return fams
+
 
 _PIPELINE_STATS = PipelineStats()
+_obs_registry().register_collector(_PIPELINE_STATS.collect)
 
 
 def pipeline_stats() -> PipelineStats:

@@ -192,7 +192,13 @@ def validate_report(doc: dict) -> None:
                     f"io_bench report: stage {stage!r} missing in "
                     f"{row['mode']}")
             srow = row["stages"][stage]
-            for field in ("count", "rows", "total_s", "rows_per_sec"):
+            fields = ["count", "rows", "total_s", "rows_per_sec"]
+            if srow.get("count"):
+                # active stages also carry the window-consistent timing
+                # summary: mean_ms covers the same sliding window as the
+                # percentiles, lifetime_mean_ms the whole epoch
+                fields += ["mean_ms", "lifetime_mean_ms", "p50_ms"]
+            for field in fields:
                 v = srow.get(field)
                 if not (isinstance(v, (int, float)) and math.isfinite(v)
                         and v >= 0):

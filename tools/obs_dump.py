@@ -1,0 +1,387 @@
+"""Observability dump/validate tool: metrics, telemetry, event logs.
+
+The command-line companion of ``cxxnet_tpu/obs/`` (doc/observability.md)
+— and the schema gate the ``OBS=1`` lane of ``tools/run_tier1.sh``
+asserts.  Three artifact kinds:
+
+* **metrics** — Prometheus text exposition, either scraped to a file or
+  fetched live (``--metrics http://host:port/metricsz``).  The
+  validator checks the exposition grammar line by line: HELP/TYPE
+  placement, metric/label name syntax, label-value escaping, float
+  sample values, duplicate sample detection, and histogram invariants
+  (cumulative non-decreasing ``le`` buckets, ``+Inf`` == ``_count``,
+  ``_sum``/``_count`` present).
+* **telemetry** — the per-round ``telemetry.jsonl`` a ``telemetry=1``
+  train run appends (one JSON object per line with ``ts`` / ``round``
+  / ``steps`` / ``eval`` / ``stages``).
+* **events** — the rotating structured event log (``event_log=...``):
+  one JSON object per line with ``ts`` + ``kind``.
+
+Usage:
+  python tools/obs_dump.py --check --metrics /tmp/metricsz.txt \\
+      --telemetry telemetry.jsonl --events events.jsonl
+  python tools/obs_dump.py --tail 20 --events events.jsonl
+  python tools/obs_dump.py --summary --events events.jsonl
+  python tools/obs_dump.py --summary --telemetry telemetry.jsonl
+
+``--check`` exits non-zero on the first schema violation, printing
+every problem found; ``--tail``/``--summary`` are the human front-end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)(?:\s+(-?\d+))?$"
+)
+_METRIC_KINDS = ("counter", "gauge", "histogram", "summary", "untyped")
+
+#: keys every per-round telemetry record must carry
+TELEMETRY_REQUIRED = ("ts", "round", "steps", "eval", "stages")
+#: canonical pipeline stages every record's ``stages`` must include
+TELEMETRY_STAGES = ("decode", "augment", "batch", "h2d", "device_wait")
+
+
+def _parse_labels(text: str) -> Optional[Dict[str, str]]:
+    """Parse ``{a="b",c="d"}``; None on malformed text (bad escapes,
+    unquoted values, bad label names)."""
+    if not (text.startswith("{") and text.endswith("}")):
+        return None
+    body = text[1:-1]
+    out: Dict[str, str] = {}
+    i, n = 0, len(body)
+    while i < n:
+        j = body.find("=", i)
+        if j < 0:
+            return None
+        name = body[i:j].strip()
+        if not _LABEL_NAME_RE.match(name):
+            return None
+        if j + 1 >= n or body[j + 1] != '"':
+            return None
+        k = j + 2
+        val: List[str] = []
+        while k < n:
+            c = body[k]
+            if c == "\\":
+                if k + 1 >= n or body[k + 1] not in ('"', "\\", "n"):
+                    return None
+                val.append({"n": "\n"}.get(body[k + 1], body[k + 1]))
+                k += 2
+                continue
+            if c == '"':
+                break
+            if c == "\n":
+                return None
+            val.append(c)
+            k += 1
+        else:
+            return None
+        if name in out:
+            return None  # duplicate label name
+        out[name] = "".join(val)
+        i = k + 1
+        if i < n:
+            if body[i] != ",":
+                return None
+            i += 1
+    return out
+
+
+def _parse_value(text: str) -> Optional[float]:
+    if text in ("+Inf", "Inf"):
+        return math.inf
+    if text == "-Inf":
+        return -math.inf
+    if text == "NaN":
+        return math.nan
+    try:
+        return float(text)
+    except ValueError:
+        return None
+
+
+def validate_prometheus_text(text: str) -> List[str]:
+    """Return a list of problems (empty == valid exposition text)."""
+    problems: List[str] = []
+    types: Dict[str, str] = {}
+    seen_samples: set = set()
+    samples: List[Tuple[str, Dict[str, str], float]] = []
+    if text and not text.endswith("\n"):
+        problems.append("exposition must end with a newline")
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) < 3 or not _NAME_RE.match(parts[2]):
+                problems.append(f"line {ln}: malformed HELP: {line!r}")
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4 or not _NAME_RE.match(parts[2]):
+                problems.append(f"line {ln}: malformed TYPE: {line!r}")
+                continue
+            name, kind = parts[2], parts[3]
+            if kind not in _METRIC_KINDS:
+                problems.append(f"line {ln}: unknown metric kind {kind!r}")
+            if name in types:
+                problems.append(f"line {ln}: duplicate TYPE for {name}")
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue  # comment
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            problems.append(f"line {ln}: unparseable sample: {line!r}")
+            continue
+        name, labeltext, valtext = m.group(1), m.group(2), m.group(3)
+        labels = _parse_labels(labeltext) if labeltext else {}
+        if labels is None:
+            problems.append(f"line {ln}: malformed labels: {labeltext!r}")
+            continue
+        value = _parse_value(valtext)
+        if value is None:
+            problems.append(f"line {ln}: bad sample value {valtext!r}")
+            continue
+        key = (name, tuple(sorted(labels.items())))
+        if key in seen_samples:
+            problems.append(f"line {ln}: duplicate sample {line!r}")
+        seen_samples.add(key)
+        samples.append((name, labels, value))
+    # histogram invariants per family and labelset (excluding 'le')
+    hist_names = {n for n, k in types.items() if k == "histogram"}
+    for base in sorted(hist_names):
+        buckets: Dict[Tuple, List[Tuple[float, float]]] = {}
+        sums: Dict[Tuple, float] = {}
+        counts: Dict[Tuple, float] = {}
+        for name, labels, value in samples:
+            rest = tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "le"
+            ))
+            if name == base + "_bucket":
+                if "le" not in labels:
+                    problems.append(f"{base}: bucket sample without le")
+                    continue
+                le = _parse_value(labels["le"])
+                if le is None:
+                    problems.append(
+                        f"{base}: unparseable le {labels['le']!r}")
+                    continue
+                buckets.setdefault(rest, []).append((le, value))
+            elif name == base + "_sum":
+                sums[rest] = value
+            elif name == base + "_count":
+                counts[rest] = value
+        if not buckets:
+            problems.append(f"{base}: histogram with no _bucket samples")
+        for rest, bl in buckets.items():
+            bl.sort()
+            vals = [v for _, v in bl]
+            if any(vals[i + 1] < vals[i] for i in range(len(vals) - 1)):
+                problems.append(
+                    f"{base}{dict(rest)}: buckets not cumulative")
+            if not bl or not math.isinf(bl[-1][0]):
+                problems.append(f"{base}{dict(rest)}: missing +Inf bucket")
+            if rest not in sums or rest not in counts:
+                problems.append(f"{base}{dict(rest)}: missing _sum/_count")
+            elif bl and math.isinf(bl[-1][0]) and bl[-1][1] != counts[rest]:
+                problems.append(
+                    f"{base}{dict(rest)}: +Inf bucket {bl[-1][1]} != "
+                    f"_count {counts[rest]}")
+    return problems
+
+
+def _read_jsonl(path: str) -> List[Tuple[int, object]]:
+    out = []
+    with open(path, "r", encoding="utf-8") as f:
+        for ln, line in enumerate(f, 1):
+            if line.strip():
+                out.append((ln, json.loads(line)))
+    return out
+
+
+def validate_telemetry(path: str) -> List[str]:
+    """Schema-check a ``telemetry.jsonl``; returns problems (empty=ok)."""
+    problems: List[str] = []
+    try:
+        rows = _read_jsonl(path)
+    except (OSError, ValueError) as e:
+        return [f"{path}: {type(e).__name__}: {e}"]
+    if not rows:
+        return [f"{path}: no telemetry records"]
+    last_round = None
+    for ln, rec in rows:
+        if not isinstance(rec, dict):
+            problems.append(f"line {ln}: not an object")
+            continue
+        for key in TELEMETRY_REQUIRED:
+            if key not in rec:
+                problems.append(f"line {ln}: missing key {key!r}")
+        if not isinstance(rec.get("stages"), dict):
+            problems.append(f"line {ln}: stages is not an object")
+        else:
+            for st in TELEMETRY_STAGES:
+                if st not in rec["stages"]:
+                    problems.append(f"line {ln}: missing stage {st!r}")
+        if not isinstance(rec.get("eval"), dict):
+            problems.append(f"line {ln}: eval is not an object")
+        r = rec.get("round")
+        if isinstance(r, int):
+            if last_round is not None and r < last_round:
+                problems.append(
+                    f"line {ln}: round went backwards ({last_round}->{r})")
+            last_round = r
+        else:
+            problems.append(f"line {ln}: round is not an int")
+    return problems
+
+
+def validate_events(path: str) -> List[str]:
+    """Schema-check an event log; returns problems (empty == valid)."""
+    problems: List[str] = []
+    try:
+        rows = _read_jsonl(path)
+    except (OSError, ValueError) as e:
+        return [f"{path}: {type(e).__name__}: {e}"]
+    if not rows:
+        return [f"{path}: no events"]
+    for ln, rec in rows:
+        if not isinstance(rec, dict):
+            problems.append(f"line {ln}: not an object")
+            continue
+        if not isinstance(rec.get("ts"), (int, float)):
+            problems.append(f"line {ln}: missing/bad ts")
+        if not (isinstance(rec.get("kind"), str) and rec["kind"]):
+            problems.append(f"line {ln}: missing/bad kind")
+    return problems
+
+
+# ----------------------------------------------------------------------
+# human front-end
+def _load_metrics_text(src: str) -> str:
+    if src.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(src, timeout=10) as r:
+            return r.read().decode("utf-8")
+    with open(src, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def _tail(path: str, n: int) -> None:
+    rows = _read_jsonl(path)
+    for _, rec in rows[-n:]:
+        print(json.dumps(rec, sort_keys=True))
+
+
+def _summarize_events(path: str) -> None:
+    counts: Dict[str, int] = {}
+    first = last = None
+    for _, rec in _read_jsonl(path):
+        k = rec.get("kind", "?")
+        counts[k] = counts.get(k, 0) + 1
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)):
+            first = ts if first is None else min(first, ts)
+            last = ts if last is None else max(last, ts)
+    span = (last - first) if first is not None else 0.0
+    print(f"{sum(counts.values())} event(s) over {span:.1f}s:")
+    for k in sorted(counts, key=counts.get, reverse=True):
+        print(f"  {counts[k]:6d}  {k}")
+
+
+def _summarize_telemetry(path: str) -> None:
+    rows = [rec for _, rec in _read_jsonl(path)]
+    print(f"{len(rows)} round record(s)")
+    if not rows:
+        return
+    hdr = f"{'round':>6} {'steps':>6} {'step_ms':>9} {'samp/s':>9}  eval"
+    print(hdr)
+    for rec in rows:
+        step = rec.get("step") or {}
+        ev = rec.get("eval") or {}
+        evtxt = " ".join(f"{k}={v:g}" for k, v in sorted(ev.items()))
+        print(f"{rec.get('round', -1):>6} {rec.get('steps', 0):>6} "
+              f"{step.get('mean_ms', 0.0):>9.2f} "
+              f"{step.get('samples_per_sec', 0.0):>9.1f}  {evtxt}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="schema-validate the given artifacts; exit 1 on "
+                         "any violation")
+    ap.add_argument("--metrics", default="",
+                    help="Prometheus exposition text: file path or URL")
+    ap.add_argument("--telemetry", default="",
+                    help="per-round telemetry.jsonl path")
+    ap.add_argument("--events", default="", help="event-log JSONL path")
+    ap.add_argument("--tail", type=int, default=0,
+                    help="print the last N records of --events/--telemetry")
+    ap.add_argument("--summary", action="store_true",
+                    help="aggregate the given --events/--telemetry")
+    args = ap.parse_args()
+
+    if not (args.metrics or args.telemetry or args.events):
+        ap.error("give at least one of --metrics/--telemetry/--events")
+    if (args.tail or args.summary) and not (args.events or args.telemetry):
+        ap.error("--tail/--summary need --events or --telemetry")
+
+    if args.check:
+        problems: List[str] = []
+        if args.metrics:
+            try:
+                text = _load_metrics_text(args.metrics)
+            except OSError as e:
+                problems.append(f"metrics {args.metrics}: {e}")
+            else:
+                probs = validate_prometheus_text(text)
+                problems += [f"metrics: {p}" for p in probs]
+                if not probs:
+                    n = sum(1 for l in text.splitlines()
+                            if l and not l.startswith("#"))
+                    print(f"metrics: OK ({n} samples)")
+        if args.telemetry:
+            probs = validate_telemetry(args.telemetry)
+            problems += [f"telemetry: {p}" for p in probs]
+            if not probs:
+                print("telemetry: OK")
+        if args.events:
+            probs = validate_events(args.events)
+            problems += [f"events: {p}" for p in probs]
+            if not probs:
+                print("events: OK")
+        for p in problems:
+            print(f"FAIL {p}", file=sys.stderr)
+        return 1 if problems else 0
+
+    if args.tail:
+        _tail(args.events or args.telemetry, args.tail)
+        return 0
+    if args.summary:
+        if args.events:
+            _summarize_events(args.events)
+        if args.telemetry:
+            _summarize_telemetry(args.telemetry)
+        return 0
+    # default view: summarize whatever was given
+    if args.metrics:
+        print(_load_metrics_text(args.metrics), end="")
+    if args.events:
+        _summarize_events(args.events)
+    if args.telemetry:
+        _summarize_telemetry(args.telemetry)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

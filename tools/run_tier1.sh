@@ -11,6 +11,12 @@
 #                                     # (tiny synthetic imgbin, validates
 #                                     # the per-stage JSON schema only —
 #                                     # no flaky throughput assertions)
+#        OBS=1 tools/run_tier1.sh     # also run the observability smoke:
+#                                     # short telemetry=1 train + serve
+#                                     # scrape of /metricsz, then schema-
+#                                     # validate the exposition text,
+#                                     # telemetry.jsonl and events.jsonl
+#                                     # via tools/obs_dump.py --check
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
@@ -27,5 +33,16 @@ if [ "${PERF:-0}" = "1" ]; then
   echo "=== opt-in perf smoke (PERF=1) ==="
   timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python tools/io_bench.py --smoke || rc=1
+fi
+if [ "${OBS:-0}" = "1" ]; then
+  echo "=== opt-in observability smoke (OBS=1) ==="
+  obs_out=/tmp/_obs_smoke
+  rm -rf "$obs_out"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python tools/obs_smoke.py --out "$obs_out" || rc=1
+  timeout -k 10 60 python tools/obs_dump.py --check \
+    --metrics "$obs_out/metricsz.txt" \
+    --telemetry "$obs_out/telemetry.jsonl" \
+    --events "$obs_out/events.jsonl" || rc=1
 fi
 exit $rc
