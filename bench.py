@@ -123,12 +123,85 @@ def _acquire_tpu_lock() -> bool:
     return True
 
 
+def _kill_guard() -> None:
+    pid = _STAGE.pop("guard_pid", None)
+    if pid:
+        import signal as _signal
+
+        try:
+            os.kill(pid, _signal.SIGKILL)
+            os.waitpid(pid, os.WNOHANG)
+        except OSError:
+            pass
+
+
+def _fork_guard(deadline_sec: float) -> None:
+    """GIL-proof watchdog backstop.  The timer-thread watchdog below
+    cannot fire while the main thread is wedged inside a C call that
+    never releases the GIL (the observed libtpu metadata fetch) — a
+    thread needs the GIL to run.  This forked guard process shares only
+    the stdout fd: after the in-process deadline plus a grace period it
+    writes the diagnostic JSON line itself and SIGKILLs the wedged
+    parent.  Defused by ``_kill_guard`` on any orderly exit; a parent
+    that died some other way flips the child's ppid, which also
+    defuses."""
+    import signal as _signal
+
+    if "jax" in sys.modules or threading.active_count() > 1:
+        # forking a multithreaded process is undefined behavior (XLA's
+        # native threads — invisible to threading.active_count — and
+        # any Python threads hold locks the child inherits mid-flight;
+        # jax warns exactly about this).  The guard exists for the
+        # pre-import dial phase, where bench.py is still
+        # single-threaded; armed any later (e.g. from an in-process
+        # test harness with jax loaded) it stands down and leaves the
+        # timer-thread watchdog as the only layer.
+        return
+    try:
+        pid = os.fork()
+    except OSError:
+        return
+    if pid:
+        _STAGE["guard_pid"] = pid
+        return
+    ppid = os.getppid()
+    end = time.time() + deadline_sec + 5.0
+    while time.time() < end:
+        time.sleep(0.25)
+        if os.getppid() != ppid:
+            os._exit(0)
+    msg = json.dumps({
+        "metric": "images/sec/chip (bench)",
+        "value": None,
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "error": (f"watchdog-guard: no final measurement after "
+                  f"{deadline_sec:.0f}s and the in-process watchdog "
+                  "never fired (GIL-holding C call); killed the "
+                  "process"),
+    }) + "\n"
+    try:
+        os.write(1, msg.encode())  # async-signal-safe, no stdio locks
+    except OSError:
+        pass
+    try:
+        os.kill(ppid, _signal.SIGKILL)
+    except OSError:
+        pass
+    os._exit(0)
+
+
 def _arm_watchdog(deadline_sec: float = WATCHDOG_SEC) -> None:
     """Emit a diagnostic and hard-exit before the driver's own timeout
     can strike.  A completed run (any mode) sets ``_STAGE['done']`` on
     its way out, which turns a late fire into a no-op — no null JSON
-    line can ever follow a valid final line."""
+    line can ever follow a valid final line.  Two layers: a timer
+    thread (rich diagnostic, first shot) and a forked guard process
+    (``_fork_guard``) for hangs that starve every Python thread."""
+    _fork_guard(deadline_sec)
+
     def fire() -> None:
+        _kill_guard()
         if _STAGE.get("done"):
             return
         diag = (f"watchdog: no final measurement after {deadline_sec:.0f}s; "
@@ -160,6 +233,12 @@ def _emit(tag: str, img_s: float, batch: int) -> None:
         "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
     }
     _STAGE["last_emit"] = rec  # the watchdog re-emits this, never null
+    # the forked guard cannot see last_emit, so a post-measurement wedge
+    # would let it clobber this line with value:null — defuse it the
+    # moment a real measurement exists (the guard protects the
+    # pre-measurement dial phase; afterwards the timer watchdog and the
+    # driver's own timeout both leave a parseable last line)
+    _kill_guard()
     print(json.dumps(rec), flush=True)
     print(f"# bench[{tag}]: {img_s:.1f} img/s/chip", file=sys.stderr, flush=True)
 
@@ -575,6 +654,7 @@ def main() -> None:
         wd = _STAGE.get("watchdog")
         if wd is not None:
             wd.cancel()
+        _kill_guard()
 
 
 def _run() -> None:

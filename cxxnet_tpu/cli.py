@@ -75,6 +75,20 @@ class LearnTask:
         self.watchdog_timeout_s = 600.0  # serve batcher stall guard
         self.telemetry = 0  # per-round JSONL records (doc/observability.md)
         self.telemetry_path = "telemetry.jsonl"
+        # closed-loop continuous training (task=serve_train,
+        # doc/continuous_training.md)
+        self.loop_dir = "loop"
+        self.loop_rounds_per_cycle = 2
+        self.loop_replay_ratio = 0.25
+        self.loop_min_records = 64
+        self.loop_max_records = 0  # per cycle; 0 = everything pending
+        self.loop_cycle_period_s = 2.0
+        self.loop_max_cycles = 0  # stop fine-tuning after N trained cycles
+        self.publish_min_delta = 0.0
+        self.publish_metric = ""  # substring match; "" = first reported
+        self.capture_predict = 0  # log /predict inputs+predictions too
+        self.feedback_page_bytes = 1 << 20
+        self.feedback_rotate_bytes = 8 << 20
         self.cfg: List[tuple] = []
 
     # ------------------------------------------------------------------
@@ -161,6 +175,30 @@ class LearnTask:
             self.telemetry = int(val)
         elif name == "telemetry_path":
             self.telemetry_path = val
+        elif name == "loop_dir":
+            self.loop_dir = val
+        elif name == "loop_rounds_per_cycle":
+            self.loop_rounds_per_cycle = int(val)
+        elif name == "loop_replay_ratio":
+            self.loop_replay_ratio = float(val)
+        elif name == "loop_min_records":
+            self.loop_min_records = int(val)
+        elif name == "loop_max_records":
+            self.loop_max_records = int(val)
+        elif name == "loop_cycle_period_s":
+            self.loop_cycle_period_s = float(val)
+        elif name == "loop_max_cycles":
+            self.loop_max_cycles = int(val)
+        elif name == "publish_min_delta":
+            self.publish_min_delta = float(val)
+        elif name == "publish_metric":
+            self.publish_metric = val
+        elif name == "capture_predict":
+            self.capture_predict = int(val)
+        elif name == "feedback_page_bytes":
+            self.feedback_page_bytes = int(val)
+        elif name == "feedback_rotate_bytes":
+            self.feedback_rotate_bytes = int(val)
         self.cfg.append((name, val))
 
     # ------------------------------------------------------------------
@@ -194,7 +232,8 @@ class LearnTask:
         # before ANY jit of this run so every task's programs hit it
         compile_cache.configure(self.cfg, silent=bool(self.silent))
         if self.task not in ("train", "finetune", "pred", "pred_raw",
-                             "extract", "generate", "summary", "serve"):
+                             "extract", "generate", "summary", "serve",
+                             "serve_train"):
             raise ValueError(f"unknown task {self.task!r}")
         self.init()
         if not self.silent:
@@ -211,6 +250,8 @@ class LearnTask:
             self.task_summary()
         elif self.task == "serve":
             self.task_serve()
+        elif self.task == "serve_train":
+            self.task_serve_train()
         else:
             raise ValueError(f"unknown task {self.task!r}")
         return 0
@@ -225,6 +266,18 @@ class LearnTask:
         if self.task == "serve":
             # the serving engine owns model discovery/validation and
             # needs no data iterators — see task_serve
+            return
+        if self.task == "serve_train":
+            # the engine owns the model; the continuous loop needs the
+            # conf's data section (replay mixing) and eval section (the
+            # publish gate) but no driver-level trainer
+            from .parallel.distributed import process_info
+
+            if process_info()[1] > 1:
+                raise ValueError(
+                    "task=serve_train is single-process (the trainer "
+                    "rides beside the serving engine)")
+            self._create_iterators()
             return
         if self.task == "train" and self.continue_training:
             if self._sync_latest_model():
@@ -995,6 +1048,115 @@ class LearnTask:
                 _signal.signal(s, p)
             engine.close()
         print("serve: shutdown complete", flush=True)
+
+    def task_serve_train(self) -> None:
+        """``task=serve_train``: the closed loop — serve, collect
+        feedback, fine-tune, publish behind the eval gate
+        (doc/continuous_training.md).
+
+        The serving engine and HTTP front-end run exactly as
+        ``task=serve`` (plus a ``POST /feedback`` route and, with
+        ``capture_predict = 1``, prediction capture); a daemon thread
+        runs the :class:`~cxxnet_tpu.loop.ContinuousLoop` — tail the
+        feedback log, fine-tune ``loop_rounds_per_cycle`` rounds mixed
+        with ``loop_replay_ratio`` base-iterator rows, and hand the
+        candidate to the eval-gated publisher.  Published checkpoints
+        land in ``model_dir`` and hot-reload immediately.
+        ``loop_max_cycles > 0`` stops fine-tuning after that many
+        trained cycles (serving continues).  Shutdown is the same
+        graceful drain as ``task=serve``."""
+        import signal as _signal
+        import threading
+
+        from .loop import ContinuousLoop, FeedbackWriter
+        from .serve import Engine
+        from .serve.server import serve_forever
+
+        if not self.itr_evals:
+            raise ValueError(
+                "task=serve_train needs an eval section — the publish "
+                "gate scores candidates on held-out data")
+        engine = Engine(
+            cfg=self.cfg,
+            model_dir=self.name_model_dir,
+            max_batch_size=self.serve_max_batch,
+            batch_timeout_ms=self.batch_timeout_ms,
+            queue_limit=self.queue_limit,
+            default_deadline_ms=self.serve_deadline_ms,
+            silent=bool(self.silent),
+            reload_breaker_threshold=self.reload_breaker_threshold,
+            reload_breaker_cooldown_s=self.reload_breaker_cooldown_s,
+            watchdog_timeout_s=self.watchdog_timeout_s,
+        )
+        feedback = FeedbackWriter(
+            os.path.join(self.loop_dir, "feedback"),
+            page_bytes=self.feedback_page_bytes,
+            rotate_bytes=self.feedback_rotate_bytes,
+        )
+        loop = ContinuousLoop(
+            engine,
+            self.cfg,
+            feedback_dir=feedback.dir,
+            base_iter=self.itr_train,
+            eval_iter=self.itr_evals[0],
+            eval_name=self.eval_names[0] if self.eval_names else "eval",
+            rounds_per_cycle=self.loop_rounds_per_cycle,
+            replay_ratio=self.loop_replay_ratio,
+            min_records=self.loop_min_records,
+            max_records_per_cycle=self.loop_max_records,
+            cycle_period_s=self.loop_cycle_period_s,
+            publish_min_delta=self.publish_min_delta,
+            publish_metric=self.publish_metric,
+            feedback_writer=feedback,
+            silent=bool(self.silent),
+        )
+        loop_thread = threading.Thread(
+            target=loop.run, kwargs={"max_cycles": self.loop_max_cycles},
+            name="cxxnet-serve-train-loop", daemon=True,
+        )
+        httpd_box = {}
+
+        def _ready(httpd):
+            httpd_box["httpd"] = httpd
+            h = engine.healthz()
+            print(f"serve_train: serving model round {h['round']} "
+                  f"(fp {h['net_fp']}) on "
+                  f"http://{httpd.server_address[0]}:{httpd.server_port}; "
+                  f"feedback log at {feedback.dir}",
+                  flush=True)
+            loop_thread.start()
+
+        def _stop(signum, frame):
+            print(f"serve_train: shutdown requested, draining (up to "
+                  f"{self.drain_timeout_s:g}s)", flush=True)
+            loop.stop()
+            h = httpd_box.get("httpd")
+            if h is not None:
+                threading.Thread(target=h.shutdown, daemon=True).start()
+
+        prev = {s: _signal.signal(s, _stop)
+                for s in (_signal.SIGTERM, _signal.SIGINT)}
+        try:
+            serve_forever(
+                engine,
+                host=self.serve_host,
+                port=self.serve_port,
+                reload_period_s=self.serve_reload_period,
+                drain_timeout_s=self.drain_timeout_s,
+                verbose=not self.silent,
+                ready_fn=_ready,
+                feedback=feedback,
+                capture_predict=bool(self.capture_predict),
+            )
+        finally:
+            for s, p in prev.items():
+                _signal.signal(s, p)
+            loop.stop()
+            if loop_thread.is_alive():
+                loop_thread.join(timeout=max(self.drain_timeout_s, 5.0))
+            engine.close()
+            feedback.close()
+        print("serve_train: shutdown complete", flush=True)
 
     def task_summary(self) -> None:
         """``task=summary``: per-layer table — type, name, output node

@@ -1,0 +1,42 @@
+"""Closed-loop continuous training: serve → feedback log → fine-tune →
+eval-gated publish → hot reload (``task=serve_train``).
+
+Three parts (doc/continuous_training.md):
+
+* :mod:`~cxxnet_tpu.loop.feedback_log` — sharded append-only
+  (input, label) log in the imgbin CXBP page format with atomic page
+  commits, CRC sidecars, size rotation, and a cursor-tailing reader;
+* :mod:`~cxxnet_tpu.loop.continuous` — the fine-tune cycle driver:
+  tail the log, mix with base-iterator replay, train, gate, advance
+  the cursor;
+* :mod:`~cxxnet_tpu.loop.publisher` — the eval gate: divergence guard
+  + held-out-metric comparison against the serving model; only passing
+  candidates reach the model directory (and the engine's hot reload),
+  with a publish pointer recording rollback state.
+"""
+
+from .continuous import ContinuousLoop
+from .feedback_log import (
+    CursorFile,
+    FeedbackReader,
+    FeedbackRecord,
+    FeedbackWriter,
+    decode_record,
+    encode_record,
+    loop_metrics,
+)
+from .publisher import EvalGatedPublisher, metric_improvement, parse_eval_metric
+
+__all__ = [
+    "ContinuousLoop",
+    "CursorFile",
+    "FeedbackReader",
+    "FeedbackRecord",
+    "FeedbackWriter",
+    "EvalGatedPublisher",
+    "decode_record",
+    "encode_record",
+    "loop_metrics",
+    "metric_improvement",
+    "parse_eval_metric",
+]

@@ -1,0 +1,284 @@
+"""Continuous fine-tuning loop: consume the feedback log, train, gate.
+
+``task=serve_train``'s training half (doc/continuous_training.md).
+:class:`ContinuousLoop` runs beside a live serving engine — typically on
+a daemon thread of the same process — and repeats the cycle:
+
+1. **tail** — read every feedback record committed past the persisted
+   cursor (``loop/feedback_log.py``); fewer than ``min_records`` →
+   the cycle is idle (counted, no training);
+2. **fine-tune** — ``rounds_per_cycle`` passes over the new records,
+   each batch mixed with ``replay_ratio`` base-iterator rows (the
+   catastrophic-forgetting hedge: fresh feedback never fully displaces
+   the original distribution);
+3. **gate** — hand the candidate to the
+   :class:`~cxxnet_tpu.loop.publisher.EvalGatedPublisher`: divergence
+   guard + held-out eval against the serving model's recorded metric.
+   Published → the engine hot-reloads.  Rejected → the trainer ROLLS
+   BACK to the publish pointer's current version (fine-tuning never
+   compounds on a degraded model) and the cursor still advances (the
+   poisoned records are consumed, not retried forever);
+4. **advance** — persist the cursor only after the cycle resolves, so
+   a crash mid-cycle replays the records into the next attempt.
+
+The trainer is a FRESH ``NetTrainer`` loaded from the serving
+checkpoint — the live engine's model is never mutated in place; the
+only way weights reach serving is a published checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs import events as obs_events
+from ..obs import trace as obs_trace
+from .feedback_log import (
+    CursorFile,
+    FeedbackReader,
+    FeedbackRecord,
+    loop_metrics,
+)
+from .publisher import EvalGatedPublisher
+
+__all__ = ["ContinuousLoop"]
+
+ConfigEntry = Tuple[str, str]
+
+
+class _ReplayFeed:
+    """Endless row source over the base iterator (replay mixing):
+    yields ``(data_row, label_row)`` pairs, rewinding at epoch end."""
+
+    def __init__(self, base_iter) -> None:
+        self.base = base_iter
+        self._rows: List[Tuple[np.ndarray, np.ndarray]] = []
+        self._pos = 0
+
+    def take(self, k: int) -> List[Tuple[np.ndarray, np.ndarray]]:
+        out = []
+        while len(out) < k:
+            if self._pos >= len(self._rows):
+                if not self._refill():
+                    break
+            out.append(self._rows[self._pos])
+            self._pos += 1
+        return out
+
+    def _refill(self) -> bool:
+        self._rows, self._pos = [], 0
+        if self.base is None:
+            return False
+        if not self.base.next():
+            self.base.before_first()
+            if not self.base.next():
+                return False
+        b = self.base.value()
+        n = b.batch_size - b.num_batch_padd
+        data = np.asarray(b.data)[:n]
+        label = np.asarray(b.label)[:n]
+        if label.ndim == 1:
+            label = label[:, None]
+        self._rows = [(data[i], label[i]) for i in range(n)]
+        return bool(self._rows)
+
+
+class ContinuousLoop:
+    """The serve→train→publish cycle driver.
+
+    ``engine`` must watch a ``model_dir`` (that is both where the
+    serving model came from and where publishes land); ``cfg`` is the
+    full ordered config stream (netconfig + trainer globals — the
+    fine-tune trainer is built from it exactly like the engine's).
+    """
+
+    def __init__(
+        self,
+        engine,
+        cfg: Sequence[ConfigEntry],
+        feedback_dir: str,
+        base_iter=None,
+        eval_iter=None,
+        eval_name: str = "eval",
+        rounds_per_cycle: int = 2,
+        replay_ratio: float = 0.25,
+        min_records: int = 64,
+        max_records_per_cycle: int = 0,
+        cycle_period_s: float = 2.0,
+        publish_min_delta: float = 0.0,
+        publish_metric: str = "",
+        cursor_path: Optional[str] = None,
+        feedback_writer=None,
+        silent: bool = True,
+    ) -> None:
+        if eval_iter is None:
+            raise ValueError(
+                "ContinuousLoop needs a held-out eval iterator — the "
+                "publish gate is not optional (add an eval section to "
+                "the conf)")
+        if not 0.0 <= replay_ratio < 1.0:
+            raise ValueError("loop_replay_ratio must be in [0, 1)")
+        self.engine = engine
+        self.cfg = list(cfg)
+        self.reader = FeedbackReader(feedback_dir)
+        self.cursor_file = CursorFile(
+            cursor_path or os.path.join(feedback_dir, "cursor.json"))
+        self.replay = _ReplayFeed(base_iter)
+        self.rounds_per_cycle = int(rounds_per_cycle)
+        self.replay_ratio = float(replay_ratio)
+        self.min_records = int(min_records)
+        self.max_records_per_cycle = int(max_records_per_cycle)
+        self.cycle_period_s = float(cycle_period_s)
+        self.feedback_writer = feedback_writer
+        self.silent = silent
+        self._m = loop_metrics()
+        self._stop = threading.Event()
+        self.cycles = 0
+        self.trained_cycles = 0
+        self.publisher = EvalGatedPublisher(
+            engine, eval_iter, eval_name=eval_name,
+            metric_name=publish_metric, min_delta=publish_min_delta,
+            silent=silent,
+        )
+        self.trainer = self._load_trainer(engine.model_path)
+        self._row_shape = tuple(
+            self.trainer.net.input_node_shape(1)[1:])
+        self.publisher.record_serving_baseline(self.trainer)
+
+    # ------------------------------------------------------------------
+    def _load_trainer(self, path: Optional[str]):
+        from ..nnet.trainer import NetTrainer
+
+        if path is None:
+            raise ValueError(
+                "serve_train needs the engine's model to come from a "
+                "checkpoint file (model_dir), not an in-memory trainer")
+        tr = NetTrainer()
+        tr.set_params(self.cfg)
+        tr.load_model(path)
+        return tr
+
+    # ------------------------------------------------------------------
+    def _batches(self, records: List[FeedbackRecord]):
+        """Yield ``(data, label)`` training batches: feedback rows
+        padded out with ``replay_ratio`` base rows per batch."""
+        bs = self.trainer.batch_size
+        n_replay = min(int(round(bs * self.replay_ratio)), bs - 1)
+        n_fresh = bs - n_replay
+        lw = max(r.labels.shape[0] for r in records)
+        for lo in range(0, len(records), n_fresh):
+            chunk = records[lo: lo + n_fresh]
+            rows = [(r.data.reshape(self._row_shape), r.labels)
+                    for r in chunk]
+            rows += self.replay.take(bs - len(chunk))
+            if len(rows) < bs:
+                # not enough replay data to fill: replicate (the
+                # static-shape pad the reference's AdjustBatchSize did)
+                rows += [rows[i % len(rows)]
+                         for i in range(bs - len(rows))]
+            data = np.stack([d for d, _ in rows]).astype(np.float32)
+            labels = np.zeros((bs, max(lw, max(
+                np.atleast_1d(l).shape[0] for _, l in rows))),
+                np.float32)
+            for i, (_, l) in enumerate(rows):
+                l = np.atleast_1d(l)
+                labels[i, : l.shape[0]] = l
+            yield data, labels
+
+    def run_cycle(self) -> str:
+        """One cycle; returns ``idle`` / ``published`` / ``rejected``."""
+        self.cycles += 1
+        if self.feedback_writer is not None:
+            # part-full pages are invisible to the reader until
+            # committed: cycle boundaries flush so fresh feedback is
+            # never stranded behind the page-size threshold
+            self.feedback_writer.flush()
+        cursor = self.cursor_file.load()
+        pending = self.reader.pending(cursor)
+        self._m.pending.set(pending)
+        if pending < self.min_records:
+            self._m.cycles.labels(outcome="idle").inc()
+            return "idle"
+        records, new_cursor = self.reader.read_since(
+            cursor, max_records=self.max_records_per_cycle)
+        if len(records) < self.min_records:
+            if not records and new_cursor != cursor:
+                # every committed page past the cursor was bad (CRC):
+                # consume them now, or pending() keeps promising work
+                # and every future cycle re-reads + re-counts the same
+                # rot forever.  With SOME decodable records the cursor
+                # holds so they train once min_records accumulate.
+                self.cursor_file.store(new_cursor)
+                self._m.pending.set(self.reader.pending(new_cursor))
+            self._m.cycles.labels(outcome="idle").inc()
+            return "idle"
+        t0 = time.monotonic()
+        with obs_trace.span("loop.cycle", cycle=self.cycles,
+                            records=len(records)):
+            steps = 0
+            for _ in range(self.rounds_per_cycle):
+                for data, labels in self._batches(records):
+                    self.trainer.update_all(data, labels)
+                    steps += 1
+            self.trainer.sync()
+            published = self.publisher.consider(
+                self.trainer, cycle=self.cycles)
+            if not published:
+                self._rollback()
+        self.cursor_file.store(new_cursor)
+        self._m.pending.set(self.reader.pending(new_cursor))
+        self._m.cycles.labels(outcome="trained").inc()
+        self.trained_cycles += 1
+        obs_events.emit(
+            "loop.cycle", cycle=self.cycles, records=len(records),
+            steps=steps, published=published,
+            elapsed_s=time.monotonic() - t0)
+        if not self.silent:
+            print(f"loop: cycle {self.cycles}: {len(records)} records, "
+                  f"{steps} steps, "
+                  f"{'published' if published else 'rejected'} "
+                  f"({time.monotonic() - t0:.2f}s)", flush=True)
+        return "published" if published else "rejected"
+
+    def _rollback(self) -> None:
+        """Reload the trainer from the last published/serving version
+        so the next cycle fine-tunes from known-good weights."""
+        target = self.publisher.rollback_target()
+        if target is None:  # no checkpoint left: keep current weights
+            obs_events.emit("loop.rollback", ok=False,
+                            reason="no valid rollback checkpoint")
+            return
+        round_, path = target
+        self.trainer = self._load_trainer(path)
+        self._m.publishes.labels(decision="rollback").inc()
+        obs_events.emit("loop.rollback", ok=True, round=round_,
+                        path=path)
+        if not self.silent:
+            print(f"loop: rolled trainer back to round {round_} "
+                  f"({path})", flush=True)
+
+    # ------------------------------------------------------------------
+    def run(self, max_cycles: int = 0) -> None:
+        """Cycle until :meth:`stop` (or ``max_cycles`` trained cycles).
+        Exceptions are contained per cycle: a failed cycle is logged
+        and the loop keeps serving-side state intact."""
+        while not self._stop.is_set():
+            try:
+                self.run_cycle()
+            except Exception as e:  # noqa: BLE001 - loop must survive
+                obs_events.log_exception_once(
+                    "loop.cycle", e, kind="loop.cycle_error",
+                    cycle=self.cycles)
+                if not self.silent:
+                    print(f"loop: cycle {self.cycles} failed: "
+                          f"{type(e).__name__}: {e}", flush=True)
+            if max_cycles and self.trained_cycles >= max_cycles:
+                return
+            self._stop.wait(self.cycle_period_s)
+
+    def stop(self) -> None:
+        self._stop.set()

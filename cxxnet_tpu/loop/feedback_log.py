@@ -1,0 +1,477 @@
+"""Sharded append-only feedback log: the serve→train data bridge.
+
+The closed loop's durability layer (doc/continuous_training.md): the
+serve front-end appends ``(input, label)`` records here, and the
+continuous trainer tails them through a persisted cursor.  The on-disk
+page layout is imgbin's native ``CXBP`` format (``io/imgbin.py`` — the
+same magic/header/length-table byte layout ``iter_cxbp_pages`` reads),
+so a full shard can be read back or repacked into a training set with
+the existing imgbin tooling.  What this module adds on top is the
+**commit protocol** an always-on serving process needs:
+
+* **atomic page commits** — records buffer in RAM until a page fills
+  (``page_bytes``) or :meth:`FeedbackWriter.flush` is called; the page
+  bytes are appended to the shard and fsynced, and only THEN is the
+  page's ``{offset, bytes, crc32, nrec}`` entry appended (and fsynced)
+  to the ``.commit`` JSONL sidecar.  A crash mid-append leaves a
+  trailing torn page that no sidecar entry references — readers never
+  observe it;
+* **CRC sidecars** — every committed page carries its CRC32; the reader
+  verifies before parsing, and a mismatching page (bit rot, torn
+  sidecar replay) is skipped and counted, never served to the trainer;
+* **rotation by size** — a shard exceeding ``rotate_bytes`` is closed
+  and ``feedback-NNNNNN.bin`` rolls to the next index, so retention can
+  prune whole shards without touching the live tail;
+* **tailing reader + cursor** — :meth:`FeedbackReader.read_since`
+  returns every record committed after a ``(shard, offset)`` cursor;
+  :class:`CursorFile` persists the cursor atomically so the trainer
+  resumes where it left off across restarts;
+* **degrade-don't-fail appends** — the ``loop.append`` fault-injection
+  site fires per append; an I/O failure (injected or real) DROPS the
+  record and bumps ``loop_feedback_dropped_total`` instead of failing
+  the serving request (``drop_on_error=True``, the serving default).
+
+Record encoding (one CXBP blob)::
+
+    u32 nlabel | f4*nlabel labels | u16 h,w,c,pad | f4*h*w*c input
+
+The input tail is exactly ``io.imgbin.encode_raw`` so the blob's data
+part round-trips through ``ImageBinIterator._decode_raw``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import struct
+import threading
+import zlib
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..io.imgbin import PAGE_MAGIC, encode_raw
+from ..obs import events as obs_events
+from ..obs.registry import registry as obs_registry
+from ..utils import faults
+
+__all__ = [
+    "FeedbackRecord",
+    "FeedbackWriter",
+    "FeedbackReader",
+    "CursorFile",
+    "encode_record",
+    "decode_record",
+    "loop_metrics",
+]
+
+SHARD_RE = re.compile(r"feedback-(\d{6})\.bin$")
+COMMIT_SUFFIX = ".commit"
+
+
+class _LoopMetrics:
+    """Process-wide registry families for the closed loop (lazy, shared
+    by the writer, reader, continuous trainer, and publisher)."""
+
+    def __init__(self) -> None:
+        reg = obs_registry()
+        self.appended = reg.counter(
+            "loop_feedback_records_total",
+            "Feedback records durably committed to the log.")
+        self.dropped = reg.counter(
+            "loop_feedback_dropped_total",
+            "Feedback records dropped on append/commit failure "
+            "(degrade-don't-fail).")
+        self.bad_pages = reg.counter(
+            "loop_feedback_bad_pages_total",
+            "Committed pages skipped by the reader (CRC mismatch / "
+            "unreadable).")
+        self.cycles = reg.counter(
+            "loop_cycles_total",
+            "Continuous-training cycles by outcome: trained / idle.",
+            labelnames=("outcome",),
+        )
+        self.publishes = reg.counter(
+            "loop_publish_total",
+            "Eval-gate decisions: published / rejected / rollback.",
+            labelnames=("decision",),
+        )
+        self.pending = reg.gauge(
+            "loop_feedback_pending_records",
+            "Records committed but not yet consumed by the trainer "
+            "cursor (set at each cycle).")
+
+
+_METRICS: Optional[_LoopMetrics] = None
+_METRICS_LOCK = threading.Lock()
+
+
+def loop_metrics() -> _LoopMetrics:
+    global _METRICS
+    with _METRICS_LOCK:
+        if _METRICS is None:
+            _METRICS = _LoopMetrics()
+        return _METRICS
+
+
+class FeedbackRecord:
+    """One decoded (input, labels) feedback instance."""
+
+    __slots__ = ("data", "labels")
+
+    def __init__(self, data: np.ndarray, labels: np.ndarray) -> None:
+        self.data = data
+        self.labels = labels
+
+
+def encode_record(data, labels) -> bytes:
+    """Encode one instance: label vector + raw-pixel input blob."""
+    arr = np.ascontiguousarray(data, np.float32)
+    if arr.ndim == 1:
+        arr = arr.reshape(1, 1, -1)
+    if arr.ndim != 3:
+        raise ValueError(
+            f"feedback input must be a (H, W, C) or flat row, got shape "
+            f"{arr.shape}")
+    lab = np.atleast_1d(np.asarray(labels, np.float32)).reshape(-1)
+    return (struct.pack("<I", lab.shape[0]) + lab.tobytes()
+            + encode_raw(arr))
+
+
+def decode_record(blob) -> FeedbackRecord:
+    """Inverse of :func:`encode_record` (raises on truncation)."""
+    blob = bytes(blob)
+    (nlabel,) = struct.unpack_from("<I", blob)
+    off = 4 + 4 * nlabel
+    labels = np.frombuffer(blob, "<f4", count=nlabel, offset=4).copy()
+    h, w, c = struct.unpack_from("<HHH", blob, off)
+    data = np.frombuffer(blob, "<f4", offset=off + 8).reshape(h, w, c)
+    return FeedbackRecord(data.copy(), labels)
+
+
+def _shard_path(dir_: str, idx: int) -> str:
+    return os.path.join(dir_, f"feedback-{idx:06d}.bin")
+
+
+def list_shards(dir_: str) -> List[Tuple[int, str]]:
+    """All shard files in the log directory, sorted by index."""
+    try:
+        names = os.listdir(dir_)
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        m = SHARD_RE.match(n)
+        if m:
+            out.append((int(m.group(1)), os.path.join(dir_, n)))
+    return sorted(out)
+
+
+def _read_commits(shard_path: str) -> List[Dict]:
+    """Committed-page entries of one shard.  A trailing torn line (a
+    crash mid-commit) is ignored — its page is simply uncommitted."""
+    out: List[Dict] = []
+    try:
+        with open(shard_path + COMMIT_SUFFIX, "r", encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return out
+    for line in text.split("\n"):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            ent = json.loads(line)
+        except ValueError:
+            break  # torn tail: nothing after it is trustworthy
+        if isinstance(ent, dict) and {"off", "bytes", "crc32",
+                                      "nrec"} <= set(ent):
+            out.append(ent)
+        else:
+            break
+    return out
+
+
+class FeedbackWriter:
+    """Thread-safe append side of the log (the serve front-end's handle).
+
+    Appends buffer in RAM; a page is committed when the buffer reaches
+    ``page_bytes`` or on :meth:`flush`.  With ``drop_on_error`` (the
+    serving default) any I/O failure — injected via the ``loop.append``
+    chaos site or real — drops the affected records and counts them in
+    ``loop_feedback_dropped_total`` instead of propagating, so a sick
+    disk degrades feedback capture without failing predict traffic.
+    """
+
+    def __init__(
+        self,
+        dir_: str,
+        page_bytes: int = 1 << 20,
+        rotate_bytes: int = 8 << 20,
+        fsync: bool = True,
+        drop_on_error: bool = True,
+    ) -> None:
+        self.dir = dir_
+        self.page_bytes = int(page_bytes)
+        self.rotate_bytes = int(rotate_bytes)
+        self.fsync = fsync
+        self.drop_on_error = drop_on_error
+        self._lock = threading.Lock()
+        self._blobs: List[bytes] = []
+        self._cur = 0
+        self._m = loop_metrics()
+        self.appended = 0  # records durably committed
+        self.dropped = 0
+        os.makedirs(dir_, exist_ok=True)
+        shards = list_shards(dir_)
+        # resume at the last shard's committed length (a torn tail past
+        # it is dead bytes; truncate so offsets stay contiguous)
+        self._shard_idx = shards[-1][0] if shards else 0
+        self._f = None
+        self._open_shard(truncate_torn=True)
+
+    # ------------------------------------------------------------------
+    def _open_shard(self, truncate_torn: bool = False) -> None:
+        path = _shard_path(self.dir, self._shard_idx)
+        commits = _read_commits(path)
+        committed_end = (commits[-1]["off"] + commits[-1]["bytes"]
+                         if commits else 0)
+        self._f = open(path, "ab")
+        if truncate_torn and self._f.tell() > committed_end:
+            self._f.truncate(committed_end)
+            self._f.seek(committed_end)
+        self._off = self._f.tell()
+
+    def _rotate_locked(self) -> None:
+        self._f.close()
+        self._shard_idx += 1
+        self._open_shard()
+
+    def append(self, data, labels) -> int:
+        """Buffer one record; returns 1, or 0 when it was dropped
+        (``drop_on_error``).  Encoding errors (bad shapes) always
+        raise — they are caller bugs, not I/O weather."""
+        blob = encode_record(data, labels)
+        try:
+            faults.fault_point("loop.append")
+        except OSError as e:
+            if not self.drop_on_error:
+                raise
+            self._drop(1, e)
+            return 0
+        with self._lock:
+            self._blobs.append(blob)
+            self._cur += len(blob) + 4
+            if self._cur + 8 >= self.page_bytes:
+                self._commit_page_locked()
+        return 1
+
+    def append_batch(self, data, labels) -> int:
+        """Append N instances; returns how many were accepted."""
+        data = np.asarray(data)
+        labels = np.asarray(labels)
+        if labels.ndim == 1:
+            labels = labels[:, None]
+        if data.shape[0] != labels.shape[0]:
+            raise ValueError(
+                f"feedback batch: {data.shape[0]} rows vs "
+                f"{labels.shape[0]} labels")
+        n = 0
+        for i in range(data.shape[0]):
+            n += self.append(data[i], labels[i])
+        return n
+
+    def _drop(self, nrec: int, exc: BaseException) -> None:
+        with self._lock:
+            self.dropped += nrec
+        self._m.dropped.inc(nrec)
+        obs_events.log_exception_once(
+            "loop.append", exc, kind="loop.append_error", dropped=nrec)
+
+    def _commit_page_locked(self) -> int:
+        """Write the buffered page + its commit entry.  Returns the
+        record count committed (0 after a degrade-drop)."""
+        if not self._blobs:
+            return 0
+        blobs, self._blobs, self._cur = self._blobs, [], 0
+        page = bytearray(struct.pack("<II", PAGE_MAGIC, len(blobs)))
+        for b in blobs:
+            page += struct.pack("<I", len(b))
+        for b in blobs:
+            page += b
+        page = bytes(page)
+        try:
+            self._f.write(page)
+            self._f.flush()
+            if self.fsync:
+                os.fsync(self._f.fileno())
+            ent = {"off": self._off, "bytes": len(page),
+                   "crc32": zlib.crc32(page) & 0xFFFFFFFF,
+                   "nrec": len(blobs)}
+            cpath = (_shard_path(self.dir, self._shard_idx)
+                     + COMMIT_SUFFIX)
+            with open(cpath, "a", encoding="utf-8") as cf:
+                cf.write(json.dumps(ent, separators=(",", ":")) + "\n")
+                cf.flush()
+                if self.fsync:
+                    os.fsync(cf.fileno())
+        except OSError as e:
+            # degrade: the page (and its records) are lost, serving
+            # is not.  Reopen at the committed tail so the next page
+            # starts on a clean offset.
+            if not self.drop_on_error:
+                raise
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._open_shard(truncate_torn=True)
+            self._m.dropped.inc(len(blobs))
+            self.dropped += len(blobs)
+            obs_events.log_exception_once(
+                "loop.commit", e, kind="loop.append_error",
+                dropped=len(blobs))
+            return 0
+        self._off += len(page)
+        self.appended += len(blobs)
+        self._m.appended.inc(len(blobs))
+        if self._off >= self.rotate_bytes:
+            self._rotate_locked()
+        return len(blobs)
+
+    def flush(self) -> int:
+        """Commit the current partial page (cycle boundaries, tests)."""
+        with self._lock:
+            return self._commit_page_locked()
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "appended": self.appended,
+                "dropped": self.dropped,
+                "buffered": len(self._blobs),
+                "shard": self._shard_idx,
+                "shard_bytes": self._off,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            self._commit_page_locked()
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    def __enter__(self) -> "FeedbackWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+Cursor = Dict[str, int]  # {"shard": int, "off": int}
+
+
+def _cursor(shard: int = 0, off: int = 0) -> Cursor:
+    return {"shard": int(shard), "off": int(off)}
+
+
+class FeedbackReader:
+    """Tailing read side: committed pages only, CRC-verified."""
+
+    def __init__(self, dir_: str) -> None:
+        self.dir = dir_
+
+    # ------------------------------------------------------------------
+    def _shard_commits(self) -> List[Tuple[int, str, List[Dict]]]:
+        return [(idx, path, _read_commits(path))
+                for idx, path in list_shards(self.dir)]
+
+    def pending(self, cursor: Optional[Cursor] = None) -> int:
+        """Committed records past ``cursor`` (cheap: sidecars only)."""
+        cur = cursor or _cursor()
+        n = 0
+        for idx, _path, commits in self._shard_commits():
+            if idx < cur["shard"]:
+                continue
+            for ent in commits:
+                if idx == cur["shard"] and ent["off"] < cur["off"]:
+                    continue
+                n += ent["nrec"]
+        return n
+
+    def read_since(
+        self, cursor: Optional[Cursor] = None, max_records: int = 0
+    ) -> Tuple[List[FeedbackRecord], Cursor]:
+        """Every record committed after ``cursor`` (in commit order),
+        plus the advanced cursor to persist once the records are
+        consumed.  A CRC-mismatching or unreadable committed page is
+        skipped and counted (``loop_feedback_bad_pages_total``) — the
+        cursor still advances past it.  ``max_records > 0`` caps the
+        read (the cursor then stops at a page boundary)."""
+        cur = dict(cursor) if cursor else _cursor()
+        out: List[FeedbackRecord] = []
+        m = loop_metrics()
+        for idx, path, commits in self._shard_commits():
+            if idx < cur["shard"]:
+                continue
+            for ent in commits:
+                if idx == cur["shard"] and ent["off"] < cur["off"]:
+                    continue
+                if max_records and len(out) >= max_records:
+                    return out, cur
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(ent["off"])
+                        page = f.read(ent["bytes"])
+                    if (len(page) != ent["bytes"]
+                            or (zlib.crc32(page) & 0xFFFFFFFF)
+                            != ent["crc32"]):
+                        raise ValueError(
+                            f"page@{ent['off']}: CRC/size mismatch")
+                    out.extend(self._parse_page(page))
+                except (OSError, ValueError, struct.error) as e:
+                    m.bad_pages.inc()
+                    obs_events.emit(
+                        "loop.bad_page", shard=idx, off=ent["off"],
+                        error=f"{type(e).__name__}: {e}")
+                cur = _cursor(idx, ent["off"] + ent["bytes"])
+        return out, cur
+
+    @staticmethod
+    def _parse_page(page: bytes) -> Iterator[FeedbackRecord]:
+        magic, nrec = struct.unpack_from("<II", page)
+        if magic != PAGE_MAGIC:
+            raise ValueError(f"bad page magic {magic:#x}")
+        lens = struct.unpack_from(f"<{nrec}I", page, 8)
+        off = 8 + 4 * nrec
+        mv = memoryview(page)
+        for l in lens:
+            yield decode_record(mv[off: off + l])
+            off += l
+
+
+class CursorFile:
+    """Atomic persistence for the trainer's read cursor."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+
+    def load(self) -> Cursor:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                cur = json.load(f)
+            if (isinstance(cur, dict)
+                    and {"shard", "off"} <= set(cur)):
+                return _cursor(cur["shard"], cur["off"])
+        except (OSError, ValueError, TypeError):
+            pass
+        return _cursor()
+
+    def store(self, cursor: Cursor) -> None:
+        from ..utils.checkpoint import atomic_write_bytes
+
+        atomic_write_bytes(
+            self.path,
+            json.dumps(_cursor(**cursor)).encode("utf-8"),
+        )

@@ -1,0 +1,224 @@
+"""Eval-gated checkpoint publisher: no update ships without proof.
+
+The gate between the continuous trainer and the serving plane
+(doc/continuous_training.md).  A fine-tuned candidate is published —
+written as the next ``NNNN.model`` in the engine's watch directory and
+hot-reloaded — only when ALL of:
+
+* **divergence guard** — every candidate weight is finite
+  (``NetTrainer.weights_finite``, the PR 1 guard applied pre-publish
+  instead of post-mortem);
+* **eval gate** — the held-out eval metric is at least
+  ``publish_min_delta`` better than the SERVING model's recorded
+  metric (orientation-aware: error/rmse/logloss improve downward,
+  rec@n upward).  ``publish_min_delta = 0`` means "no worse";
+
+On acceptance the checkpoint is written through the atomic manifest
+machinery (``utils/checkpoint.write_checkpoint``), the **publish
+pointer** (``PUBLISHED.json``) flips to it — recording the previous
+version for rollback — and the engine hot-reload hook fires so the new
+weights serve immediately.  On rejection nothing reaches the model
+directory; the caller (``loop/continuous.py``) rolls its trainer back
+to the pointer's current version so fine-tuning never compounds on a
+degraded model.  Every decision is emitted to the obs event log
+(``loop.publish`` / ``loop.reject``) and counted in
+``loop_publish_total{decision}`` — the ``/metricsz`` audit trail.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional, Tuple
+
+from ..obs import events as obs_events
+from ..utils import checkpoint as ckpt
+from .feedback_log import loop_metrics
+
+__all__ = ["EvalGatedPublisher", "metric_improvement", "parse_eval_metric"]
+
+#: metrics where a SMALLER value is better; anything else (rec@n) is
+#: treated as larger-is-better
+LOWER_IS_BETTER_PREFIXES = ("error", "rmse", "logloss")
+
+_METRIC_RE = re.compile(
+    r"(\S+?):([-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?)")
+
+
+def parse_eval_metric(eval_text: str, metric_name: str = "",
+                      prefix: str = "") -> Tuple[str, float]:
+    """Extract ``(name, value)`` from a trainer eval line
+    (``\\tname-metric:value`` format).  ``prefix`` restricts to one
+    eval section's metrics (e.g. ``"eval-"`` — the trainer prepends a
+    possibly-empty ``train-`` metric to the same line);
+    ``metric_name`` further selects by substring; empty picks the
+    first remaining metric.  Raises ``ValueError`` when nothing
+    matches — a loop without a measurable gate must not silently
+    publish."""
+    pairs = _METRIC_RE.findall(eval_text or "")
+    if prefix:
+        pairs = [(n, v) for n, v in pairs if n.startswith(prefix)]
+    if metric_name:
+        pairs = [(n, v) for n, v in pairs if metric_name in n]
+    if not pairs:
+        want = " ".join(filter(None, (
+            f"prefix {prefix!r}" if prefix else "",
+            f"matching {metric_name!r}" if metric_name else "")))
+        raise ValueError(
+            f"no eval metric {want} in {eval_text!r}; the publish gate "
+            "needs an eval section with a metric")
+    name, val = pairs[0]
+    return name, float(val)
+
+
+def metric_improvement(name: str, serving: float, candidate: float) -> float:
+    """Signed improvement of ``candidate`` over ``serving`` — positive
+    is better, orientation-aware by metric name."""
+    base = name.rsplit("-", 1)[-1]  # "eval-error[field]" -> "error[field]"
+    lower_better = base.startswith(LOWER_IS_BETTER_PREFIXES)
+    return (serving - candidate) if lower_better else (candidate - serving)
+
+
+class EvalGatedPublisher:
+    """Gatekeeper of the serving model directory.
+
+    ``engine`` is the live serving engine (its ``model_dir`` is the
+    publish target and its ``try_reload`` the hot-swap hook);
+    ``eval_iter`` the held-out eval iterator the gate scores on.
+    """
+
+    def __init__(
+        self,
+        engine,
+        eval_iter,
+        eval_name: str = "eval",
+        metric_name: str = "",
+        min_delta: float = 0.0,
+        silent: bool = True,
+    ) -> None:
+        if engine.model_dir is None:
+            raise ValueError(
+                "EvalGatedPublisher needs an engine watching a "
+                "model_dir (the publish target)")
+        self.engine = engine
+        self.eval_iter = eval_iter
+        self.eval_name = eval_name
+        self.metric_name = metric_name
+        self.min_delta = float(min_delta)
+        self.silent = silent
+        self._m = loop_metrics()
+        self.serving_metric: Optional[float] = None
+        self.serving_metric_name: Optional[str] = None
+
+    # ------------------------------------------------------------------
+    def evaluate(self, trainer) -> Tuple[str, float]:
+        """Held-out eval of ``trainer``; returns ``(name, value)``.
+        Only the eval section's own metrics qualify (the trainer
+        prepends a ``train-`` metric to the same line when
+        ``eval_train`` is on — scoring the gate on that would compare
+        against an empty-count 0)."""
+        text = trainer.evaluate(self.eval_iter, self.eval_name)
+        return parse_eval_metric(text, self.metric_name,
+                                 prefix=f"{self.eval_name}-")
+
+    def record_serving_baseline(self, trainer) -> float:
+        """Score the SERVING weights (``trainer`` must still hold them)
+        — the bar every candidate is gated against until a publish
+        moves it."""
+        name, val = self.evaluate(trainer)
+        self.serving_metric, self.serving_metric_name = val, name
+        obs_events.emit("loop.baseline", metric=name, value=val,
+                        round=self.engine.round)
+        if not self.silent:
+            print(f"loop: serving baseline {name}:{val:g} "
+                  f"(round {self.engine.round})", flush=True)
+        return val
+
+    # ------------------------------------------------------------------
+    def consider(self, trainer, cycle: int = -1) -> bool:
+        """Gate one candidate; publish + hot-reload on pass.
+
+        Returns True when the candidate was published.  On any gate
+        failure (non-finite weights, eval regression beyond
+        ``min_delta``) nothing is written and False returns — the
+        caller rolls the trainer back."""
+        if self.serving_metric is None:
+            raise RuntimeError(
+                "record_serving_baseline must run before consider()")
+        if not trainer.weights_finite():
+            self._reject(cycle, reason="non-finite weights",
+                         metric=self.serving_metric_name,
+                         candidate=None)
+            return False
+        name, cand = self.evaluate(trainer)
+        gain = metric_improvement(name, self.serving_metric, cand)
+        if gain < self.min_delta:
+            self._reject(
+                cycle, reason=f"eval gate: improvement {gain:g} < "
+                              f"publish_min_delta {self.min_delta:g}",
+                metric=name, candidate=cand)
+            return False
+        self._publish(trainer, name, cand, gain, cycle)
+        return True
+
+    # ------------------------------------------------------------------
+    def _reject(self, cycle: int, reason: str, metric,
+                candidate) -> None:
+        self._m.publishes.labels(decision="rejected").inc()
+        obs_events.emit("loop.reject", cycle=cycle, reason=reason,
+                        metric=metric, candidate=candidate,
+                        serving=self.serving_metric,
+                        serving_round=self.engine.round)
+        if not self.silent:
+            print(f"loop: candidate REJECTED ({reason}; serving "
+                  f"{metric}:{self.serving_metric:g}"
+                  + (f", candidate {candidate:g}"
+                     if candidate is not None else "") + ")",
+                  flush=True)
+
+    def _publish(self, trainer, name: str, cand: float, gain: float,
+                 cycle: int) -> None:
+        model_dir = self.engine.model_dir
+        prev_round = self.engine.round
+        latest = ckpt.list_checkpoints(model_dir)
+        round_ = max(prev_round, latest[-1][0] if latest else -1) + 1
+        path = ckpt.publish_path(model_dir, round_)
+        blob = trainer.checkpoint_bytes()
+        ckpt.write_checkpoint(
+            path, blob, round_=round_, net_fp=trainer.net_fp(),
+            save_ustate=trainer.save_ustate, retry=True,
+            silent=self.silent,
+        )
+        ckpt.write_publish_pointer(
+            model_dir, round_, path,
+            net_fp=trainer.net_fp(),
+            metric={"name": name, "value": cand},
+            prev_round=prev_round,
+        )
+        self.serving_metric, self.serving_metric_name = cand, name
+        # the reload hook: the engine swaps to the published round NOW
+        # (breaker-gated) instead of waiting for a poll period
+        swapped = self.engine.try_reload()
+        self._m.publishes.labels(decision="published").inc()
+        obs_events.emit("loop.publish", cycle=cycle, round=round_,
+                        path=path, metric=name, candidate=cand,
+                        gain=gain, swapped=swapped,
+                        prev_round=prev_round)
+        if not self.silent:
+            print(f"loop: PUBLISHED round {round_} ({name}:{cand:g}, "
+                  f"improvement {gain:g}, reloaded={swapped})",
+                  flush=True)
+
+    # ------------------------------------------------------------------
+    def rollback_target(self) -> Optional[Tuple[int, str]]:
+        """Where a rejected trainer should roll back to: the publish
+        pointer's current version when one exists and validates, else
+        the newest valid checkpoint in the model directory."""
+        model_dir = self.engine.model_dir
+        ptr = ckpt.read_publish_pointer(model_dir)
+        if ptr is not None:
+            path = ptr.get("path")
+            if (path and os.path.exists(path)
+                    and ckpt.validate_checkpoint(path) is None):
+                return int(ptr["round"]), path
+        return ckpt.find_latest_valid(model_dir, silent=True)

@@ -21,3 +21,15 @@ def shard_map_nocheck(f, mesh, in_specs, out_specs):
         f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         **{_CHECK_KW: False},
     )
+
+
+def pallas_tpu_compiler_params(**kw):
+    """Pallas-TPU compiler params under either spelling: the class was
+    ``TPUCompilerParams`` through jax 0.4.x and renamed
+    ``CompilerParams`` in 0.5."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:  # pragma: no cover - depends on installed jax
+        cls = pltpu.TPUCompilerParams
+    return cls(**kw)

@@ -111,6 +111,7 @@ class Engine:
         self._model_lock = threading.RLock()
         self._round = -1
         self._model_path: Optional[str] = None
+        self._model_crc: Optional[int] = None
         if trainer is not None:
             if trainer.net is None:
                 raise ValueError("Engine(trainer=...): init/load it first")
@@ -205,14 +206,27 @@ class Engine:
 
     def _set_model(self, path: str, round_: Optional[int] = None) -> None:
         self._model_path = path
+        man = ckpt.read_manifest(path)
         if round_ is not None:
             self._round = round_
         else:
             r = ckpt.checkpoint_round(path)
-            man = ckpt.read_manifest(path)
             if man is not None and man.get("round") is not None:
                 r = int(man["round"])
             self._round = r if r is not None else -1
+        # the served WEIGHTS' identity: the checkpoint payload CRC from
+        # the manifest (the net fingerprint only identifies structure —
+        # every round of one net shares it).  Gauged into /metricsz so
+        # a scrape shows gated publishes landing (doc/serving.md).
+        self._model_crc = (int(man["crc32"])
+                           if man is not None and man.get("crc32")
+                           is not None else None)
+        from .metrics import serve_metrics
+
+        m = serve_metrics()
+        m.model_round.set(self._round)
+        m.model_crc.set(self._model_crc if self._model_crc is not None
+                        else -1)
 
     @staticmethod
     def _allowed_row_shapes(tr: NetTrainer) -> List[Tuple[int, ...]]:
@@ -418,6 +432,21 @@ class Engine:
         return self._round
 
     @property
+    def model_path(self) -> Optional[str]:
+        """Path of the checkpoint currently serving (None when built
+        from an in-memory trainer)."""
+        with self._model_lock:
+            return self._model_path
+
+    @property
+    def model_crc32(self) -> Optional[int]:
+        """Manifest CRC32 of the served checkpoint payload — the
+        weights fingerprint (the net fingerprint only identifies the
+        structure)."""
+        with self._model_lock:
+            return self._model_crc
+
+    @property
     def trainer(self) -> NetTrainer:
         """The live trainer (swapped by hot reload; hold no references
         across requests)."""
@@ -432,6 +461,7 @@ class Engine:
                 "status": status,
                 "round": self._round,
                 "model": self._model_path,
+                "model_crc32": self._model_crc,
                 "net_fp": self._cache.net_fp(),
                 "reload_breaker": self.reload_breaker.state,
             }
@@ -443,6 +473,7 @@ class Engine:
             out["model"] = {
                 "path": self._model_path,
                 "round": self._round,
+                "crc32": self._model_crc,
                 "net_fp": self._cache.net_fp(),
             }
         out["batcher"] = {

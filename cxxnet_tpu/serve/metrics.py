@@ -80,6 +80,15 @@ class _ServeMetrics:
         )
         self.queue_depth = reg.gauge(
             "serve_queue_depth", "Live micro-batcher queue depth.")
+        # identity of the SERVED model (set on load + every hot reload)
+        # — the observable proof that a gated publish landed
+        self.model_round = reg.gauge(
+            "serve_model_round",
+            "Checkpoint round of the currently served model.")
+        self.model_crc = reg.gauge(
+            "serve_model_crc32",
+            "Manifest CRC32 of the served checkpoint payload (weights "
+            "fingerprint; -1 when unknown).")
         self.queue_depth_errors = reg.counter(
             "serve_queue_depth_errors_total",
             "Queue-depth gauge sampling failures.",

@@ -9,6 +9,14 @@ the batching opportunity.  Endpoints:
   (add ``"raw": true`` for the full score rows)
 * ``POST /extract``  — ``{"data": ..., "node": "fc1"}`` →
   ``{"features": [[...], ...]}``
+* ``POST /feedback`` — ``{"data": [[...], ...], "label": [...]}`` →
+  ``{"appended": n}``: append labeled instances to the closed-loop
+  feedback log (``task=serve_train``; doc/continuous_training.md).
+  Append failures DEGRADE — records drop and are counted
+  (``loop_feedback_dropped_total``), the request still succeeds.
+  With capture mode armed (``capture_predict = 1``) every successful
+  ``/predict`` also logs its inputs with the model's own predictions
+  as labels (self-training capture).
 * ``GET  /healthz``  — liveness + model identity (round, fingerprint)
 * ``GET  /statsz``   — serving metrics (see ``metrics.py``)
 * ``GET  /metricsz`` — Prometheus text exposition of the process-wide
@@ -87,6 +95,8 @@ class _Handler(BaseHTTPRequestHandler):
     engine: Engine = None  # bound by make_server via subclassing
     inflight: _InflightGauge = None
     verbose = False
+    feedback = None  # FeedbackWriter when the loop is armed
+    capture_predict = False  # log /predict inputs + predictions
 
     # ------------------------------------------------------------------
     def log_message(self, fmt, *args):  # noqa: N802 - stdlib name
@@ -144,7 +154,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._do_post()
 
     def _do_post(self) -> None:
-        if self.path not in ("/predict", "/extract"):
+        if self.path not in ("/predict", "/extract", "/feedback"):
             self._reply(404, {"error": f"unknown route {self.path}"})
             return
         obj = self._read_json()
@@ -152,7 +162,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         deadline = obj.get("deadline_ms")
         try:
-            if self.path == "/extract":
+            if self.path == "/feedback":
+                self._do_feedback(obj)
+            elif self.path == "/extract":
                 node = obj.get("node")
                 if not node:
                     self._reply(400, {"error": "extract needs a node name"})
@@ -166,6 +178,11 @@ class _Handler(BaseHTTPRequestHandler):
                                          deadline_ms=deadline)
                 key = "scores" if kind == "scores" else "pred"
                 self._reply(200, {key: np.asarray(out).tolist()})
+                # capture AFTER the reply: a page commit's fsyncs must
+                # never sit inside the client's request latency
+                if (self.capture_predict and self.feedback is not None
+                        and kind == "predict"):
+                    self._capture(obj["data"], out)
         except ServeError as e:
             self._reply(e.http_status, {"error": str(e)})
         except (ValueError, TypeError) as e:
@@ -173,20 +190,71 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001 - served as a 500
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
+    @staticmethod
+    def _feedback_arrays(obj: dict):
+        """Normalize a feedback body to ``(data (N, ...), label (N, L))``."""
+        data = np.ascontiguousarray(obj["data"], np.float32)
+        if data.ndim == 1:
+            data = data[None, :]
+        if "label" not in obj:
+            raise ValueError('feedback needs {"data": ..., "label": ...}')
+        label = np.atleast_1d(
+            np.ascontiguousarray(obj["label"], np.float32))
+        if label.ndim == 1:
+            label = label[:, None]
+        if label.shape[0] != data.shape[0]:
+            raise ValueError(
+                f"feedback: {data.shape[0]} data rows vs "
+                f"{label.shape[0]} labels")
+        return data, label
+
+    def _do_feedback(self, obj: dict) -> None:
+        if self.feedback is None:
+            self._reply(404, {
+                "error": "no feedback log armed (run task=serve_train)"
+            })
+            return
+        data, label = self._feedback_arrays(obj)
+        n = self.feedback.append_batch(data, label)
+        self._reply(200, {"appended": n,
+                          "dropped": data.shape[0] - n})
+
+    def _capture(self, data, preds) -> None:
+        """Opt-in /predict capture: inputs + model predictions into the
+        feedback log.  Never fails the request — the log's degrade
+        discipline applies to capture too."""
+        try:
+            arr = np.ascontiguousarray(data, np.float32)
+            if arr.ndim == 1:
+                arr = arr[None, :]
+            self.feedback.append_batch(
+                arr, np.asarray(preds, np.float32).reshape(arr.shape[0], -1))
+        except Exception as e:  # noqa: BLE001 - capture is best-effort
+            from ..obs import log_exception_once
+
+            log_exception_once("serve.capture", e,
+                               kind="loop.append_error", capture=True)
+
 
 def make_server(
     engine: Engine,
     host: str = "127.0.0.1",
     port: int = 0,
     verbose: bool = False,
+    feedback=None,
+    capture_predict: bool = False,
 ) -> ThreadingHTTPServer:
     """Bind (but do not run) the HTTP server; ``port=0`` picks an
     ephemeral port — read it back from ``server.server_port``.  The
-    in-flight gauge hangs off the server as ``httpd.inflight``."""
+    in-flight gauge hangs off the server as ``httpd.inflight``.
+    ``feedback`` (a :class:`~cxxnet_tpu.loop.feedback_log.
+    FeedbackWriter`) arms the ``/feedback`` route; ``capture_predict``
+    additionally logs every successful ``/predict``."""
     gauge = _InflightGauge()
     handler = type(
         "BoundHandler", (_Handler,),
-        {"engine": engine, "verbose": verbose, "inflight": gauge},
+        {"engine": engine, "verbose": verbose, "inflight": gauge,
+         "feedback": feedback, "capture_predict": capture_predict},
     )
     httpd = ThreadingHTTPServer((host, port), handler)
     httpd.daemon_threads = True
@@ -202,6 +270,8 @@ def serve_forever(
     drain_timeout_s: float = 5.0,
     verbose: bool = False,
     ready_fn=None,
+    feedback=None,
+    capture_predict: bool = False,
 ) -> Tuple[ThreadingHTTPServer, Optional[threading.Thread]]:
     """Run the server until ``httpd.shutdown()`` (blocking).
 
@@ -215,7 +285,9 @@ def serve_forever(
     accept loop, in-flight requests get up to ``drain_timeout_s`` to
     finish writing their responses before this function returns (the
     caller then closes the engine, which 503s anything still queued)."""
-    httpd = make_server(engine, host, port, verbose=verbose)
+    httpd = make_server(engine, host, port, verbose=verbose,
+                        feedback=feedback,
+                        capture_predict=capture_predict)
     stop = threading.Event()
     reloader = None
     if reload_period_s > 0 and engine.model_dir is not None:

@@ -366,6 +366,66 @@ def apply_retention(
 
 
 # ----------------------------------------------------------------------
+# publish pointer (closed-loop continuous training, doc/continuous_training.md)
+PUBLISH_POINTER = "PUBLISHED.json"
+
+
+def publish_path(model_dir: str, round_: int) -> str:
+    """Canonical checkpoint path for a published round (the same
+    ``NNNN.model`` naming the trainer and serve discovery use)."""
+    return os.path.join(model_dir, f"{round_:04d}.model")
+
+
+def pointer_path(model_dir: str) -> str:
+    return os.path.join(model_dir, PUBLISH_POINTER)
+
+
+def write_publish_pointer(
+    model_dir: str,
+    round_: int,
+    path: str,
+    net_fp: Optional[str] = None,
+    metric: Optional[dict] = None,
+    prev_round: Optional[int] = None,
+) -> dict:
+    """Atomically flip the publish pointer to ``round_``/``path``.
+
+    The pointer is the loop's "currently blessed version" record: the
+    eval-gated publisher writes it after every accepted candidate, and
+    rollback (a rejected candidate, or an operator intervention) reads
+    it to find the last version that passed the gate.  ``prev`` keeps
+    one level of history — enough to answer "what was serving before
+    this publish" without scanning manifests."""
+    ptr = {
+        "format": MANIFEST_FORMAT,
+        "round": int(round_),
+        "path": path,
+        "net_fingerprint": net_fp,
+        "metric": metric,
+        "prev": ({"round": int(prev_round)}
+                 if prev_round is not None else None),
+        "time": time.time(),
+    }
+    atomic_write_bytes(
+        pointer_path(model_dir),
+        (json.dumps(ptr, indent=1) + "\n").encode("utf-8"),
+    )
+    return ptr
+
+
+def read_publish_pointer(model_dir: str) -> Optional[dict]:
+    """The current publish pointer, or None if absent/unparseable."""
+    try:
+        with open(pointer_path(model_dir), "r", encoding="utf-8") as f:
+            ptr = json.load(f)
+        if isinstance(ptr, dict) and "round" in ptr and "path" in ptr:
+            return ptr
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+# ----------------------------------------------------------------------
 # preemption
 class PreemptionHandler:
     """Cooperative SIGTERM/SIGINT handling for the train loop.

@@ -84,6 +84,10 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "checkpoint.read": ("ioerror", "latency"),
     "serve.reload": ("ioerror", "latency"),
     "serve.batch": ("ioerror", "latency", "hang"),
+    # feedback-log append (loop/feedback_log.py): an ioerror here must
+    # DEGRADE — the record is dropped and counted, the serving request
+    # still succeeds (doc/continuous_training.md)
+    "loop.append": ("ioerror", "latency"),
 }
 
 KINDS = ("ioerror", "corrupt", "latency", "hang")

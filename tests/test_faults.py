@@ -654,6 +654,35 @@ def _scn_serve_batch(kind, tmp_path):
         eng.close()
 
 
+def _scn_loop_append(kind, tmp_path):
+    """A feedback-log append fault must DEGRADE: the record drops and is
+    counted, nothing raises toward the serving request, and the log
+    keeps accepting once the fault clears."""
+    import numpy as np
+
+    from cxxnet_tpu.loop import FeedbackReader, FeedbackWriter
+
+    w = FeedbackWriter(str(tmp_path / "log"))
+    x = np.ones((1, 16), np.float32)
+    y = np.zeros((1, 1), np.float32)
+    try:
+        if kind == "latency":
+            faults.install("loop.append:latency:1:1")
+            assert w.append_batch(x, y) == 1  # slow, not lost
+            assert w.dropped == 0
+            return
+        faults.install("loop.append:ioerror:1:3")
+        assert w.append_batch(x, y) == 0  # dropped, no raise
+        assert w.dropped == 1
+        faults.reset()
+        assert w.append_batch(x, y) == 1  # fault cleared: accepted
+        w.flush()
+        recs, _ = FeedbackReader(w.dir).read_since(None)
+        assert len(recs) == 1  # exactly the accepted record survived
+    finally:
+        w.close()
+
+
 MATRIX = [
     pytest.param(site, kind, id=f"{site}-{kind}",
                  marks=[pytest.mark.chaos])
@@ -685,5 +714,7 @@ def test_fault_matrix(site, kind, tmp_path):
         _scn_serve_reload(kind, tmp_path)
     elif site == "serve.batch":
         _scn_serve_batch(kind, tmp_path)
+    elif site == "loop.append":
+        _scn_loop_append(kind, tmp_path)
     else:  # a new site without a scenario must fail the matrix
         pytest.fail(f"no chaos scenario for registered site {site!r}")

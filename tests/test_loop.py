@@ -1,0 +1,487 @@
+"""Closed-loop continuous training tests (cxxnet_tpu/loop/).
+
+Covers the feedback log's commit/rotation/CRC protocol, the eval-gated
+publisher's accept/reject/rollback semantics, the HTTP ``/feedback``
+route + capture mode, the model-identity observability satellites, and
+the full closed loop: serve a model, append feedback, fine-tune, assert
+the gate blocks a degraded update (rollback observed via the event log)
+and publishes an improving one that the engine hot-reloads.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import config as cfgmod
+from cxxnet_tpu import serve
+from cxxnet_tpu.io.data import create_iterator
+from cxxnet_tpu.loop import (
+    ContinuousLoop,
+    CursorFile,
+    EvalGatedPublisher,
+    FeedbackReader,
+    FeedbackWriter,
+    decode_record,
+    encode_record,
+    metric_improvement,
+    parse_eval_metric,
+)
+from cxxnet_tpu.loop.feedback_log import COMMIT_SUFFIX, list_shards
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils import checkpoint as ckpt
+from cxxnet_tpu.utils import faults
+
+MLP_CFG = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+1:a1] = relu:a1
+layer[a1->out] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 32
+dev = cpu
+eta = 0.05
+metric = error
+"""
+
+
+def synth_iter(nsample=256, bs=32, seed=1):
+    it = create_iterator([
+        ("iter", "synthetic"), ("nsample", str(nsample)),
+        ("input_shape", "1,1,16"), ("nclass", "4"),
+        ("batch_size", str(bs)), ("seed_data", str(seed)),
+    ])
+    it.init()
+    return it
+
+
+def synth_rows(it):
+    """All (data, label) rows of a synthetic iterator's dataset."""
+    rows, labs = [], []
+    it.before_first()
+    while it.next():
+        b = it.value()
+        rows.append(np.asarray(b.data).copy())
+        labs.append(np.asarray(b.label).copy())
+    return np.concatenate(rows), np.concatenate(labs)
+
+
+def make_trained_checkpoint(tmp_path, rounds=1, seed=0):
+    """Train a small MLP briefly and checkpoint it as round 1."""
+    cfg = cfgmod.parse_pairs(MLP_CFG)
+    tr = NetTrainer()
+    tr.set_params(cfg)
+    tr.set_param("seed", str(seed))
+    tr.init_model()
+    it = synth_iter()
+    for _ in range(rounds):
+        it.before_first()
+        while it.next():
+            b = it.value()
+            tr.update_all(np.asarray(b.data), np.asarray(b.label))
+    mdir = str(tmp_path / "models")
+    os.makedirs(mdir, exist_ok=True)
+    ckpt.write_checkpoint(
+        ckpt.publish_path(mdir, 1), tr.checkpoint_bytes(),
+        round_=1, net_fp=tr.net_fp(),
+    )
+    return cfg, mdir, tr
+
+
+# ----------------------------------------------------------------------
+# record codec
+def test_record_roundtrip_3d_and_flat():
+    img = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    rec = decode_record(encode_record(img, [2.0, 7.0]))
+    np.testing.assert_array_equal(rec.data, img)
+    np.testing.assert_array_equal(rec.labels, [2.0, 7.0])
+    flat = decode_record(encode_record(np.arange(16, dtype=np.float32), 3))
+    assert flat.data.shape == (1, 1, 16)
+    np.testing.assert_array_equal(flat.labels, [3.0])
+    with pytest.raises(ValueError):
+        encode_record(np.zeros((2, 2)), 0)  # 2-d is ambiguous
+
+
+# ----------------------------------------------------------------------
+# feedback log: commit protocol
+def test_uncommitted_page_invisible_until_flush(tmp_path):
+    d = str(tmp_path / "log")
+    w = FeedbackWriter(d, page_bytes=1 << 20)
+    x = np.random.RandomState(0).randn(10, 16).astype(np.float32)
+    assert w.append_batch(x, np.zeros((10, 1), np.float32)) == 10
+    r = FeedbackReader(d)
+    assert r.read_since(None)[0] == []  # buffered, not committed
+    assert r.pending(None) == 0
+    assert w.flush() == 10
+    recs, cur = r.read_since(None)
+    assert len(recs) == 10
+    np.testing.assert_array_equal(recs[3].data.reshape(-1), x[3])
+    w.close()
+
+
+def test_torn_tail_and_crc_mismatch_are_skipped(tmp_path):
+    d = str(tmp_path / "log")
+    w = FeedbackWriter(d)
+    x = np.ones((4, 16), np.float32)
+    w.append_batch(x, np.zeros((4, 1), np.float32))
+    w.flush()
+    w.close()
+    (idx, shard), = list_shards(d)
+    # torn page: bytes appended with no commit entry — invisible
+    with open(shard, "ab") as f:
+        f.write(b"\x12garbage-torn-page")
+    r = FeedbackReader(d)
+    recs, cur = r.read_since(None)
+    assert len(recs) == 4
+    # bit rot inside a COMMITTED page: CRC catches it; page skipped,
+    # counted, cursor still advances past it
+    with open(shard, "r+b") as f:
+        f.seek(20)
+        f.write(b"\xff\xff")
+    before = _counter_value("loop_feedback_bad_pages_total")
+    recs, cur2 = r.read_since(None)
+    assert recs == []
+    assert cur2 == cur  # advanced past the bad page, not stuck
+    assert _counter_value("loop_feedback_bad_pages_total") == before + 1
+
+
+def test_torn_commit_sidecar_line_ignored(tmp_path):
+    d = str(tmp_path / "log")
+    w = FeedbackWriter(d)
+    w.append_batch(np.ones((3, 16), np.float32),
+                   np.zeros((3, 1), np.float32))
+    w.flush()
+    w.close()
+    (_, shard), = list_shards(d)
+    with open(shard + COMMIT_SUFFIX, "a", encoding="utf-8") as f:
+        f.write('{"off": 999, "byt')  # crash mid-commit
+    recs, _ = FeedbackReader(d).read_since(None)
+    assert len(recs) == 3
+
+
+def test_rotation_and_cross_shard_tailing(tmp_path):
+    d = str(tmp_path / "log")
+    # tiny pages + tiny rotation: every flush rotates
+    w = FeedbackWriter(d, page_bytes=512, rotate_bytes=1024)
+    x = np.random.RandomState(1).randn(40, 16).astype(np.float32)
+    y = np.arange(40, dtype=np.float32)[:, None]
+    w.append_batch(x, y)
+    w.flush()
+    shards = list_shards(d)
+    assert len(shards) > 1, "rotation never happened"
+    r = FeedbackReader(d)
+    recs, cur = r.read_since(None)
+    assert len(recs) == 40
+    # record order is append order across shard boundaries
+    np.testing.assert_array_equal(
+        np.concatenate([rec.labels for rec in recs]), y.reshape(-1))
+    # tail from a mid-stream cursor: only the new records
+    w.append_batch(x[:7], y[:7])
+    w.flush()
+    recs2, cur2 = r.read_since(cur)
+    assert len(recs2) == 7
+    assert r.pending(cur2) == 0
+    w.close()
+
+
+def test_writer_resumes_after_reopen(tmp_path):
+    d = str(tmp_path / "log")
+    w = FeedbackWriter(d)
+    w.append_batch(np.ones((5, 16), np.float32),
+                   np.zeros((5, 1), np.float32))
+    w.close()  # close() commits the partial page
+    w2 = FeedbackWriter(d)
+    w2.append_batch(np.ones((3, 16), np.float32) * 2,
+                    np.ones((3, 1), np.float32))
+    w2.flush()
+    w2.close()
+    recs, _ = FeedbackReader(d).read_since(None)
+    assert len(recs) == 8
+    assert [float(r.labels[0]) for r in recs] == [0.0] * 5 + [1.0] * 3
+
+
+def test_cursor_file_roundtrip_and_corruption(tmp_path):
+    cf = CursorFile(str(tmp_path / "cursor.json"))
+    assert cf.load() == {"shard": 0, "off": 0}  # absent: fresh
+    cf.store({"shard": 2, "off": 4096})
+    assert cf.load() == {"shard": 2, "off": 4096}
+    with open(cf.path, "w", encoding="utf-8") as f:
+        f.write("{corrupt")
+    assert cf.load() == {"shard": 0, "off": 0}  # unparseable: fresh
+
+
+def _counter_value(name, **labels):
+    fam = __import__("cxxnet_tpu.obs", fromlist=["registry"]).registry() \
+        .snapshot().get(name, {})
+    for key, v in fam.items():
+        if all(f'{k}="{val}"' in key for k, val in labels.items()):
+            return v
+    return 0
+
+
+# ----------------------------------------------------------------------
+# degrade-don't-fail appends (loop.append chaos site)
+def test_append_fault_drops_and_counts_instead_of_raising():
+    import tempfile
+
+    d = tempfile.mkdtemp()
+    w = FeedbackWriter(d)
+    faults.install("loop.append:ioerror:1:2")
+    x = np.ones((1, 16), np.float32)
+    y = np.zeros((1, 1), np.float32)
+    assert w.append_batch(x, y) == 0  # dropped, no raise
+    assert w.append_batch(x, y) == 0
+    assert w.append_batch(x, y) == 1  # limit spent: accepted again
+    assert w.dropped == 2
+    w.flush()
+    recs, _ = FeedbackReader(d).read_since(None)
+    assert len(recs) == 1
+    w.close()
+
+
+def test_append_fault_raises_when_drop_disabled():
+    import tempfile
+
+    w = FeedbackWriter(tempfile.mkdtemp(), drop_on_error=False)
+    faults.install("loop.append:ioerror:1:1")
+    with pytest.raises(OSError):
+        w.append(np.ones(16, np.float32), 0.0)
+    w.close()
+
+
+# ----------------------------------------------------------------------
+# eval-gate primitives
+def test_parse_eval_metric_prefers_section_prefix():
+    line = "\ttrain-error:0\teval-error:0.25\teval-logloss:1.5"
+    assert parse_eval_metric(line, prefix="eval-") == ("eval-error", 0.25)
+    assert parse_eval_metric(line, "logloss", prefix="eval-") == (
+        "eval-logloss", 1.5)
+    with pytest.raises(ValueError):
+        parse_eval_metric("\ttrain-error:0", prefix="eval-")
+    with pytest.raises(ValueError):
+        parse_eval_metric("", prefix="eval-")
+
+
+def test_metric_improvement_orientation():
+    # error/rmse/logloss: down is better
+    assert metric_improvement("eval-error", 0.5, 0.3) == pytest.approx(0.2)
+    assert metric_improvement("eval-logloss[f]", 1.0, 1.2) == pytest.approx(-0.2)
+    # rec@n: up is better
+    assert metric_improvement("eval-rec@5", 0.5, 0.7) == pytest.approx(0.2)
+
+
+# ----------------------------------------------------------------------
+# publish pointer
+def test_publish_pointer_roundtrip(tmp_path):
+    d = str(tmp_path)
+    assert ckpt.read_publish_pointer(d) is None
+    ckpt.write_publish_pointer(d, 3, ckpt.publish_path(d, 3),
+                               net_fp="abcd1234",
+                               metric={"name": "eval-error", "value": 0.1},
+                               prev_round=2)
+    ptr = ckpt.read_publish_pointer(d)
+    assert ptr["round"] == 3 and ptr["prev"]["round"] == 2
+    assert ptr["metric"]["value"] == 0.1
+
+
+# ----------------------------------------------------------------------
+# the closed loop
+def test_closed_loop_gate_blocks_worse_publishes_better(tmp_path):
+    """Serve → poisoned feedback rejected (rollback in the event log) →
+    correct feedback published → engine hot-reloads the new weights
+    fingerprint."""
+    cfg, mdir, _ = make_trained_checkpoint(tmp_path)
+    eng = serve.Engine(cfg=cfg, model_dir=mdir, max_batch_size=32)
+    try:
+        assert eng.round == 1
+        crc0 = eng.model_crc32
+        assert crc0 is not None
+        fdir = str(tmp_path / "feedback")
+        w = FeedbackWriter(fdir)
+        base, ev = synth_iter(), synth_iter()
+        loop = ContinuousLoop(
+            eng, cfg, feedback_dir=fdir, base_iter=base, eval_iter=ev,
+            rounds_per_cycle=2, replay_ratio=0.25, min_records=64,
+            feedback_writer=w, silent=True,
+        )
+        assert loop.publisher.serving_metric is not None
+        X, Y = synth_rows(synth_iter())
+        # below min_records: idle, nothing trains
+        w.append_batch(X[:10], Y[:10])
+        assert loop.run_cycle() == "idle"
+        # poisoned labels: candidate degrades -> gate rejects, engine
+        # keeps serving round 1, trainer rolls back
+        w.append_batch(X[:200], (Y[:200] + 1.0) % 4)
+        assert loop.run_cycle() == "rejected"
+        assert eng.round == 1 and eng.model_crc32 == crc0
+        from cxxnet_tpu.obs import recent
+
+        kinds = [e["kind"] for e in recent(20)]
+        assert "loop.reject" in kinds and "loop.rollback" in kinds
+        # correct labels: candidate improves -> published + hot-reloaded
+        w.append_batch(X, Y)
+        assert loop.run_cycle() == "published"
+        assert eng.round == 2
+        assert eng.model_crc32 != crc0  # new weights fingerprint serves
+        ptr = ckpt.read_publish_pointer(mdir)
+        assert ptr["round"] == 2 and ptr["prev"]["round"] == 1
+        assert [e["kind"] for e in recent(5)][-1] == "loop.cycle"
+        # the published metric becomes the next gate's bar
+        assert loop.publisher.serving_metric == pytest.approx(
+            ptr["metric"]["value"])
+        # cursor consumed everything: an empty cycle is idle
+        assert loop.run_cycle() == "idle"
+        w.close()
+    finally:
+        eng.close()
+
+
+def test_all_bad_pages_consume_cursor_instead_of_stalling(tmp_path):
+    """When every committed page past the cursor fails its CRC, the
+    idle cycle still consumes them — otherwise pending() keeps
+    promising work and every cycle re-reads and re-counts the same rot
+    forever."""
+    cfg, mdir, _ = make_trained_checkpoint(tmp_path)
+    eng = serve.Engine(cfg=cfg, model_dir=mdir, max_batch_size=32)
+    try:
+        fdir = str(tmp_path / "feedback")
+        w = FeedbackWriter(fdir)
+        X, Y = synth_rows(synth_iter())
+        w.append_batch(X[:80], Y[:80])
+        w.flush()
+        (_, shard), = list_shards(fdir)
+        with open(shard, "r+b") as f:  # rot every committed page
+            f.seek(30)
+            f.write(b"\xff\xff\xff")
+        loop = ContinuousLoop(
+            eng, cfg, feedback_dir=fdir, base_iter=synth_iter(),
+            eval_iter=synth_iter(), min_records=64,
+            feedback_writer=w, silent=True,
+        )
+        before = _counter_value("loop_feedback_bad_pages_total")
+        assert loop.run_cycle() == "idle"
+        assert _counter_value("loop_feedback_bad_pages_total") == before + 1
+        assert FeedbackReader(fdir).pending(loop.cursor_file.load()) == 0
+        # the rot is consumed: later cycles do not re-count it
+        assert loop.run_cycle() == "idle"
+        assert _counter_value("loop_feedback_bad_pages_total") == before + 1
+        w.close()
+    finally:
+        eng.close()
+
+
+def test_rejected_cycle_still_advances_cursor(tmp_path):
+    """Poisoned records are consumed, not retried forever: after a
+    reject the same records do not re-train the next cycle."""
+    cfg, mdir, _ = make_trained_checkpoint(tmp_path)
+    eng = serve.Engine(cfg=cfg, model_dir=mdir, max_batch_size=32)
+    try:
+        fdir = str(tmp_path / "feedback")
+        w = FeedbackWriter(fdir)
+        loop = ContinuousLoop(
+            eng, cfg, feedback_dir=fdir, base_iter=synth_iter(),
+            eval_iter=synth_iter(), rounds_per_cycle=1, min_records=32,
+            feedback_writer=w, silent=True,
+        )
+        X, Y = synth_rows(synth_iter())
+        w.append_batch(X[:64], (Y[:64] + 1.0) % 4)
+        assert loop.run_cycle() == "rejected"
+        assert loop.run_cycle() == "idle"
+        w.close()
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# HTTP front-end: /feedback + capture + identity satellites
+def _get(port, path, raw=False):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        body = r.read()
+    return body.decode() if raw else json.loads(body)
+
+
+def _post(port, path, obj):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(obj).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_http_feedback_route_and_identity(tmp_path):
+    cfg, mdir, _ = make_trained_checkpoint(tmp_path)
+    eng = serve.Engine(cfg=cfg, model_dir=mdir, max_batch_size=32,
+                       batch_timeout_ms=1)
+    fdir = str(tmp_path / "feedback")
+    w = FeedbackWriter(fdir)
+    httpd = serve.make_server(eng, port=0, feedback=w,
+                              capture_predict=True)
+    port = httpd.server_port
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    x = np.random.RandomState(0).randn(6, 16).astype(np.float32)
+    try:
+        out = _post(port, "/feedback",
+                    {"data": x.tolist(), "label": [0, 1, 2, 3, 0, 1]})
+        assert out == {"appended": 6, "dropped": 0}
+        # label/data mismatch is a 400, not a drop
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(port, "/feedback", {"data": x.tolist(), "label": [1]})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(port, "/feedback", {"data": x.tolist()})
+        assert e.value.code == 400
+        # capture mode: a successful /predict logs inputs + predictions
+        pred = _post(port, "/predict", {"data": x[:3].tolist()})["pred"]
+        w.flush()
+        recs, _ = FeedbackReader(fdir).read_since(None)
+        assert len(recs) == 9  # 6 feedback + 3 captured
+        np.testing.assert_array_equal(
+            [float(r.labels[0]) for r in recs[6:]], pred)
+        # identity satellites: /healthz + /statsz carry the weights
+        # fingerprint, /metricsz gauges it
+        h = _get(port, "/healthz")
+        assert h["model_crc32"] == eng.model_crc32
+        st = _get(port, "/statsz")
+        assert st["model"]["crc32"] == eng.model_crc32
+        assert st["model"]["round"] == 1
+        mez = _get(port, "/metricsz", raw=True)
+        assert "serve_model_round 1" in mez
+        assert f"serve_model_crc32 {eng.model_crc32}" in mez
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        w.close()
+        eng.close()
+
+
+def test_feedback_route_404_when_unarmed():
+    from test_serve import make_trainer
+
+    eng = serve.Engine(trainer=make_trainer(), max_batch_size=8,
+                       batch_timeout_ms=0)
+    httpd = serve.make_server(eng, port=0)
+    port = httpd.server_port
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(port, "/feedback",
+                  {"data": [[0.0] * 16], "label": [1]})
+        assert e.value.code == 404
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        eng.close()

@@ -11,6 +11,12 @@
 #                                     # (tiny synthetic imgbin, validates
 #                                     # the per-stage JSON schema only —
 #                                     # no flaky throughput assertions)
+#        LOOP=1 tools/run_tier1.sh    # also run the closed-loop smoke:
+#                                     # a real task=serve_train process,
+#                                     # >=1k HTTP feedback records, the
+#                                     # eval gate rejecting a poisoned
+#                                     # update and publishing+reloading
+#                                     # an improving one (JSON verdict)
 #        OBS=1 tools/run_tier1.sh     # also run the observability smoke:
 #                                     # short telemetry=1 train + serve
 #                                     # scrape of /metricsz, then schema-
@@ -33,6 +39,11 @@ if [ "${PERF:-0}" = "1" ]; then
   echo "=== opt-in perf smoke (PERF=1) ==="
   timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python tools/io_bench.py --smoke || rc=1
+fi
+if [ "${LOOP:-0}" = "1" ]; then
+  echo "=== opt-in closed-loop smoke (LOOP=1) ==="
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python tools/loop_smoke.py || rc=1
 fi
 if [ "${OBS:-0}" = "1" ]; then
   echo "=== opt-in observability smoke (OBS=1) ==="
