@@ -903,6 +903,7 @@ class LearnTask:
         import json
         import re
 
+        from .obs import device as obs_device
         from .obs import log_exception_once
         from .utils.profiler import pipeline_stats
 
@@ -929,6 +930,11 @@ class LearnTask:
             "eval": metrics,
             "step": timer.summary(self.net_trainer.batch_size),
             "stages": pipeline_stats().snapshot(),
+            # device plane (doc/observability.md): programs compiled so
+            # far, their estimated FLOPs/bytes, cumulative XLA compile
+            # seconds, sampled step fences — lifetime totals, so per-
+            # round deltas are computable between records
+            "device": obs_device.summary(),
         }
         try:
             d = os.path.dirname(self.telemetry_path)

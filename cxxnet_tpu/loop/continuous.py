@@ -217,6 +217,12 @@ class ContinuousLoop:
             self._m.cycles.labels(outcome="idle").inc()
             return "idle"
         t0 = time.monotonic()
+        # lineage covers exactly THIS cycle's records: a publish ships
+        # only this cycle's fine-tuning (a rejected cycle rolls the
+        # trainer back, so earlier consumed records never contribute),
+        # and building it fresh per cycle means a cycle that failed
+        # mid-training and replays its records cannot double-count them
+        lineage = self._cycle_lineage(records)
         with obs_trace.span("loop.cycle", cycle=self.cycles,
                             records=len(records)):
             steps = 0
@@ -226,8 +232,8 @@ class ContinuousLoop:
                     steps += 1
             self.trainer.sync()
             published = self.publisher.consider(
-                self.trainer, cycle=self.cycles)
-            if not published:
+                self.trainer, cycle=self.cycles, lineage=lineage)
+            if not published:  # these records are spent either way
                 self._rollback()
         self.cursor_file.store(new_cursor)
         self._m.pending.set(self.reader.pending(new_cursor))
@@ -235,7 +241,7 @@ class ContinuousLoop:
         self.trained_cycles += 1
         obs_events.emit(
             "loop.cycle", cycle=self.cycles, records=len(records),
-            steps=steps, published=published,
+            steps=steps, published=published, lineage=lineage,
             elapsed_s=time.monotonic() - t0)
         if not self.silent:
             print(f"loop: cycle {self.cycles}: {len(records)} records, "
@@ -243,6 +249,19 @@ class ContinuousLoop:
                   f"{'published' if published else 'rejected'} "
                   f"({time.monotonic() - t0:.2f}s)", flush=True)
         return "published" if published else "rejected"
+
+    @staticmethod
+    def _cycle_lineage(records: List[FeedbackRecord]) -> dict:
+        """Lineage block for one cycle's consumed records: id range +
+        count (records from pre-lineage pages have no seq and only
+        count; ``cycles`` is kept for pointer-schema stability)."""
+        seqs = [r.seq for r in records if r.seq is not None]
+        return {
+            "first_seq": min(seqs) if seqs else None,
+            "last_seq": max(seqs) if seqs else None,
+            "records": len(records),
+            "cycles": 1,
+        }
 
     def _rollback(self) -> None:
         """Reload the trainer from the last published/serving version

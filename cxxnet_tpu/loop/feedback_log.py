@@ -12,10 +12,15 @@ the existing imgbin tooling.  What this module adds on top is the
 * **atomic page commits** — records buffer in RAM until a page fills
   (``page_bytes``) or :meth:`FeedbackWriter.flush` is called; the page
   bytes are appended to the shard and fsynced, and only THEN is the
-  page's ``{offset, bytes, crc32, nrec}`` entry appended (and fsynced)
-  to the ``.commit`` JSONL sidecar.  A crash mid-append leaves a
-  trailing torn page that no sidecar entry references — readers never
+  page's ``{offset, bytes, crc32, nrec, seq0}`` entry appended (and
+  fsynced) to the ``.commit`` JSONL sidecar.  A crash mid-append leaves
+  a trailing torn page that no sidecar entry references — readers never
   observe it;
+* **lineage sequence ids** — every appended record gets a log-wide id
+  (assigned at append, never reused); a page's ``seq0`` anchors the
+  contiguous range ``[seq0, seq0 + nrec)`` it holds, so the publish
+  pointer can name exactly which records trained a served model
+  (doc/continuous_training.md);
 * **CRC sidecars** — every committed page carries its CRC32; the reader
   verifies before parsing, and a mismatching page (bit rot, torn
   sidecar replay) is skipped and counted, never served to the trainer;
@@ -68,6 +73,13 @@ __all__ = [
 
 SHARD_RE = re.compile(r"feedback-(\d{6})\.bin$")
 COMMIT_SUFFIX = ".commit"
+SEQ_FILE = "seq.json"
+#: lineage ids are handed out from durably RESERVED blocks: one atomic
+#: sidecar write reserves this many ids ahead, so an id acknowledged to
+#: a /feedback client can never be reassigned after a crash (the
+#: unassigned remainder of a block becomes a gap, which readers
+#: tolerate) at a cost of one fsynced write per block, not per append
+SEQ_RESERVE_BLOCK = 1 << 16
 
 
 class _LoopMetrics:
@@ -116,13 +128,22 @@ def loop_metrics() -> _LoopMetrics:
 
 
 class FeedbackRecord:
-    """One decoded (input, labels) feedback instance."""
+    """One decoded (input, labels) feedback instance.
 
-    __slots__ = ("data", "labels")
+    ``seq`` is the record's log-wide sequence id (lineage): assigned at
+    append time, durably recorded per page as the commit entry's
+    ``seq0``, and stamped through the training cycle into the publish
+    pointer so ``PUBLISHED.json`` can name the exact records that
+    trained a served model.  ``None`` for pages committed before the
+    lineage format (legacy sidecars without ``seq0``)."""
 
-    def __init__(self, data: np.ndarray, labels: np.ndarray) -> None:
+    __slots__ = ("data", "labels", "seq")
+
+    def __init__(self, data: np.ndarray, labels: np.ndarray,
+                 seq: Optional[int] = None) -> None:
         self.data = data
         self.labels = labels
+        self.seq = seq
 
 
 def encode_record(data, labels) -> bytes:
@@ -229,7 +250,51 @@ class FeedbackWriter:
         # it is dead bytes; truncate so offsets stay contiguous)
         self._shard_idx = shards[-1][0] if shards else 0
         self._f = None
+        # lineage: the next record sequence id, resumed past everything
+        # ever ASSIGNED — the committed pages' coverage AND the durable
+        # reservation sidecar, so ids acknowledged for records that were
+        # still buffered at a crash are never reused (they become a gap)
+        self._seq_next = self._resume_seq(self.dir, shards)
+        self._seq_reserved = self._seq_next
         self._open_shard(truncate_torn=True)
+
+    @staticmethod
+    def _resume_seq(dir_: str, shards: List[Tuple[int, str]]) -> int:
+        seq = 0
+        for _idx, path in shards:
+            for ent in _read_commits(path):
+                s0 = ent.get("seq0")
+                end = (int(s0) + int(ent["nrec"]) if s0 is not None
+                       else seq + int(ent["nrec"]))
+                seq = max(seq, end)
+        try:
+            with open(os.path.join(dir_, SEQ_FILE), "r",
+                      encoding="utf-8") as f:
+                reserved = json.load(f).get("reserved")
+            if isinstance(reserved, int):
+                seq = max(seq, reserved)
+        except (OSError, ValueError, AttributeError):
+            pass
+        return seq
+
+    def _reserve_seq_locked(self) -> bool:
+        """Make sure ``_seq_next`` lies inside a durably reserved block
+        (one atomic fsynced write per ``SEQ_RESERVE_BLOCK`` ids).  False
+        when the reservation cannot be persisted — the caller must then
+        drop rather than hand out an id a restart could reuse."""
+        if self._seq_next < self._seq_reserved:
+            return True
+        from ..utils.checkpoint import atomic_write_bytes
+
+        limit = self._seq_next + SEQ_RESERVE_BLOCK
+        try:
+            atomic_write_bytes(
+                os.path.join(self.dir, SEQ_FILE),
+                json.dumps({"reserved": limit}).encode("utf-8"))
+        except OSError:
+            return False
+        self._seq_reserved = limit
+        return True
 
     # ------------------------------------------------------------------
     def _open_shard(self, truncate_torn: bool = False) -> None:
@@ -252,23 +317,56 @@ class FeedbackWriter:
         """Buffer one record; returns 1, or 0 when it was dropped
         (``drop_on_error``).  Encoding errors (bad shapes) always
         raise — they are caller bugs, not I/O weather."""
+        return 1 if self.append_seq(data, labels) is not None else 0
+
+    def append_seq(self, data, labels) -> Optional[int]:
+        """Buffer one record and return its lineage sequence id, or
+        ``None`` when the record was dropped.  Ids are assigned at
+        append time and never reused — a page lost to a commit failure
+        leaves a gap, which readers tolerate (ranges come from each
+        committed page's ``seq0``)."""
         blob = encode_record(data, labels)
+        with self._lock:
+            return self._append_blob_locked(blob)
+
+    def _append_blob_locked(self, blob: bytes) -> Optional[int]:
+        """Buffer one encoded record under the writer lock (a hang/IO
+        fault at the ``loop.append`` site therefore holds the lock —
+        exactly what a sick disk would do, since page commits run under
+        it too)."""
         try:
             faults.fault_point("loop.append")
+            if not self._reserve_seq_locked():
+                raise OSError(
+                    "cannot persist the lineage id reservation "
+                    f"({SEQ_FILE}); refusing to hand out a reusable id")
         except OSError as e:
             if not self.drop_on_error:
                 raise
-            self._drop(1, e)
-            return 0
-        with self._lock:
-            self._blobs.append(blob)
-            self._cur += len(blob) + 4
-            if self._cur + 8 >= self.page_bytes:
-                self._commit_page_locked()
-        return 1
+            self._drop_locked(1, e)
+            return None
+        seq = self._seq_next
+        self._seq_next += 1
+        self._blobs.append((blob, seq))
+        self._cur += len(blob) + 4
+        if self._cur + 8 >= self.page_bytes:
+            self._commit_page_locked()
+        return seq
 
     def append_batch(self, data, labels) -> int:
         """Append N instances; returns how many were accepted."""
+        return self.append_batch_ids(data, labels)[0]
+
+    def append_batch_ids(
+        self, data, labels
+    ) -> Tuple[int, Optional[int], Optional[int]]:
+        """Append N instances; returns ``(accepted, first_seq,
+        last_seq)`` — the id range the serve front-end hands back to the
+        ``/feedback`` caller (``None``s when every record dropped).
+        The whole batch is appended under ONE lock hold, so the range
+        covers exactly this caller's records even when concurrent
+        ``/feedback`` handlers interleave (per-record locking would let
+        another request's ids land inside the reported range)."""
         data = np.asarray(data)
         labels = np.asarray(labels)
         if labels.ndim == 1:
@@ -277,14 +375,23 @@ class FeedbackWriter:
             raise ValueError(
                 f"feedback batch: {data.shape[0]} rows vs "
                 f"{labels.shape[0]} labels")
-        n = 0
-        for i in range(data.shape[0]):
-            n += self.append(data[i], labels[i])
-        return n
-
-    def _drop(self, nrec: int, exc: BaseException) -> None:
+        blobs = [encode_record(data[i], labels[i])
+                 for i in range(data.shape[0])]
+        n, first, last = 0, None, None
         with self._lock:
-            self.dropped += nrec
+            for blob in blobs:
+                seq = self._append_blob_locked(blob)
+                if seq is None:
+                    continue
+                n += 1
+                first = seq if first is None else first
+                last = seq
+        return n, first, last
+
+    def _drop_locked(self, nrec: int, exc: BaseException) -> None:
+        """Account a degrade-drop; the caller holds the writer lock
+        (the metrics/event sinks take their own locks)."""
+        self.dropped += nrec
         self._m.dropped.inc(nrec)
         obs_events.log_exception_once(
             "loop.append", exc, kind="loop.append_error", dropped=nrec)
@@ -296,9 +403,9 @@ class FeedbackWriter:
             return 0
         blobs, self._blobs, self._cur = self._blobs, [], 0
         page = bytearray(struct.pack("<II", PAGE_MAGIC, len(blobs)))
-        for b in blobs:
+        for b, _seq in blobs:
             page += struct.pack("<I", len(b))
-        for b in blobs:
+        for b, _seq in blobs:
             page += b
         page = bytes(page)
         try:
@@ -306,9 +413,12 @@ class FeedbackWriter:
             self._f.flush()
             if self.fsync:
                 os.fsync(self._f.fileno())
+            # seq0 is the page's lineage anchor: buffered records are
+            # committed in append order, so the page covers exactly
+            # [seq0, seq0 + nrec) — readers reconstruct per-record ids
             ent = {"off": self._off, "bytes": len(page),
                    "crc32": zlib.crc32(page) & 0xFFFFFFFF,
-                   "nrec": len(blobs)}
+                   "nrec": len(blobs), "seq0": blobs[0][1]}
             cpath = (_shard_path(self.dir, self._shard_idx)
                      + COMMIT_SUFFIX)
             with open(cpath, "a", encoding="utf-8") as cf:
@@ -353,6 +463,7 @@ class FeedbackWriter:
                 "buffered": len(self._blobs),
                 "shard": self._shard_idx,
                 "shard_bytes": self._off,
+                "next_seq": self._seq_next,
             }
 
     def close(self) -> None:
@@ -361,6 +472,20 @@ class FeedbackWriter:
             if self._f is not None:
                 self._f.close()
                 self._f = None
+            # clean shutdown: shrink the reservation to exactly the
+            # next id, so an orderly reopen continues gap-free (only a
+            # crash leaves the unassigned block remainder as a gap)
+            if self._seq_reserved > self._seq_next:
+                from ..utils.checkpoint import atomic_write_bytes
+
+                try:
+                    atomic_write_bytes(
+                        os.path.join(self.dir, SEQ_FILE),
+                        json.dumps(
+                            {"reserved": self._seq_next}).encode("utf-8"))
+                    self._seq_reserved = self._seq_next
+                except OSError:
+                    pass  # the over-reservation stays: a gap, never reuse
 
     def __enter__(self) -> "FeedbackWriter":
         return self
@@ -429,7 +554,7 @@ class FeedbackReader:
                             != ent["crc32"]):
                         raise ValueError(
                             f"page@{ent['off']}: CRC/size mismatch")
-                    out.extend(self._parse_page(page))
+                    out.extend(self._parse_page(page, ent.get("seq0")))
                 except (OSError, ValueError, struct.error) as e:
                     m.bad_pages.inc()
                     obs_events.emit(
@@ -439,15 +564,19 @@ class FeedbackReader:
         return out, cur
 
     @staticmethod
-    def _parse_page(page: bytes) -> Iterator[FeedbackRecord]:
+    def _parse_page(page: bytes,
+                    seq0: Optional[int] = None) -> Iterator[FeedbackRecord]:
         magic, nrec = struct.unpack_from("<II", page)
         if magic != PAGE_MAGIC:
             raise ValueError(f"bad page magic {magic:#x}")
         lens = struct.unpack_from(f"<{nrec}I", page, 8)
         off = 8 + 4 * nrec
         mv = memoryview(page)
-        for l in lens:
-            yield decode_record(mv[off: off + l])
+        for i, l in enumerate(lens):
+            rec = decode_record(mv[off: off + l])
+            if seq0 is not None:
+                rec.seq = int(seq0) + i
+            yield rec
             off += l
 
 

@@ -135,13 +135,17 @@ class EvalGatedPublisher:
         return val
 
     # ------------------------------------------------------------------
-    def consider(self, trainer, cycle: int = -1) -> bool:
+    def consider(self, trainer, cycle: int = -1,
+                 lineage: Optional[dict] = None) -> bool:
         """Gate one candidate; publish + hot-reload on pass.
 
         Returns True when the candidate was published.  On any gate
         failure (non-finite weights, eval regression beyond
         ``min_delta``) nothing is written and False returns — the
-        caller rolls the trainer back."""
+        caller rolls the trainer back.  ``lineage`` (the feedback-record
+        id range + count the candidate was fine-tuned on) rides into the
+        publish pointer so a served model is traceable back to the
+        requests that trained it."""
         if self.serving_metric is None:
             raise RuntimeError(
                 "record_serving_baseline must run before consider()")
@@ -158,7 +162,7 @@ class EvalGatedPublisher:
                               f"publish_min_delta {self.min_delta:g}",
                 metric=name, candidate=cand)
             return False
-        self._publish(trainer, name, cand, gain, cycle)
+        self._publish(trainer, name, cand, gain, cycle, lineage=lineage)
         return True
 
     # ------------------------------------------------------------------
@@ -177,7 +181,7 @@ class EvalGatedPublisher:
                   flush=True)
 
     def _publish(self, trainer, name: str, cand: float, gain: float,
-                 cycle: int) -> None:
+                 cycle: int, lineage: Optional[dict] = None) -> None:
         model_dir = self.engine.model_dir
         prev_round = self.engine.round
         latest = ckpt.list_checkpoints(model_dir)
@@ -194,6 +198,7 @@ class EvalGatedPublisher:
             net_fp=trainer.net_fp(),
             metric={"name": name, "value": cand},
             prev_round=prev_round,
+            lineage=lineage,
         )
         self.serving_metric, self.serving_metric_name = cand, name
         # the reload hook: the engine swaps to the published round NOW
@@ -203,7 +208,7 @@ class EvalGatedPublisher:
         obs_events.emit("loop.publish", cycle=cycle, round=round_,
                         path=path, metric=name, candidate=cand,
                         gain=gain, swapped=swapped,
-                        prev_round=prev_round)
+                        prev_round=prev_round, lineage=lineage)
         if not self.silent:
             print(f"loop: PUBLISHED round {round_} ({name}:{cand:g}, "
                   f"improvement {gain:g}, reloaded={swapped})",

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..io.data import DataBatch
+from ..obs import device as obs_device
 from ..parallel import MeshPlan, make_mesh
 from ..parallel.distributed import fetch_array, fetch_local_rows
 from ..updater import Updater, create_updater
@@ -294,7 +295,8 @@ class NetTrainer:
         # metrics consume the out node on host: always hand back f32
         return loss, (nodes[net.out_node_index()].astype(jnp.float32), new_aux)
 
-    def _jit(self, fn, in_shardings, out_shardings, donate_argnums=()):
+    def _jit(self, fn, in_shardings, out_shardings, donate_argnums=(),
+             kind="program", data_arg=None):
         """jit with shardings only when the mesh is non-trivial.
 
         On a single-device mesh the NamedSharding annotations are pure
@@ -302,13 +304,22 @@ class NetTrainer:
         T=2048): sharding-annotated scan steps ran ~30x slower than the
         same program without annotations (layout constraints defeat
         XLA's scan buffer aliasing/fusion), so 1-device jits drop them.
+
+        Every program is wrapped for device telemetry
+        (``obs/device.py``): the first call per argument-shape
+        signature records the program's estimated FLOPs/bytes and
+        cold-call time as ``xla_program_*{kind,bucket}``, where
+        ``bucket`` is the leading dim of argument ``data_arg``.  A
+        straight pass-through when ``device_telemetry = 0``.
         """
         plan = self.mesh_plan
         if plan is not None and plan.n_devices > 1:
-            return jax.jit(fn, in_shardings=in_shardings,
-                           out_shardings=out_shardings,
-                           donate_argnums=donate_argnums)
-        return jax.jit(fn, donate_argnums=donate_argnums)
+            jf = jax.jit(fn, in_shardings=in_shardings,
+                         out_shardings=out_shardings,
+                         donate_argnums=donate_argnums)
+        else:
+            jf = jax.jit(fn, donate_argnums=donate_argnums)
+        return obs_device.instrument(jf, kind, data_arg=data_arg)
 
     def _fused_step_fn(self):
         """fwd + bwd + updater math as ONE donated SPMD program.
@@ -343,6 +354,7 @@ class NetTrainer:
                 (psh, ush, rep, dsh, dsh, dsh, rep, rep, ex),
                 (psh, ush, rep, rep, dsh),
                 donate_argnums=(0, 1, 2),
+                kind="train_fused", data_arg=3,
             )
         return self._jit_cache["fused"]
 
@@ -408,6 +420,7 @@ class NetTrainer:
                 (psh, ush, rep) + data_sh + (rep, rep),
                 (psh, ush, rep, rep, rep, ys_sh),
                 donate_argnums=(0, 1, 2),
+                kind="train_scan", data_arg=3,
             )
         return self._jit_cache[key]
 
@@ -574,6 +587,7 @@ class NetTrainer:
                 jax.value_and_grad(loss_fn, has_aux=True),
                 (psh, rep, dsh, dsh, dsh, rep, rep, ex),
                 ((rep, rep), psh),
+                kind="train_grad", data_arg=2,
             )
         return self._jit_cache["grad"]
 
@@ -597,6 +611,7 @@ class NetTrainer:
                 f,
                 (psh, rep, dsh, dsh, dsh, rep, rep, ex),
                 (rep, dsh, rep, psh),
+                kind="train_fwd", data_arg=2,
             )
         return self._jit_cache["fwd_train"]
 
@@ -614,7 +629,7 @@ class NetTrainer:
             rep, dsh, ex = self._sh()
             psh, _ = self._param_sh()
             self._jit_cache["eval"] = self._jit(
-                f, (psh, rep, dsh, ex), dsh
+                f, (psh, rep, dsh, ex), dsh, kind="eval", data_arg=2
             )
         return self._jit_cache["eval"]
 
@@ -632,7 +647,7 @@ class NetTrainer:
             rep, dsh, ex = self._sh()
             psh, _ = self._param_sh()
             self._jit_cache[key] = self._jit(
-                f, (psh, rep, dsh, ex), dsh
+                f, (psh, rep, dsh, ex), dsh, kind="extract", data_arg=2
             )
         return self._jit_cache[key]
 
@@ -650,6 +665,7 @@ class NetTrainer:
                 f,
                 (psh, ush, psh, rep),
                 (psh, ush),
+                kind="update_apply",
             )
         return self._jit_cache["apply"]
 
@@ -1020,6 +1036,11 @@ class NetTrainer:
                     self._label_ranges(),
                 )
             self.epoch_counter += 1
+            # sampled device fence (device_sample_every = N): every Nth
+            # update blocks here and the wait lands in the
+            # train_step_device_seconds histogram; off by default — a
+            # fence breaks the async dispatch overlap
+            obs_device.maybe_sample_step(self.epoch_counter, self.sync)
             return
         if self.eval_train:
             loss, out, self.aux, grads = self._fwd_train_fn()(
@@ -1057,6 +1078,7 @@ class NetTrainer:
             self._grad_accum = None
             self.sample_counter = 0
             self.epoch_counter += 1
+            obs_device.maybe_sample_step(self.epoch_counter, self.sync)
 
     def update_all(self, data: np.ndarray, labels: np.ndarray) -> None:
         """numpy-in convenience (wrapper API ``CXNNetUpdateBatch``)."""

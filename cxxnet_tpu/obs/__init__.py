@@ -12,7 +12,15 @@ from any layer:
   trace-event JSON (``trace_dir`` / ``trace_steps`` config keys);
 * :mod:`~cxxnet_tpu.obs.events` — a rotating structured JSONL event log
   for lifecycle facts (``event_log`` / ``event_log_max_bytes`` /
-  ``event_log_backups``), with an always-on in-memory ring.
+  ``event_log_backups``), with an always-on in-memory ring;
+* :mod:`~cxxnet_tpu.obs.device` — device-plane telemetry: per-program
+  XLA FLOPs/bytes, cumulative compile seconds, device-memory
+  watermarks, sampled step fences (``device_telemetry`` /
+  ``device_sample_every``);
+* :mod:`~cxxnet_tpu.obs.alerts` — declarative threshold alerts over
+  registry snapshots (``alert=<name>:<metric>:<op>:<threshold>[:for_s]``
+  / ``alert_period_s``), surfaced at ``GET /alertz`` and in
+  ``/healthz``.
 
 :func:`configure` routes one ordered config stream to every pillar —
 the CLI calls it once at startup, right after the fault injector.
@@ -22,6 +30,8 @@ from __future__ import annotations
 
 from typing import Sequence, Tuple
 
+from . import alerts as alerts
+from . import device as device
 from . import events as events
 from . import trace as trace
 from .events import emit, event_log, log_exception_once, recent
@@ -44,6 +54,8 @@ __all__ = [
     "registry",
     "tracer",
     "span",
+    "alerts",
+    "device",
     "events",
     "trace",
     "event_log",
@@ -61,3 +73,5 @@ def configure(cfg: Sequence[ConfigEntry]) -> None:
     unknown keys ignored — the whole framework's config discipline)."""
     trace.configure(cfg)
     events.configure(cfg)
+    device.configure(cfg)
+    alerts.configure(cfg)

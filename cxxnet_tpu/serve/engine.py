@@ -454,10 +454,17 @@ class Engine:
             return self._trainer
 
     def healthz(self) -> Dict[str, object]:
+        # firing alert rules degrade health exactly like an open reload
+        # breaker: the model still serves, but a load balancer keying on
+        # /healthz sees (and can act on) the named condition
+        from ..obs import alerts as obs_alerts
+
+        firing = obs_alerts.evaluator().firing()
         with self._model_lock:
             status = ("closed" if self._closed
-                      else "degraded" if self.reload_degraded() else "ok")
-            return {
+                      else "degraded" if (self.reload_degraded()
+                                          or firing) else "ok")
+            out = {
                 "status": status,
                 "round": self._round,
                 "model": self._model_path,
@@ -465,6 +472,9 @@ class Engine:
                 "net_fp": self._cache.net_fp(),
                 "reload_breaker": self.reload_breaker.state,
             }
+            if firing:
+                out["alerts"] = firing
+            return out
 
     def snapshot_stats(self) -> Dict[str, object]:
         out = self.stats.snapshot()

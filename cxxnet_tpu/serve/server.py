@@ -17,12 +17,22 @@ the batching opportunity.  Endpoints:
   With capture mode armed (``capture_predict = 1``) every successful
   ``/predict`` also logs its inputs with the model's own predictions
   as labels (self-training capture).
-* ``GET  /healthz``  — liveness + model identity (round, fingerprint)
+* ``GET  /healthz``  — liveness + model identity (round, fingerprint);
+  degrades (and lists the names) while any alert rule is firing
 * ``GET  /statsz``   — serving metrics (see ``metrics.py``)
 * ``GET  /metricsz`` — Prometheus text exposition of the process-wide
   metrics registry (``cxxnet_tpu/obs/registry.py``): request outcomes,
   batch fill/coalescing, latency histogram, reload counters, pipeline
-  stages — the scrape target (doc/observability.md)
+  stages, device-plane families — the scrape target
+  (doc/observability.md)
+* ``GET  /alertz``   — the alert evaluator's rules + live firing state
+  as JSON (``alert=`` config rules; ``cxxnet_tpu/obs/alerts.py``)
+
+Every POST response carries a minted correlation id (``rid``), and a
+``/feedback`` response additionally carries the durable lineage id
+range its accepted records were assigned (``seq: [first, last]``) —
+the handle ``PUBLISHED.json``'s lineage block later refers back to
+(doc/continuous_training.md).
 
 Errors map to JSON bodies with meaningful statuses: 400 malformed
 request, 404 unknown route, 429 load shed, 503 shutting down, 504
@@ -42,7 +52,9 @@ broken reload at full poll rate.
 
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -97,6 +109,14 @@ class _Handler(BaseHTTPRequestHandler):
     verbose = False
     feedback = None  # FeedbackWriter when the loop is armed
     capture_predict = False  # log /predict inputs + predictions
+    # correlation ids: a short per-server token + a monotonic counter,
+    # minted per POST and echoed in the response as "rid" so a client
+    # can tie its request to server-side events and feedback lineage
+    rid_token = "srv"
+    rid_counter = None  # itertools.count, bound by make_server
+
+    def _mint_rid(self) -> str:
+        return f"{self.rid_token}-{next(self.rid_counter)}"
 
     # ------------------------------------------------------------------
     def log_message(self, fmt, *args):  # noqa: N802 - stdlib name
@@ -114,21 +134,23 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _read_json(self) -> Optional[dict]:
+    def _read_json(self, rid: str) -> Optional[dict]:
         try:
             length = int(self.headers.get("Content-Length", 0))
         except ValueError:
             length = 0
         if length <= 0 or length > MAX_BODY_BYTES:
-            self._reply(400, {"error": "missing or oversized body"})
+            self._reply(400, {"error": "missing or oversized body",
+                              "rid": rid})
             return None
         try:
             obj = json.loads(self.rfile.read(length).decode("utf-8"))
         except (ValueError, UnicodeDecodeError) as e:
-            self._reply(400, {"error": f"bad JSON: {e}"})
+            self._reply(400, {"error": f"bad JSON: {e}", "rid": rid})
             return None
         if not isinstance(obj, dict) or "data" not in obj:
-            self._reply(400, {"error": 'body must be {"data": [...]}'})
+            self._reply(400, {"error": 'body must be {"data": [...]}',
+                              "rid": rid})
             return None
         return obj
 
@@ -146,6 +168,10 @@ class _Handler(BaseHTTPRequestHandler):
                     200, obs_registry().render_prometheus(),
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
+            elif self.path == "/alertz":
+                from ..obs import alerts as obs_alerts
+
+                self._reply(200, obs_alerts.evaluator().status())
             else:
                 self._reply(404, {"error": f"unknown route {self.path}"})
 
@@ -154,41 +180,46 @@ class _Handler(BaseHTTPRequestHandler):
             self._do_post()
 
     def _do_post(self) -> None:
+        rid = self._mint_rid()
         if self.path not in ("/predict", "/extract", "/feedback"):
-            self._reply(404, {"error": f"unknown route {self.path}"})
+            self._reply(404, {"error": f"unknown route {self.path}",
+                              "rid": rid})
             return
-        obj = self._read_json()
+        obj = self._read_json(rid)
         if obj is None:
             return
         deadline = obj.get("deadline_ms")
         try:
             if self.path == "/feedback":
-                self._do_feedback(obj)
+                self._do_feedback(obj, rid)
             elif self.path == "/extract":
                 node = obj.get("node")
                 if not node:
-                    self._reply(400, {"error": "extract needs a node name"})
+                    self._reply(400, {"error": "extract needs a node name",
+                                      "rid": rid})
                     return
                 out = self.engine.extract(obj["data"], node,
                                           deadline_ms=deadline)
-                self._reply(200, {"features": out.tolist()})
+                self._reply(200, {"features": out.tolist(), "rid": rid})
             else:
                 kind = "scores" if obj.get("raw") else "predict"
                 out = self.engine.submit(obj["data"], kind=kind,
                                          deadline_ms=deadline)
                 key = "scores" if kind == "scores" else "pred"
-                self._reply(200, {key: np.asarray(out).tolist()})
+                self._reply(200, {key: np.asarray(out).tolist(),
+                                  "rid": rid})
                 # capture AFTER the reply: a page commit's fsyncs must
                 # never sit inside the client's request latency
                 if (self.capture_predict and self.feedback is not None
                         and kind == "predict"):
                     self._capture(obj["data"], out)
         except ServeError as e:
-            self._reply(e.http_status, {"error": str(e)})
+            self._reply(e.http_status, {"error": str(e), "rid": rid})
         except (ValueError, TypeError) as e:
-            self._reply(400, {"error": str(e)})
+            self._reply(400, {"error": str(e), "rid": rid})
         except Exception as e:  # noqa: BLE001 - served as a 500
-            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            self._reply(500, {"error": f"{type(e).__name__}: {e}",
+                              "rid": rid})
 
     @staticmethod
     def _feedback_arrays(obj: dict):
@@ -208,16 +239,20 @@ class _Handler(BaseHTTPRequestHandler):
                 f"{label.shape[0]} labels")
         return data, label
 
-    def _do_feedback(self, obj: dict) -> None:
+    def _do_feedback(self, obj: dict, rid: str) -> None:
         if self.feedback is None:
             self._reply(404, {
-                "error": "no feedback log armed (run task=serve_train)"
+                "error": "no feedback log armed (run task=serve_train)",
+                "rid": rid,
             })
             return
         data, label = self._feedback_arrays(obj)
-        n = self.feedback.append_batch(data, label)
+        n, first, last = self.feedback.append_batch_ids(data, label)
         self._reply(200, {"appended": n,
-                          "dropped": data.shape[0] - n})
+                          "dropped": data.shape[0] - n,
+                          "seq": ([first, last] if first is not None
+                                  else None),
+                          "rid": rid})
 
     def _capture(self, data, preds) -> None:
         """Opt-in /predict capture: inputs + model predictions into the
@@ -254,7 +289,9 @@ def make_server(
     handler = type(
         "BoundHandler", (_Handler,),
         {"engine": engine, "verbose": verbose, "inflight": gauge,
-         "feedback": feedback, "capture_predict": capture_predict},
+         "feedback": feedback, "capture_predict": capture_predict,
+         "rid_token": os.urandom(3).hex(),
+         "rid_counter": itertools.count(1)},
     )
     httpd = ThreadingHTTPServer((host, port), handler)
     httpd.daemon_threads = True

@@ -387,6 +387,7 @@ def write_publish_pointer(
     net_fp: Optional[str] = None,
     metric: Optional[dict] = None,
     prev_round: Optional[int] = None,
+    lineage: Optional[dict] = None,
 ) -> dict:
     """Atomically flip the publish pointer to ``round_``/``path``.
 
@@ -395,7 +396,10 @@ def write_publish_pointer(
     rollback (a rejected candidate, or an operator intervention) reads
     it to find the last version that passed the gate.  ``prev`` keeps
     one level of history — enough to answer "what was serving before
-    this publish" without scanning manifests."""
+    this publish" without scanning manifests.  ``lineage`` records the
+    feedback-log id range (+ record/cycle counts) the published weights
+    were fine-tuned on — ``tools/obs_dump.py --lineage`` resolves it
+    back to the log's committed pages."""
     ptr = {
         "format": MANIFEST_FORMAT,
         "round": int(round_),
@@ -404,6 +408,7 @@ def write_publish_pointer(
         "metric": metric,
         "prev": ({"round": int(prev_round)}
                  if prev_round is not None else None),
+        "lineage": lineage,
         "time": time.time(),
     }
     atomic_write_bytes(

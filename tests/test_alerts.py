@@ -1,0 +1,280 @@
+"""Alert evaluator tests (cxxnet_tpu/obs/alerts.py).
+
+Rule parsing, the ok→pending→firing→cleared state machine (including
+``for_s`` debounce and the derived interval ``_rate``/``_mean`` series),
+the ``GET /alertz`` endpoint's schema (validated with the same
+``tools/obs_dump.py`` parser CI uses), and the /healthz degrade+recover
+contract: a deliberately-tripped latency rule (threshold 0) fires,
+degrades health with its name in the detail, and clears after recovery.
+"""
+
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import config as cfgmod
+from cxxnet_tpu import serve
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.obs import alerts as obs_alerts
+from cxxnet_tpu.obs.registry import MetricsRegistry, registry
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+from obs_dump import validate_alertz  # noqa: E402
+
+MLP_CFG = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+1:a1] = relu:a1
+layer[a1->out] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 32
+dev = cpu
+eta = 0.1
+"""
+
+
+@pytest.fixture(autouse=True)
+def _fresh_alerts():
+    """No test leaks rules (or a firing state that would degrade other
+    tests' /healthz) into the next one."""
+    obs_alerts.reset()
+    yield
+    obs_alerts.reset()
+
+
+# ----------------------------------------------------------------------
+# parsing
+def test_parse_rule_grammar():
+    r = obs_alerts.parse_rule("hi_lat:serve_request_latency_seconds_mean"
+                              ":>:0.25:10")
+    assert (r.name, r.metric, r.op, r.threshold, r.for_s) == (
+        "hi_lat", "serve_request_latency_seconds_mean", ">", 0.25, 10.0)
+    # shell-friendly op spellings canonicalize
+    assert obs_alerts.parse_rule("a:m:ge:1").op == ">="
+    # labeled selector survives the colon split
+    r2 = obs_alerts.parse_rule(
+        'shed:serve_request_outcomes_total{outcome="shed"}:>:0')
+    assert r2.metric == 'serve_request_outcomes_total{outcome="shed"}'
+    # label VALUES may contain colons (device labels like tpu:0): the
+    # spec parses outside-in, so the metric keeps its colons intact
+    r3 = obs_alerts.parse_rule(
+        'mem:xla_device_memory_bytes{device="tpu:0",stat="bytes_in_use"}'
+        ":>=:8e9:30")
+    assert r3.metric == ('xla_device_memory_bytes{device="tpu:0",'
+                         'stat="bytes_in_use"}')
+    assert (r3.op, r3.threshold, r3.for_s) == (">=", 8e9, 30.0)
+    for bad in ("toofew:m:>", "x:m:~:1", "x:m:>:abc", "x::>:1",
+                "bad name:m:>:1", "x:m:>:1:2:3"):
+        with pytest.raises(ValueError):
+            obs_alerts.parse_rule(bad)
+
+
+def test_duplicate_rule_names_rejected_but_reconfigure_ignored():
+    ev = obs_alerts.AlertEvaluator(registry=MetricsRegistry())
+    cfg = [("alert", "a:some_gauge:>:1")]
+    assert ev.configure(cfg) == 1
+    assert ev.configure(cfg) == 0  # idempotent re-configure
+    with pytest.raises(ValueError):
+        ev.add_rule(obs_alerts.parse_rule("a:other:>:2"))
+
+
+# ----------------------------------------------------------------------
+# state machine
+def test_gauge_rule_fires_and_clears():
+    reg = MetricsRegistry()
+    g = reg.gauge("t_depth", "test gauge")
+    ev = obs_alerts.AlertEvaluator(registry=reg)
+    ev.add_rule(obs_alerts.parse_rule("deep:t_depth:>:10"))
+    g.set(3)
+    assert ev.evaluate_once() == [] and ev.firing() == []
+    g.set(42)
+    events = ev.evaluate_once()
+    assert [e["kind"] for e in events] == ["alert.firing"]
+    assert events[0]["value"] == 42 and ev.firing() == ["deep"]
+    # the registry gauge mirrors the state
+    snap = reg.snapshot()
+    assert snap["obs_alerts_firing"]['obs_alerts_firing{name="deep"}'] == 1
+    g.set(0)
+    events = ev.evaluate_once()
+    assert [e["kind"] for e in events] == ["alert.cleared"]
+    assert ev.firing() == []
+    assert reg.snapshot()["obs_alerts_firing"][
+        'obs_alerts_firing{name="deep"}'] == 0
+    trans = reg.snapshot()["obs_alert_transitions_total"]
+    assert trans['obs_alert_transitions_total{name="deep",to="firing"}'] == 1
+    assert trans['obs_alert_transitions_total{name="deep",to="cleared"}'] == 1
+
+
+def test_for_s_debounce():
+    reg = MetricsRegistry()
+    g = reg.gauge("t_load", "test gauge")
+    ev = obs_alerts.AlertEvaluator(registry=reg)
+    ev.add_rule(obs_alerts.parse_rule("hot:t_load:>=:1:5"))
+    g.set(2)
+    assert ev.evaluate_once(now=100.0) == []  # pending, not firing
+    assert ev.status()["rules"][0]["state"] == "pending"
+    assert ev.evaluate_once(now=103.0) == []  # still inside for_s
+    events = ev.evaluate_once(now=105.5)      # held >= 5s -> fires
+    assert [e["kind"] for e in events] == ["alert.firing"]
+    # a dip resets the debounce clock entirely
+    g.set(0)
+    ev.evaluate_once(now=106.0)
+    g.set(2)
+    assert ev.evaluate_once(now=107.0) == []  # pending again from zero
+    assert ev.status()["rules"][0]["state"] == "pending"
+
+
+def test_labeled_family_any_sample_fires():
+    reg = MetricsRegistry()
+    c = reg.counter("t_outcomes_total", "", labelnames=("outcome",))
+    ev = obs_alerts.AlertEvaluator(registry=reg)
+    ev.add_rule(obs_alerts.parse_rule(
+        't_shed:t_outcomes_total{outcome="shed"}:>:0'))
+    ev.add_rule(obs_alerts.parse_rule("t_any:t_outcomes_total:>:2"))
+    c.labels(outcome="ok").inc(3)
+    ev.evaluate_once()
+    assert ev.firing() == ["t_any"]  # bare family matches any labelset
+    c.labels(outcome="shed").inc()
+    ev.evaluate_once()
+    assert ev.firing() == ["t_any", "t_shed"]
+
+
+def test_derived_rate_and_mean_clear_after_recovery():
+    """The deliberately-tripped latency rule of the acceptance bar:
+    threshold 0 on the interval mean fires while observations land and
+    clears once traffic stops — where the lifetime mean never would."""
+    reg = MetricsRegistry()
+    h = reg.histogram("t_lat_seconds", "test latency")
+    c = reg.counter("t_reqs_total", "test requests")
+    ev = obs_alerts.AlertEvaluator(registry=reg)
+    ev.add_rule(obs_alerts.parse_rule("lat0:t_lat_seconds_mean:>:0"))
+    ev.add_rule(obs_alerts.parse_rule("busy:t_reqs_rate:>:100"))
+    ev.evaluate_once(now=10.0)  # baseline snapshot
+    for _ in range(300):
+        c.inc()
+        h.observe(0.02)
+    ev.evaluate_once(now=11.0)  # 300 req/s, mean 20ms > 0
+    assert ev.firing() == ["busy", "lat0"]
+    # recovery: no new observations in the next interval
+    events = ev.evaluate_once(now=12.0)
+    assert sorted(e["kind"] for e in events) == ["alert.cleared",
+                                                "alert.cleared"]
+    assert ev.firing() == []
+
+
+def test_status_is_valid_alertz_schema():
+    reg = MetricsRegistry()
+    reg.gauge("t_x", "").set(5)
+    ev = obs_alerts.AlertEvaluator(registry=reg)
+    ev.add_rule(obs_alerts.parse_rule("x_high:t_x:>:1"))
+    ev.add_rule(obs_alerts.parse_rule("x_low:t_x:<:0"))
+    ev.evaluate_once()
+    body = json.loads(json.dumps(ev.status()))  # HTTP round-trip
+    assert validate_alertz(body) == []
+    assert body["firing"] == ["x_high"]
+    states = {r["name"]: r["state"] for r in body["rules"]}
+    assert states == {"x_high": "firing", "x_low": "ok"}
+
+
+# ----------------------------------------------------------------------
+# the serve surface: /alertz + /healthz degrade and recover
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_alertz_endpoint_and_healthz_degrade(tmp_path):
+    """End-to-end acceptance path: a latency rule with threshold 0 on
+    the process-wide evaluator fires after real /predict traffic,
+    /alertz reports it, /healthz degrades with the rule named, and both
+    recover once traffic stops."""
+    tr = NetTrainer()
+    tr.set_params(cfgmod.parse_pairs(MLP_CFG))
+    tr.set_param("seed", "0")
+    tr.init_model()
+    eng = serve.Engine(trainer=tr, max_batch_size=32, batch_timeout_ms=1)
+    ev = obs_alerts.evaluator()  # the singleton the server reads
+    ev.configure([
+        ("alert", "trip_lat:serve_request_latency_seconds_mean:>:0"),
+    ])
+    httpd = serve.make_server(eng, port=0)
+    port = httpd.server_port
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+    try:
+        ev.evaluate_once()  # baseline
+        assert _get(port, "/healthz")["status"] == "ok"
+        body = _get(port, "/alertz")
+        assert validate_alertz(body) == []
+        assert [r["name"] for r in body["rules"]] == ["trip_lat"]
+        assert body["firing"] == []
+        out = _get_post(port, "/predict", {"data": x.tolist()})
+        assert len(out["pred"]) == 4 and out["rid"]
+        ev.evaluate_once()  # latency observations landed -> fires
+        body = _get(port, "/alertz")
+        assert validate_alertz(body) == []
+        assert body["firing"] == ["trip_lat"]
+        h = _get(port, "/healthz")
+        assert h["status"] == "degraded" and h["alerts"] == ["trip_lat"]
+        # recovery: a quiet interval clears the rule and health returns
+        ev.evaluate_once()
+        assert _get(port, "/alertz")["firing"] == []
+        h = _get(port, "/healthz")
+        assert h["status"] == "ok" and "alerts" not in h
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        eng.close()
+
+
+def _get_post(port, path, obj):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(obj).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_background_thread_lifecycle():
+    reg = MetricsRegistry()
+    reg.gauge("t_bg", "").set(9)
+    ev = obs_alerts.AlertEvaluator(registry=reg, period_s=0.05)
+    ev.add_rule(obs_alerts.parse_rule("bg:t_bg:>:1"))
+    ev.start()
+    try:
+        deadline = 5.0
+        import time as _t
+
+        t0 = _t.monotonic()
+        while ev.firing() != ["bg"] and _t.monotonic() - t0 < deadline:
+            _t.sleep(0.02)
+        assert ev.firing() == ["bg"]
+        assert ev.status()["running"]
+    finally:
+        ev.stop()
+    assert not ev.status()["running"]
+
+
+def test_configure_via_obs_configure_starts_nothing_without_rules():
+    # the CLI path: obs.configure with no alert= keys must not spawn a
+    # thread or change evaluator state
+    from cxxnet_tpu import obs
+
+    obs.configure([("telemetry", "0")])
+    assert not obs_alerts.evaluator().status()["running"]
+    assert obs_alerts.evaluator().rules() == []

@@ -436,7 +436,11 @@ def test_http_feedback_route_and_identity(tmp_path):
     try:
         out = _post(port, "/feedback",
                     {"data": x.tolist(), "label": [0, 1, 2, 3, 0, 1]})
-        assert out == {"appended": 6, "dropped": 0}
+        assert out["appended"] == 6 and out["dropped"] == 0
+        # lineage: the response names the durable id range the records
+        # got, and a correlation id ties the request to server events
+        assert out["seq"] == [0, 5]
+        assert isinstance(out["rid"], str) and out["rid"]
         # label/data mismatch is a 400, not a drop
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(port, "/feedback", {"data": x.tolist(), "label": [1]})
@@ -485,3 +489,150 @@ def test_feedback_route_404_when_unarmed():
         httpd.shutdown()
         httpd.server_close()
         eng.close()
+
+
+# ----------------------------------------------------------------------
+# lineage: request -> feedback seq ids -> publish pointer -> resolution
+def test_feedback_seq_ids_durable_across_reopen(tmp_path):
+    d = str(tmp_path / "log")
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    w = FeedbackWriter(d)
+    n, first, last = w.append_batch_ids(x[:5], np.arange(5.0))
+    assert (n, first, last) == (5, 0, 4)
+    w.close()  # close commits the buffered page
+    # the commit sidecar anchors the page's id range
+    (_, shard), = list_shards(d)
+    ent = json.loads(open(shard + COMMIT_SUFFIX).read().splitlines()[0])
+    assert ent["seq0"] == 0 and ent["nrec"] == 5
+    # a reopened writer resumes PAST everything ever assigned
+    w2 = FeedbackWriter(d)
+    n, first, last = w2.append_batch_ids(x[5:], np.arange(3.0))
+    assert (n, first, last) == (3, 5, 7)
+    w2.close()
+    # the reader hands each record its id back
+    recs, _ = FeedbackReader(d).read_since(None)
+    assert [r.seq for r in recs] == list(range(8))
+
+
+def test_closed_loop_publish_carries_resolvable_lineage(tmp_path):
+    """The acceptance chain: poisoned records are consumed but must NOT
+    appear in the published lineage (their effect was rolled back); the
+    publishing cycle's id range lands in PUBLISHED.json and resolves
+    back to committed feedback pages via tools/obs_dump.py."""
+    cfg, mdir, _ = make_trained_checkpoint(tmp_path)
+    eng = serve.Engine(cfg=cfg, model_dir=mdir, max_batch_size=32)
+    try:
+        fdir = str(tmp_path / "feedback")
+        w = FeedbackWriter(fdir)
+        loop = ContinuousLoop(
+            eng, cfg, feedback_dir=fdir, base_iter=synth_iter(),
+            eval_iter=synth_iter(), rounds_per_cycle=2, min_records=64,
+            feedback_writer=w, silent=True,
+        )
+        X, Y = synth_rows(synth_iter())
+        # phase A: poisoned -> rejected; ids 0..199 are spent
+        w.append_batch(X[:200], (Y[:200] + 1.0) % 4)
+        assert loop.run_cycle() == "rejected"
+        # phase B: correct -> published; ids 200..455 trained the model
+        w.append_batch(X, Y)
+        assert loop.run_cycle() == "published"
+        ptr = ckpt.read_publish_pointer(mdir)
+        lin = ptr["lineage"]
+        assert lin == {"first_seq": 200, "last_seq": 455,
+                       "records": 256, "cycles": 1}
+        # resolution end to end (what --lineage runs)
+        import os as _os
+        import sys as _sys
+
+        _sys.path.insert(0, _os.path.join(
+            _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+            "tools"))
+        import obs_dump
+
+        report, problems = obs_dump.resolve_lineage(mdir, fdir)
+        assert problems == []
+        assert report["lineage"] == lin
+        assert report["round"] == ptr["round"]
+        res = report["resolved"]
+        assert res["records_in_range"] == 256
+        assert all(p["seq"][0] >= 0 for p in res["pages"])
+        w.close()
+    finally:
+        eng.close()
+
+
+def test_lineage_resolution_fails_loud_without_pointer(tmp_path):
+    import os as _os
+    import sys as _sys
+
+    _sys.path.insert(0, _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        "tools"))
+    import obs_dump
+
+    _report, problems = obs_dump.resolve_lineage(str(tmp_path))
+    assert problems and "cannot read" in problems[0]
+    # a pointer written outside the loop (no lineage block) is reported
+    ckpt.write_publish_pointer(str(tmp_path), 1, "0001.model")
+    report, problems = obs_dump.resolve_lineage(str(tmp_path))
+    assert report["lineage"] is None
+    assert problems and "no lineage block" in problems[0]
+
+
+def test_concurrent_feedback_batches_get_disjoint_contiguous_ranges(tmp_path):
+    """Concurrent /feedback handlers must each get an id range covering
+    exactly their own records — the whole batch is appended under one
+    lock hold, so ranges are contiguous and never interleave."""
+    d = str(tmp_path / "log")
+    w = FeedbackWriter(d)
+    x = np.random.RandomState(0).randn(20, 16).astype(np.float32)
+    ranges = []
+    lock = threading.Lock()
+
+    def poster():
+        for _ in range(10):
+            out = w.append_batch_ids(x, np.zeros((20, 1), np.float32))
+            with lock:
+                ranges.append(out)
+
+    threads = [threading.Thread(target=poster) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(ranges) == 40
+    spans = []
+    for n, first, last in ranges:
+        assert n == 20
+        assert last - first + 1 == 20  # contiguous: only OUR records
+        spans.append((first, last))
+    spans.sort()
+    for (_, a_last), (b_first, _) in zip(spans, spans[1:]):
+        assert b_first == a_last + 1  # disjoint, gap-free total order
+    w.close()
+
+
+def test_acknowledged_seq_ids_never_reused_after_crash(tmp_path):
+    """Ids handed to /feedback clients for records still BUFFERED at a
+    crash must never be reassigned: assignment draws from durably
+    reserved blocks, so a crashed writer's successor starts past the
+    reservation (a gap), while a cleanly closed writer resumes exactly."""
+    d = str(tmp_path / "log")
+    x = np.ones((3, 16), np.float32)
+    y = np.zeros((3, 1), np.float32)
+    w = FeedbackWriter(d)
+    w.append_batch_ids(x, y)      # seqs 0-2
+    w.flush()                     # committed: pages cover through 2
+    _, first, last = w.append_batch_ids(x, y)  # seqs 3-5, buffered only
+    assert (first, last) == (3, 5)
+    # simulate a crash: no close(), the buffered page never commits
+    w._f.close()
+    w2 = FeedbackWriter(d)
+    _, first2, _ = w2.append_batch_ids(x, y)
+    assert first2 > 5  # acknowledged ids 3-5 are a gap, never reused
+    w2.close()
+    # clean close shrinks the reservation: the next reopen is gap-free
+    w3 = FeedbackWriter(d)
+    _, first3, _ = w3.append_batch_ids(x, y)
+    assert first3 == first2 + 3
+    w3.close()

@@ -13,6 +13,7 @@ import json
 import os
 import sys
 import threading
+import time
 
 import pytest
 
@@ -23,6 +24,7 @@ from cxxnet_tpu.obs.registry import (
     Histogram,
     MetricsRegistry,
     escape_label_value,
+    registry,
 )
 from cxxnet_tpu.obs.trace import Tracer
 
@@ -460,3 +462,79 @@ def test_validate_events_schema(tmp_path):
         f.write(json.dumps({"ts": "notanumber", "kind": ""}) + "\n")
     probs = obs_dump.validate_events(str(p))
     assert any("ts" in x for x in probs) and any("kind" in x for x in probs)
+
+
+# ----------------------------------------------------------------------
+# concurrent scrapes (ISSUE 7 satellite): /metricsz + /alertz bodies
+# rendered while worker threads hammer every pillar
+def test_concurrent_scrapes_with_live_writers():
+    """Concurrent exposition + alert-status reads while spans, events,
+    counters and histograms are being recorded from worker threads: no
+    torn exposition (every scrape parses clean), no deadlock, and the
+    alert evaluator keeps evaluating throughout."""
+    import json as _json
+
+    from cxxnet_tpu.obs import alerts as obs_alerts
+    from cxxnet_tpu.obs import device as obs_device
+    from cxxnet_tpu.obs import emit, span, tracer
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from obs_dump import validate_alertz, validate_prometheus_text
+
+    tracer().enable(ring=256)
+    reg = registry()
+    c = reg.counter("t_scrape_total", "scrape test", labelnames=("k",))
+    h = reg.histogram("t_scrape_seconds", "scrape test")
+    obs_alerts.reset()
+    ev = obs_alerts.evaluator()
+    ev.configure([("alert", "t_scrape_busy:t_scrape_rate:>:1e12")])
+    stop = threading.Event()
+    errors = []
+
+    def writer(i):
+        k = f"w{i}"
+        while not stop.is_set():
+            try:
+                c.labels(k=k).inc()
+                h.observe(0.001 * i)
+                with span("t.scrape", worker=i):
+                    emit("t.scrape", worker=i)
+            except Exception as e:  # noqa: BLE001 - collected below
+                errors.append(e)
+                return
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                text = reg.render_prometheus()
+                probs = validate_prometheus_text(text)
+                if probs:
+                    errors.append(AssertionError(probs[:3]))
+                    return
+                body = _json.loads(_json.dumps(ev.status()))
+                probs = validate_alertz(body)
+                if probs:
+                    errors.append(AssertionError(probs[:3]))
+                    return
+                ev.evaluate_once()
+            except Exception as e:  # noqa: BLE001 - collected below
+                errors.append(e)
+                return
+
+    threads = ([threading.Thread(target=writer, args=(i,))
+                for i in range(4)]
+               + [threading.Thread(target=scraper) for _ in range(3)])
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive(), "scrape/writer thread deadlocked"
+    assert errors == []
+    assert ev.evaluations > 0
+    # the device-plane families render alongside without tearing either
+    obs_device.device_metrics()
+    assert validate_prometheus_text(reg.render_prometheus()) == []
+    obs_alerts.reset()
+    tracer().reset()

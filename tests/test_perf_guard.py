@@ -1,0 +1,155 @@
+"""perf_guard sentinel tests (tools/perf_guard.py).
+
+Pure-logic coverage of the rolling baseline, noise band, orientation
+rules, history round-trip and verdict schema; the OBS=1 lane runs the
+real two-measurement ``--smoke`` end to end.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import perf_guard  # noqa: E402
+
+
+def _entry(ts, **metrics):
+    return {"ts": ts, "bench": "io_bench", "host": "t",
+            "metrics": metrics}
+
+
+# ----------------------------------------------------------------------
+def test_orientation_rules():
+    assert not perf_guard.lower_is_better("serial.img_per_sec")
+    assert not perf_guard.lower_is_better("closed.speedup")
+    assert perf_guard.lower_is_better("closed.concurrent.latency_ms.p99")
+    assert perf_guard.lower_is_better("closed.sequential.wall_sec")
+    # markers match the FULL dotted path: a markerless leaf under a
+    # latency parent must not invert the regression direction
+    assert perf_guard.lower_is_better("closed.concurrent.latency_ms.mean")
+
+
+def test_first_run_is_baseline_verdict():
+    doc = perf_guard.compare("io_bench", {"serial.img_per_sec": 100.0},
+                             history=[])
+    assert doc["verdict"] == "baseline"
+    assert doc["baseline"] is None
+    assert perf_guard.validate_verdict(doc) == []
+
+
+def test_regression_detected_outside_band():
+    hist = [_entry(i, **{"serial.img_per_sec": v})
+            for i, v in enumerate([100, 98, 102, 101, 99])]
+    ok = perf_guard.compare("io_bench", {"serial.img_per_sec": 85.0},
+                            hist, band=0.2)
+    assert ok["verdict"] == "ok"  # -15% sits inside the 20% band
+    bad = perf_guard.compare("io_bench", {"serial.img_per_sec": 70.0},
+                             hist, band=0.2)
+    assert bad["verdict"] == "regression"
+    (row,) = bad["regressions"]
+    assert row["metric"] == "serial.img_per_sec"
+    assert row["baseline"] == 100  # median of the window
+    assert perf_guard.validate_verdict(bad) == []
+
+
+def test_latency_regresses_upward_and_improves_downward():
+    hist = [_entry(i, **{"closed.concurrent.latency_ms.p99": 10.0})
+            for i in range(5)]
+    worse = perf_guard.compare(
+        "io_bench", {"closed.concurrent.latency_ms.p99": 15.0}, hist,
+        band=0.2)
+    assert worse["verdict"] == "regression"
+    better = perf_guard.compare(
+        "io_bench", {"closed.concurrent.latency_ms.p99": 6.0}, hist,
+        band=0.2)
+    assert better["verdict"] == "ok"
+    assert [r["metric"] for r in better["improvements"]] == [
+        "closed.concurrent.latency_ms.p99"]
+
+
+def test_rolling_window_median_ignores_older_entries():
+    hist = ([_entry(i, **{"serial.img_per_sec": 1000.0})
+             for i in range(3)]
+            + [_entry(10 + i, **{"serial.img_per_sec": 100.0})
+               for i in range(5)])
+    doc = perf_guard.compare("io_bench", {"serial.img_per_sec": 95.0},
+                             hist, window=5, band=0.2)
+    assert doc["baseline"]["serial.img_per_sec"] == 100.0
+    assert doc["verdict"] == "ok"
+
+
+def test_new_metric_without_prior_history_is_not_a_regression():
+    hist = [_entry(i, **{"serial.img_per_sec": 100.0}) for i in range(5)]
+    doc = perf_guard.compare(
+        "io_bench",
+        {"serial.img_per_sec": 99.0, "workers=2.img_per_sec": 5.0},
+        hist, band=0.2)
+    assert doc["verdict"] == "ok"
+    assert "workers=2.img_per_sec" not in (doc["baseline"] or {})
+
+
+def test_history_roundtrip_skips_torn_and_foreign_lines(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    perf_guard.append_history(path, _entry(1, **{"m": 1.0}))
+    perf_guard.append_history(path, {"ts": 2, "bench": "serve_bench",
+                                     "host": "t", "metrics": {"m": 9.0}})
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"torn": \n')  # crash mid-append
+    perf_guard.append_history(path, _entry(3, **{"m": 2.0}))
+    hist = perf_guard.load_history(path, "io_bench")
+    assert [e["metrics"]["m"] for e in hist] == [1.0, 2.0]
+    assert [e["metrics"]["m"]
+            for e in perf_guard.load_history(path, "serve_bench")] == [9.0]
+
+
+def test_run_once_appends_and_emits_alert_event(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    io_doc = {"results": [{"mode": "serial", "img_per_sec": 100.0,
+                           "decode_augment_per_sec": 200.0, "stages": {}}]}
+    first = perf_guard.run_once("io_bench", io_doc, path, 5, 0.2)
+    assert first["verdict"] == "baseline"
+    slow = {"results": [{"mode": "serial", "img_per_sec": 10.0,
+                         "decode_augment_per_sec": 20.0, "stages": {}}]}
+    second = perf_guard.run_once("io_bench", slow, path, 5, 0.2)
+    assert second["verdict"] == "regression"
+    assert len(perf_guard.load_history(path, "io_bench")) == 2
+    from cxxnet_tpu.obs import recent
+
+    kinds = [e["kind"] for e in recent(10)]
+    assert "alert.perf_regression" in kinds
+
+
+def test_flatten_serve_bench():
+    doc = {"closed_loop": {
+        "sequential": {"req_per_sec": 50.0, "rows_per_sec": 50.0,
+                       "latency_ms": {"p50": 2.0, "p99": 5.0}},
+        "concurrent": {"req_per_sec": 200.0, "rows_per_sec": 200.0,
+                       "latency_ms": {"p50": 4.0, "p99": 9.0}},
+        "speedup": 4.0,
+    }}
+    m = perf_guard.flatten_serve_bench(doc)
+    assert m["closed.speedup"] == 4.0
+    assert m["closed.concurrent.latency_ms.p99"] == 9.0
+    assert m["closed.sequential.req_per_sec"] == 50.0
+
+
+def test_empty_metrics_is_an_error(tmp_path):
+    with pytest.raises(ValueError):
+        perf_guard.run_once("io_bench", {"results": []},
+                            str(tmp_path / "h.jsonl"), 5, 0.2)
+
+
+def test_verdict_schema_catches_drift():
+    doc = perf_guard.compare("io_bench", {"m": 1.0}, [])
+    assert perf_guard.validate_verdict(doc) == []
+    bad = dict(doc)
+    bad["verdict"] = "maybe"
+    assert perf_guard.validate_verdict(bad)
+    bad2 = dict(doc)
+    bad2["metrics"] = {"m": float("nan")}
+    assert perf_guard.validate_verdict(bad2)
+    json.dumps(doc)  # the verdict is a printable JSON document

@@ -34,6 +34,7 @@ import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
 
 CONF = """
 data = train
@@ -263,9 +264,26 @@ def main() -> None:
             if needle not in mez:
                 _fail(f"/metricsz is missing {needle!r}", proc)
 
+        # lineage: the publish pointer must name the id range that
+        # trained the served model, and obs_dump --lineage must resolve
+        # it back to committed feedback pages (ISSUE 7 acceptance)
+        import obs_dump
+
+        lineage_report, lineage_problems = obs_dump.resolve_lineage(
+            mdir, os.path.join(work, "loop", "feedback"))
+        lin = lineage_report.get("lineage") or {}
+        resolved = lineage_report.get("resolved") or {}
+        lineage_ok = (not lineage_problems
+                      and isinstance(lin.get("first_seq"), int)
+                      and isinstance(lin.get("last_seq"), int)
+                      and lin.get("records", 0) >= 1
+                      and resolved.get("records_in_range", 0) >= 1)
+
         verdict = {
             "ok": True,
             "records": ingested,
+            "lineage": lin or None,
+            "lineage_resolved": lineage_ok,
             "rejected": len(_events(events_path, "loop.reject")),
             "rollbacks": len(_events(events_path, "loop.rollback")),
             "published": len(publishes),
@@ -280,7 +298,7 @@ def main() -> None:
               and verdict["rollbacks"] >= 1 and verdict["published"] >= 1
               and verdict["cycles"] >= 2
               and verdict["round_after"] > verdict["round_before"]
-              and verdict["crc_changed"])
+              and verdict["crc_changed"] and verdict["lineage_resolved"])
         verdict["ok"] = bool(ok)
         # ---- graceful drain
         proc.send_signal(signal.SIGTERM)

@@ -16,13 +16,26 @@ asserts.  Three artifact kinds:
   / ``steps`` / ``eval`` / ``stages``).
 * **events** — the rotating structured event log (``event_log=...``):
   one JSON object per line with ``ts`` + ``kind``.
+* **alertz** — the ``GET /alertz`` JSON body (``--alertz`` file or
+  URL): configured rules with live firing state.
+
+``--require fam1,fam2`` additionally asserts that the exposition text
+carries those metric families — how the CI lane pins the device-plane
+families (``xla_program_flops``, ``xla_compile_seconds_total``, ...).
+
+``--lineage MODEL_DIR [--feedback DIR]`` answers "which requests
+trained the model now serving": reads ``PUBLISHED.json``'s lineage
+block (feedback-record id range + counts) and, given the feedback log
+directory, resolves the range to the committed pages/shards holding
+those records.
 
 Usage:
   python tools/obs_dump.py --check --metrics /tmp/metricsz.txt \\
-      --telemetry telemetry.jsonl --events events.jsonl
+      --telemetry telemetry.jsonl --events events.jsonl \\
+      --alertz /tmp/alertz.json --require xla_program_flops
   python tools/obs_dump.py --tail 20 --events events.jsonl
   python tools/obs_dump.py --summary --events events.jsonl
-  python tools/obs_dump.py --summary --telemetry telemetry.jsonl
+  python tools/obs_dump.py --lineage models/ --feedback loop/feedback
 
 ``--check`` exits non-zero on the first schema violation, printing
 every problem found; ``--tail``/``--summary`` are the human front-end.
@@ -33,6 +46,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import re
 import sys
 from typing import Dict, List, Optional, Tuple
@@ -245,6 +259,74 @@ def validate_telemetry(path: str) -> List[str]:
     return problems
 
 
+def exposition_families(text: str) -> set:
+    """Family names present in an exposition text: TYPE declarations
+    plus bare sample names (suffix-stripped for histogram parts)."""
+    fams = set()
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) >= 3:
+                fams.add(parts[2])
+            continue
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m:
+            name = m.group(1)
+            fams.add(name)
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix):
+                    fams.add(name[: -len(suffix)])
+    return fams
+
+
+_ALERT_STATES = ("ok", "pending", "firing")
+_ALERT_RULE_KEYS = ("name", "metric", "op", "threshold", "for_s", "state")
+
+
+def validate_alertz(obj) -> List[str]:
+    """Schema-check a ``GET /alertz`` body; returns problems."""
+    problems: List[str] = []
+    if not isinstance(obj, dict):
+        return ["alertz: body is not an object"]
+    for key in ("period_s", "rules", "firing"):
+        if key not in obj:
+            problems.append(f"alertz: missing key {key!r}")
+    rules = obj.get("rules")
+    if not isinstance(rules, list):
+        problems.append("alertz: rules is not a list")
+        rules = []
+    names = set()
+    for i, rule in enumerate(rules):
+        if not isinstance(rule, dict):
+            problems.append(f"alertz: rule[{i}] is not an object")
+            continue
+        for key in _ALERT_RULE_KEYS:
+            if key not in rule:
+                problems.append(f"alertz: rule[{i}] missing {key!r}")
+        if rule.get("state") not in _ALERT_STATES:
+            problems.append(
+                f"alertz: rule[{i}] bad state {rule.get('state')!r}")
+        if rule.get("op") not in (">", "<", ">=", "<="):
+            problems.append(f"alertz: rule[{i}] bad op {rule.get('op')!r}")
+        names.add(rule.get("name"))
+    firing = obj.get("firing")
+    if not isinstance(firing, list):
+        problems.append("alertz: firing is not a list")
+    else:
+        # str() both sides: a malformed rule with no name must yield a
+        # reported problem, not a None-vs-str sort TypeError
+        expect = sorted(str(r.get("name")) for r in rules
+                        if isinstance(r, dict)
+                        and r.get("state") == "firing")
+        if sorted(str(n) for n in firing) != expect:
+            problems.append(
+                f"alertz: firing {firing} inconsistent with rule "
+                f"states {expect}")
+    return problems
+
+
 def validate_events(path: str) -> List[str]:
     """Schema-check an event log; returns problems (empty == valid)."""
     problems: List[str] = []
@@ -266,6 +348,102 @@ def validate_events(path: str) -> List[str]:
 
 
 # ----------------------------------------------------------------------
+# lineage: PUBLISHED.json -> feedback-log pages
+_SHARD_COMMIT_RE = re.compile(r"^feedback-(\d{6})\.bin\.commit$")
+
+
+def _feedback_pages(feedback_dir: str) -> List[Tuple[int, Dict]]:
+    """All committed page entries ``(shard_idx, entry)`` across the
+    log's ``.commit`` sidecars, shard order (same trust rules as the
+    reader: stop a shard at the first torn/foreign line)."""
+    out: List[Tuple[int, Dict]] = []
+    try:
+        names = sorted(os.listdir(feedback_dir))
+    except OSError:
+        return out
+    for n in names:
+        m = _SHARD_COMMIT_RE.match(n)
+        if not m:
+            continue
+        idx = int(m.group(1))
+        try:
+            with open(os.path.join(feedback_dir, n), "r",
+                      encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        for line in text.split("\n"):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ent = json.loads(line)
+            except ValueError:
+                break
+            # same required keys as FeedbackReader._read_commits — an
+            # entry the reader would refuse must not count as trained-on
+            if isinstance(ent, dict) and {"off", "bytes", "crc32",
+                                          "nrec"} <= set(ent):
+                out.append((idx, ent))
+            else:
+                break
+    return out
+
+
+def resolve_lineage(model_dir: str,
+                    feedback_dir: str = "") -> Tuple[dict, List[str]]:
+    """Answer "which requests trained the published model": the publish
+    pointer's lineage block, plus (with the feedback-log dir) the
+    committed pages covering the id range.  Returns ``(report,
+    problems)`` — problems non-empty when the chain does not resolve."""
+    problems: List[str] = []
+    ptr_path = os.path.join(model_dir, "PUBLISHED.json")
+    try:
+        with open(ptr_path, "r", encoding="utf-8") as f:
+            ptr = json.load(f)
+    except (OSError, ValueError) as e:
+        return {}, [f"lineage: cannot read {ptr_path}: {e}"]
+    report = {
+        "round": ptr.get("round"),
+        "path": ptr.get("path"),
+        "metric": ptr.get("metric"),
+        "published_ts": ptr.get("time"),
+        "lineage": ptr.get("lineage"),
+    }
+    lin = ptr.get("lineage")
+    if not isinstance(lin, dict):
+        problems.append(
+            f"lineage: {ptr_path} carries no lineage block (published "
+            "before the lineage format, or by a bare write)")
+        return report, problems
+    first, last = lin.get("first_seq"), lin.get("last_seq")
+    if feedback_dir and first is not None and last is not None:
+        pages = []
+        covered = 0
+        for idx, ent in _feedback_pages(feedback_dir):
+            s0 = ent.get("seq0")
+            if s0 is None:
+                continue
+            lo, hi = int(s0), int(s0) + int(ent["nrec"]) - 1
+            if hi < first or lo > last:
+                continue
+            overlap = min(hi, last) - max(lo, first) + 1
+            covered += overlap
+            pages.append({"shard": idx, "off": ent["off"],
+                          "seq": [lo, hi], "overlap": overlap})
+        report["resolved"] = {
+            "feedback_dir": feedback_dir,
+            "pages": pages,
+            "records_in_range": covered,
+        }
+        if not pages:
+            problems.append(
+                f"lineage: no committed page in {feedback_dir} covers "
+                f"seq range [{first}, {last}]")
+    return report, problems
+
+
+# ----------------------------------------------------------------------
 # human front-end
 def _load_metrics_text(src: str) -> str:
     if src.startswith(("http://", "https://")):
@@ -275,6 +453,10 @@ def _load_metrics_text(src: str) -> str:
             return r.read().decode("utf-8")
     with open(src, "r", encoding="utf-8") as f:
         return f.read()
+
+
+def _load_json_obj(src: str):
+    return json.loads(_load_metrics_text(src))
 
 
 def _tail(path: str, n: int) -> None:
@@ -325,14 +507,32 @@ def main() -> int:
     ap.add_argument("--telemetry", default="",
                     help="per-round telemetry.jsonl path")
     ap.add_argument("--events", default="", help="event-log JSONL path")
+    ap.add_argument("--alertz", default="",
+                    help="GET /alertz JSON body: file path or URL")
+    ap.add_argument("--require", default="",
+                    help="comma-separated metric families the exposition "
+                         "must carry (device-plane pinning)")
+    ap.add_argument("--lineage", default="",
+                    help="model_dir: resolve PUBLISHED.json's "
+                         "contributing-feedback lineage")
+    ap.add_argument("--feedback", default="",
+                    help="feedback-log dir for --lineage page resolution")
     ap.add_argument("--tail", type=int, default=0,
                     help="print the last N records of --events/--telemetry")
     ap.add_argument("--summary", action="store_true",
                     help="aggregate the given --events/--telemetry")
     args = ap.parse_args()
 
-    if not (args.metrics or args.telemetry or args.events):
-        ap.error("give at least one of --metrics/--telemetry/--events")
+    if args.lineage:
+        report, problems = resolve_lineage(args.lineage, args.feedback)
+        print(json.dumps(report, indent=1))
+        for p in problems:
+            print(f"FAIL {p}", file=sys.stderr)
+        return 1 if problems else 0
+
+    if not (args.metrics or args.telemetry or args.events or args.alertz):
+        ap.error("give at least one of --metrics/--telemetry/--events/"
+                 "--alertz (or --lineage)")
     if (args.tail or args.summary) and not (args.events or args.telemetry):
         ap.error("--tail/--summary need --events or --telemetry")
 
@@ -345,11 +545,29 @@ def main() -> int:
                 problems.append(f"metrics {args.metrics}: {e}")
             else:
                 probs = validate_prometheus_text(text)
+                if args.require:
+                    fams = exposition_families(text)
+                    for need in args.require.split(","):
+                        need = need.strip()
+                        if need and need not in fams:
+                            probs.append(
+                                f"required family {need!r} absent")
                 problems += [f"metrics: {p}" for p in probs]
                 if not probs:
                     n = sum(1 for l in text.splitlines()
                             if l and not l.startswith("#"))
                     print(f"metrics: OK ({n} samples)")
+        if args.alertz:
+            try:
+                obj = _load_json_obj(args.alertz)
+            except (OSError, ValueError) as e:
+                problems.append(f"alertz {args.alertz}: {e}")
+            else:
+                probs = validate_alertz(obj)
+                problems += [f"alertz: {p}" for p in probs]
+                if not probs:
+                    print(f"alertz: OK ({len(obj.get('rules', []))} "
+                          f"rule(s), {len(obj.get('firing', []))} firing)")
         if args.telemetry:
             probs = validate_telemetry(args.telemetry)
             problems += [f"telemetry: {p}" for p in probs]
@@ -376,6 +594,8 @@ def main() -> int:
     # default view: summarize whatever was given
     if args.metrics:
         print(_load_metrics_text(args.metrics), end="")
+    if args.alertz:
+        print(json.dumps(_load_json_obj(args.alertz), indent=1))
     if args.events:
         _summarize_events(args.events)
     if args.telemetry:

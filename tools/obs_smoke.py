@@ -4,15 +4,18 @@ The driver behind the ``OBS=1`` lane of ``tools/run_tier1.sh``
 (doc/observability.md).  One process:
 
 1. generates a tiny synthetic MNIST-style dataset and trains it for a
-   couple of rounds with ``telemetry=1``, ``event_log`` and
-   ``trace_dir`` armed — producing ``telemetry.jsonl``,
-   ``events.jsonl`` and a Chrome host trace;
+   couple of rounds with ``telemetry=1``, ``event_log``, ``trace_dir``,
+   ``device_sample_every`` and a deliberately-tripped ``alert=`` rule
+   armed — producing ``telemetry.jsonl`` (with per-round ``device``
+   totals), ``events.jsonl`` and a Chrome host trace;
 2. serves the checkpoint it just wrote (``serve/`` engine + HTTP
    front-end), drives a few ``/predict`` requests through the
-   micro-batcher, and scrapes ``GET /metricsz`` to
-   ``<out>/metricsz.txt``;
+   micro-batcher, walks the latency alert through fire (degraded
+   ``/healthz``) and clear, and scrapes ``GET /metricsz`` /
+   ``GET /alertz`` to ``<out>/metricsz.txt`` / ``<out>/alertz.json``;
 3. prints the artifact paths — the lane then schema-validates them via
-   ``tools/obs_dump.py --check``.
+   ``tools/obs_dump.py --check`` (including the device-plane metric
+   families pinned with ``--require``).
 
 Usage:  python tools/obs_smoke.py --out /tmp/obs_smoke
 """
@@ -68,6 +71,8 @@ telemetry_path = {out}/telemetry.jsonl
 event_log = {out}/events.jsonl
 trace_dir = {out}/traces
 trace_steps = 3
+device_sample_every = 2
+alert = smoke_latency:serve_request_latency_seconds_mean:>:0:0
 silent = 1
 """
 
@@ -100,6 +105,7 @@ def train(out: str) -> None:
 
 def serve_and_scrape(out: str) -> None:
     from cxxnet_tpu import config as cfgmod
+    from cxxnet_tpu.obs import alerts as obs_alerts
     from cxxnet_tpu.serve import Engine
     from cxxnet_tpu.serve.server import make_server
 
@@ -112,7 +118,23 @@ def serve_and_scrape(out: str) -> None:
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
     port = httpd.server_port
+
+    def get(path: str):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            ctype = r.headers.get("Content-Type", "")
+            body = r.read().decode("utf-8")
+        return ctype, body
+
     try:
+        # drive the evaluator by hand for determinism (the CLI started
+        # its background thread — its passes would race the fire/clear
+        # assertions below)
+        ev = obs_alerts.evaluator()
+        ev.stop()
+        # baseline evaluation BEFORE traffic: the latency rule keys on
+        # the interval mean, so the next pass sees fresh observations
+        ev.evaluate_once()
         rng = np.random.RandomState(1)
         for n in (1, 3, 5):
             body = json.dumps(
@@ -125,24 +147,48 @@ def serve_and_scrape(out: str) -> None:
             with urllib.request.urlopen(req, timeout=30) as r:
                 out_rows = len(json.load(r)["pred"])
                 assert out_rows == n, (out_rows, n)
-        with urllib.request.urlopen(
-                f"http://127.0.0.1:{port}/metricsz", timeout=30) as r:
-            ctype = r.headers.get("Content-Type", "")
-            assert ctype.startswith("text/plain"), ctype
-            text = r.read().decode("utf-8")
+        # fire: requests landed since the baseline pass, mean > 0
+        ev.evaluate_once()
+        if ev.firing() != ["smoke_latency"]:
+            raise SystemExit(
+                f"obs_smoke: latency alert did not fire ({ev.firing()})")
+        _, health = get("/healthz")
+        h = json.loads(health)
+        if h["status"] != "degraded" or "smoke_latency" not in h.get(
+                "alerts", []):
+            raise SystemExit(f"obs_smoke: /healthz not degraded while "
+                             f"firing: {h}")
+        _, alertz = get("/alertz")  # captured while firing
+        ctype, text = get("/metricsz")
+        assert ctype.startswith("text/plain"), ctype
+        # clear: no traffic between passes -> no interval mean sample
+        ev.evaluate_once()
+        if ev.firing():
+            raise SystemExit(
+                f"obs_smoke: alert did not clear ({ev.firing()})")
+        h2 = json.loads(get("/healthz")[1])
+        if h2["status"] != "ok":
+            raise SystemExit(f"obs_smoke: /healthz stuck degraded: {h2}")
     finally:
         httpd.shutdown()
         httpd.server_close()
         engine.close()
-    # the acceptance surface: outcomes, batch fill, latency, reloads
+    # the acceptance surface: outcomes, batch fill, latency, reloads,
+    # the alert gauge and the device-plane families (the in-process
+    # train + the serve bucket compiles above feed them)
     for needle in ("serve_request_outcomes_total", "serve_batch_rows_total",
                    "serve_request_latency_seconds_bucket",
-                   "serve_model_reloads_total", "obs_events_total"):
+                   "serve_model_reloads_total", "obs_events_total",
+                   "obs_alerts_firing", "xla_program_flops",
+                   "xla_compile_seconds_total"):
         if needle not in text:
             raise SystemExit(f"obs_smoke: {needle!r} missing from /metricsz")
     with open(os.path.join(out, "metricsz.txt"), "w",
               encoding="utf-8") as f:
         f.write(text)
+    with open(os.path.join(out, "alertz.json"), "w",
+              encoding="utf-8") as f:
+        f.write(alertz)
 
 
 def main() -> None:
@@ -152,7 +198,8 @@ def main() -> None:
     args = ap.parse_args()
     out = os.path.abspath(args.out)
     os.makedirs(out, exist_ok=True)
-    for leftover in ("telemetry.jsonl", "events.jsonl", "metricsz.txt"):
+    for leftover in ("telemetry.jsonl", "events.jsonl", "metricsz.txt",
+                     "alertz.json"):
         p = os.path.join(out, leftover)
         if os.path.exists(p):
             os.remove(p)
@@ -162,6 +209,7 @@ def main() -> None:
     traces = sorted(os.listdir(os.path.join(out, "traces")))
     print(f"obs_smoke: OK — artifacts in {out}")
     print(f"  metrics:   {out}/metricsz.txt")
+    print(f"  alertz:    {out}/alertz.json")
     print(f"  telemetry: {out}/telemetry.jsonl")
     print(f"  events:    {out}/events.jsonl")
     print(f"  traces:    {traces}")

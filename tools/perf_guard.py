@@ -1,0 +1,340 @@
+"""Local perf-regression sentinel over io_bench / serve_bench results.
+
+Rounds 3-5's TPU bench artifacts were lost to relay outages because
+bench results lived in ad-hoc JSON files nobody appended to.  This tool
+makes bench artifacts first-class and loss-proof:
+
+* **history** — every run is appended to a committed-format JSONL file
+  (one ``{"ts", "bench", "host", "metrics": {...}}`` object per line;
+  the file is meant to be committed next to the code it measures, so a
+  lost relay session costs one entry, not the whole series);
+* **rolling baseline** — each metric is compared against the median of
+  the last ``--window`` (default 5) prior entries of the same bench;
+* **noise band** — a metric only counts as a regression/improvement
+  when it leaves the ``--band`` (default 20%) envelope around the
+  baseline, orientation-aware: ``*_per_sec``-style metrics regress
+  downward, ``p50/p95/p99``/``*_ms``-style metrics regress upward;
+* **verdict** — one schema-stable JSON document on stdout (and
+  ``--json``): ``verdict`` is ``baseline`` (not enough history), ``ok``
+  or ``regression``; regressions also emit an ``alert.perf_regression``
+  structured event (``--event-log`` to persist it) and bump
+  ``perf_regressions_total{bench}``.
+
+Usage::
+
+    python tools/io_bench.py --json /tmp/io.json
+    python tools/perf_guard.py --bench io_bench --input /tmp/io.json \\
+        --history bench_history.jsonl
+    python tools/serve_bench.py > /tmp/serve.json
+    python tools/perf_guard.py --bench serve_bench --input /tmp/serve.json
+    python tools/perf_guard.py --smoke        # the OBS=1 CI lane
+
+Exit code: 0 on ``ok``/``baseline``; 1 on schema problems, or on
+``regression`` when ``--strict`` is given (CI lanes stay green on slow
+hardware days unless they opt in).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import platform
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+VERDICTS = ("baseline", "ok", "regression")
+
+#: substrings marking a metric as lower-is-better (latencies)
+_LOWER_MARKERS = ("latency", "_ms", "p50", "p95", "p99", "wall_sec")
+
+
+def lower_is_better(name: str) -> bool:
+    # match against the FULL dotted name: a latency metric whose leaf
+    # carries no marker (latency_ms.mean, latency_ms.max) must still
+    # regress upward, not get its direction inverted
+    return any(m in name for m in _LOWER_MARKERS)
+
+
+# ----------------------------------------------------------------------
+# flatteners: bench JSON documents -> {metric_name: float}
+def _walk_numbers(prefix: str, obj, out: Dict[str, float]) -> None:
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _walk_numbers(f"{prefix}.{k}" if prefix else str(k), v, out)
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        if math.isfinite(obj):
+            out[prefix] = float(obj)
+
+
+def flatten_io_bench(doc: dict) -> Dict[str, float]:
+    """Per-mode throughput rates from an ``io_bench --json`` report."""
+    out: Dict[str, float] = {}
+    for row in doc.get("results", []):
+        mode = row.get("mode", "?")
+        for key in ("img_per_sec", "decode_augment_per_sec"):
+            v = row.get(key)
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                out[f"{mode}.{key}"] = float(v)
+    return out
+
+
+def flatten_serve_bench(doc: dict) -> Dict[str, float]:
+    """Throughput + latency percentiles from a serve_bench report."""
+    out: Dict[str, float] = {}
+    closed = doc.get("closed_loop", {})
+    for leg in ("sequential", "concurrent"):
+        d = closed.get(leg, {})
+        for key in ("req_per_sec", "rows_per_sec"):
+            v = d.get(key)
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                out[f"closed.{leg}.{key}"] = float(v)
+        _walk_numbers(f"closed.{leg}.latency_ms",
+                      d.get("latency_ms", {}), out)
+    v = closed.get("speedup")
+    if isinstance(v, (int, float)) and math.isfinite(v):
+        out["closed.speedup"] = float(v)
+    return out
+
+
+FLATTENERS = {"io_bench": flatten_io_bench,
+              "serve_bench": flatten_serve_bench}
+
+
+# ----------------------------------------------------------------------
+# history
+def load_history(path: str, bench: str) -> List[dict]:
+    """Prior entries of ``bench``, oldest first; torn/foreign lines are
+    skipped (the file survives crashes and hand edits)."""
+    out: List[dict] = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ent = json.loads(line)
+                except ValueError:
+                    continue
+                if (isinstance(ent, dict) and ent.get("bench") == bench
+                        and isinstance(ent.get("metrics"), dict)):
+                    out.append(ent)
+    except OSError:
+        pass
+    return out
+
+
+def append_history(path: str, entry: dict) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(entry, separators=(",", ":")) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+# ----------------------------------------------------------------------
+# comparison
+def compare(bench: str, metrics: Dict[str, float], history: List[dict],
+            window: int = 5, band: float = 0.2) -> dict:
+    """Build the verdict document for one run vs the rolling baseline.
+
+    ``history`` holds PRIOR entries only (the current run is appended
+    separately, after comparison — a run must never be its own
+    baseline)."""
+    baseline: Dict[str, float] = {}
+    tail = history[-window:]
+    for name in metrics:
+        prior = [e["metrics"][name] for e in tail
+                 if isinstance(e["metrics"].get(name), (int, float))]
+        if prior:
+            baseline[name] = _median(prior)
+    regressions, improvements = [], []
+    for name, value in sorted(metrics.items()):
+        base = baseline.get(name)
+        if base is None or base == 0:
+            continue
+        ratio = value / base
+        worse = ratio > 1 + band if lower_is_better(name) \
+            else ratio < 1 - band
+        better = ratio < 1 - band if lower_is_better(name) \
+            else ratio > 1 + band
+        row = {"metric": name, "value": value, "baseline": base,
+               "ratio": round(ratio, 4)}
+        if worse:
+            regressions.append(row)
+        elif better:
+            improvements.append(row)
+    verdict = ("baseline" if not baseline
+               else "regression" if regressions else "ok")
+    return {
+        "bench": bench,
+        "ts": time.time(),
+        "host": platform.node(),
+        "metrics": metrics,
+        "window": window,
+        "noise_band": band,
+        "history_len": len(history),
+        "baseline": baseline or None,
+        "regressions": regressions,
+        "improvements": improvements,
+        "verdict": verdict,
+    }
+
+
+def validate_verdict(doc: dict) -> List[str]:
+    """Schema problems of a verdict document (empty == valid) — what
+    the CI lane asserts; throughput itself is hardware weather."""
+    problems: List[str] = []
+    for key in ("bench", "ts", "metrics", "window", "noise_band",
+                "history_len", "regressions", "improvements", "verdict"):
+        if key not in doc:
+            problems.append(f"verdict: missing key {key!r}")
+    if doc.get("verdict") not in VERDICTS:
+        problems.append(f"verdict: bad verdict {doc.get('verdict')!r}")
+    if not isinstance(doc.get("metrics"), dict) or not doc.get("metrics"):
+        problems.append("verdict: metrics missing/empty")
+    else:
+        for k, v in doc["metrics"].items():
+            if not (isinstance(v, (int, float)) and math.isfinite(v)):
+                problems.append(f"verdict: metric {k}={v!r} not finite")
+    for key in ("regressions", "improvements"):
+        for row in doc.get(key) or []:
+            for f in ("metric", "value", "baseline", "ratio"):
+                if f not in row:
+                    problems.append(f"verdict: {key} row missing {f!r}")
+    return problems
+
+
+# ----------------------------------------------------------------------
+def _emit_alert(doc: dict, event_log: str = "") -> None:
+    """Regression → structured event + registry counter (in this
+    process; a scraping service sees it when the guard runs embedded)."""
+    from cxxnet_tpu.obs import events as obs_events
+    from cxxnet_tpu.obs.registry import registry
+
+    if event_log:
+        obs_events.configure([("event_log", event_log)])
+    registry().counter(
+        "perf_regressions_total",
+        "perf_guard verdicts that found a regression.",
+        labelnames=("bench",),
+    ).labels(bench=doc["bench"]).inc()
+    worst = max(doc["regressions"], key=lambda r: abs(r["ratio"] - 1.0))
+    obs_events.emit(
+        "alert.perf_regression", bench=doc["bench"],
+        regressions=[r["metric"] for r in doc["regressions"]],
+        worst_metric=worst["metric"], worst_ratio=worst["ratio"],
+        history_len=doc["history_len"])
+
+
+def run_once(bench: str, input_doc: dict, history_path: str,
+             window: int, band: float, event_log: str = "") -> dict:
+    metrics = FLATTENERS[bench](input_doc)
+    if not metrics:
+        raise ValueError(
+            f"perf_guard: no {bench} metrics found in the input document")
+    history = load_history(history_path, bench)
+    doc = compare(bench, metrics, history, window=window, band=band)
+    append_history(history_path, {
+        "ts": doc["ts"], "bench": bench, "host": doc["host"],
+        "metrics": metrics,
+    })
+    if doc["verdict"] == "regression":
+        try:
+            _emit_alert(doc, event_log)
+        except Exception as e:  # noqa: BLE001 - the verdict still stands
+            print(f"# perf_guard: alert emission failed: {e}",
+                  file=sys.stderr)
+    return doc
+
+
+# ----------------------------------------------------------------------
+def _smoke(history_path: str, window: int, band: float) -> dict:
+    """Two tiny real io_bench measurements through the full pipeline:
+    the first seeds the history (verdict ``baseline``), the second
+    compares against it — proving append, rolling baseline, banding and
+    the verdict schema on real numbers in seconds."""
+    import tempfile
+
+    import io_bench
+
+    docs = []
+    with tempfile.TemporaryDirectory() as workdir:
+        io_bench.generate_imgbin(workdir, 48, 48)
+        for _ in range(2):
+            rate, stages = io_bench.run_epoch(workdir, 48, 0)
+            bench_doc = {"results": [{
+                "mode": "serial", "img_per_sec": rate,
+                "decode_augment_per_sec": rate, "stages": stages,
+            }]}
+            docs.append(run_once("io_bench", bench_doc, history_path,
+                                 window, band))
+    final = docs[-1]
+    final["smoke"] = {"runs": len(docs),
+                      "first_verdict": docs[0]["verdict"]}
+    return final
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--bench", choices=sorted(FLATTENERS),
+                    default="io_bench")
+    ap.add_argument("--input", default="",
+                    help="bench JSON report ('-' for stdin)")
+    ap.add_argument("--history", default="bench_history.jsonl",
+                    help="append-only history JSONL (committed format)")
+    ap.add_argument("--window", type=int, default=5,
+                    help="rolling-baseline width (prior runs)")
+    ap.add_argument("--band", type=float, default=0.2,
+                    help="noise band around the baseline (fraction)")
+    ap.add_argument("--json", dest="json_path", default="",
+                    help="also write the verdict document here")
+    ap.add_argument("--event-log", default="",
+                    help="persist regression alert events to this JSONL")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on a regression verdict")
+    ap.add_argument("--smoke", action="store_true",
+                    help="two tiny real runs end to end (CI lane)")
+    args = ap.parse_args()
+
+    if args.smoke:
+        doc = _smoke(args.history, args.window, args.band)
+    else:
+        if not args.input:
+            ap.error("--input is required (or use --smoke)")
+        if args.input == "-":
+            input_doc = json.load(sys.stdin)
+        else:
+            with open(args.input, "r", encoding="utf-8") as f:
+                input_doc = json.load(f)
+        doc = run_once(args.bench, input_doc, args.history,
+                       args.window, args.band, event_log=args.event_log)
+
+    problems = validate_verdict(doc)
+    print(json.dumps(doc, indent=1))
+    if args.json_path:
+        with open(args.json_path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+    for p in problems:
+        print(f"FAIL {p}", file=sys.stderr)
+    if problems:
+        return 1
+    if args.strict and doc["verdict"] == "regression":
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,10 +19,14 @@
 #                                     # an improving one (JSON verdict)
 #        OBS=1 tools/run_tier1.sh     # also run the observability smoke:
 #                                     # short telemetry=1 train + serve
-#                                     # scrape of /metricsz, then schema-
-#                                     # validate the exposition text,
+#                                     # scrape of /metricsz + /alertz
+#                                     # (alert fire/degrade/clear walked
+#                                     # end to end), then schema-validate
+#                                     # the exposition text (device-plane
+#                                     # families pinned), alertz.json,
 #                                     # telemetry.jsonl and events.jsonl
-#                                     # via tools/obs_dump.py --check
+#                                     # via tools/obs_dump.py --check,
+#                                     # plus a perf_guard --smoke verdict
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
@@ -53,7 +57,13 @@ if [ "${OBS:-0}" = "1" ]; then
     python tools/obs_smoke.py --out "$obs_out" || rc=1
   timeout -k 10 60 python tools/obs_dump.py --check \
     --metrics "$obs_out/metricsz.txt" \
+    --alertz "$obs_out/alertz.json" \
+    --require xla_program_flops,xla_program_bytes,xla_compile_seconds_total,obs_alerts_firing \
     --telemetry "$obs_out/telemetry.jsonl" \
     --events "$obs_out/events.jsonl" || rc=1
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python tools/perf_guard.py --smoke \
+    --history "$obs_out/bench_history.jsonl" \
+    --json "$obs_out/perf_verdict.json" || rc=1
 fi
 exit $rc
