@@ -75,6 +75,10 @@ class LearnTask:
         self.watchdog_timeout_s = 600.0  # serve batcher stall guard
         self.telemetry = 0  # per-round JSONL records (doc/observability.md)
         self.telemetry_path = "telemetry.jsonl"
+        # self-tuning knob controller (cxxnet_tpu/tune/,
+        # doc/performance.md): tune_* keys are parsed by
+        # tune.options_from_cfg from the raw cfg stream
+        self.controller = 0
         # closed-loop continuous training (task=serve_train,
         # doc/continuous_training.md)
         self.loop_dir = "loop"
@@ -171,6 +175,8 @@ class LearnTask:
             self.reload_breaker_cooldown_s = float(val)
         elif name == "watchdog_timeout_s":
             self.watchdog_timeout_s = float(val)
+        elif name == "controller":
+            self.controller = int(val)
         elif name == "telemetry":
             self.telemetry = int(val)
         elif name == "telemetry_path":
@@ -525,6 +531,74 @@ class LearnTask:
                 it.init()
 
     # ------------------------------------------------------------------
+    # self-tuning controller (cxxnet_tpu/tune/): ``controller = 1``
+    # arms a background KnobController for the task's live knobs
+    def _start_controller(self, knobs, objective, on_tick=None,
+                          name="tune"):
+        from .tune import KnobController, options_from_cfg
+
+        opts = options_from_cfg(self.cfg)
+        ctrl = KnobController(
+            objective, knobs,
+            period_s=opts.period_s, band=opts.band,
+            measure_ticks=opts.measure_ticks,
+            settle_ticks=opts.settle_ticks,
+            cooldown_ticks=opts.cooldown_ticks,
+            name=name, on_tick=on_tick,
+        )
+        ctrl.start()
+        if not self.silent:
+            print(f"controller: tuning {[k.name for k in knobs]} "
+                  f"every {opts.period_s:g}s (band {opts.band:g})",
+                  flush=True)
+        return ctrl
+
+    def _start_train_controller(self):
+        """``controller = 1`` for train tasks: tune the decode pool
+        (workers + in-flight window) against the rate of rows the train
+        loop actually dispatches.  None when the conf did not opt in or
+        the chain has no parallel decode stage."""
+        if not self.controller or self.itr_train is None:
+            return None
+        from .tune import find_pipeline, options_from_cfg, pipeline_knobs
+
+        opts = options_from_cfg(self.cfg)
+        knobs = []
+        if opts.wants("pipeline"):
+            pipe = find_pipeline(self.itr_train)
+            if pipe is not None:
+                knobs.extend(pipeline_knobs(pipe))
+        if not knobs:
+            if not self.silent:
+                print("controller=1: no tunable pipeline stage in this "
+                      "iterator chain; controller idle", flush=True)
+            return None
+        bs = float(self.net_trainer.batch_size or 1)
+        return self._start_controller(
+            knobs,
+            objective=lambda: float(getattr(self, "_global_step", 0)) * bs,
+            name="train",
+        )
+
+    def _start_serve_controller(self, engine):
+        """``controller = 1`` for serve tasks: tune the micro-batcher
+        (coalescing limit + batch window) against executed batch rows,
+        with the speculative bucket prewarm riding every tick."""
+        if not self.controller:
+            return None
+        from .tune import batcher_knobs, options_from_cfg
+
+        opts = options_from_cfg(self.cfg)
+        knobs = batcher_knobs(engine) if opts.wants("batcher") else []
+        if not knobs:
+            return None
+        return self._start_controller(
+            knobs,
+            objective=lambda: float(engine.stats.batch_rows),
+            on_tick=engine.prewarm_buckets,
+            name="serve",
+        )
+
     def task_train(self) -> None:
         from .parallel.distributed import any_process_flag, process_info
         from .utils.checkpoint import DivergenceError, PreemptionHandler
@@ -562,6 +636,7 @@ class LearnTask:
         # preempted worker stops the whole job consistently.
         self._preempt = PreemptionHandler().install()
         preempted = False
+        tuner = self._start_train_controller()
         try:
             cc = self.max_round
             while self.start_counter <= self.num_round and cc > 0:
@@ -591,6 +666,8 @@ class LearnTask:
                     preempted = True
                     break
         finally:
+            if tuner is not None:
+                tuner.stop()
             self._preempt.uninstall()
         tracer.close()
         obs_trace.tracer().flush_window(self._global_step)
@@ -691,7 +768,8 @@ class LearnTask:
         from .obs import trace as obs_trace
         from .utils.profiler import pipeline_stats
 
-        check_preempt = process_info()[1] == 1
+        nproc = process_info()[1]
+        check_preempt = nproc == 1
         preempted = False
         sample_counter = 0
         self.net_trainer.start_round(self.start_counter)
@@ -813,9 +891,35 @@ class LearnTask:
             and not (self.net_trainer.eval_train
                      and self.net_trainer.train_metric.need_nodes())
         )
-        while self.itr_train.next():
+        # double-buffered device feed (doc/performance.md): in the
+        # per-batch path with no metric fetch in the way, batch N+1 is
+        # decoded AND transferred (stage_batch: async sharding-aware
+        # device_put) while step N still executes, then step N is
+        # fenced — h2d no longer serializes with dispatch.  The staged
+        # copy is owned (iterator buffers are reused by next()).  The
+        # timed span becomes fence-to-fence, i.e. the honest pipeline
+        # rate, exactly like the scan path.
+        db_ok = (
+            self.test_io == 0
+            and not scan_ok
+            and nproc == 1
+            and not self.net_trainer.eval_train
+        )
+        staged_next = None  # owned copy of batch N+1, H2D in flight
+        exhausted = False   # next() returned False — NEVER call it
+        # again this epoch (a ThreadBufferIterator delivers exactly one
+        # end marker per generation; a second next() would block)
+        while True:
+            if staged_next is not None:
+                batch, staged_next = staged_next, None
+            elif exhausted:
+                break
+            elif self.itr_train.next():
+                batch = (self.itr_train.value() if self.test_io == 0
+                         else None)
+            else:
+                break
             if self.test_io == 0:
-                batch = self.itr_train.value()
                 if scan_ok and not batch.num_batch_padd:
                     import numpy as _np
 
@@ -834,7 +938,29 @@ class LearnTask:
                     timer.start()
                     self.net_trainer.update(batch)
                     if not self.net_trainer.eval_train:
+                        if db_ok and not exhausted:
+                            if self.itr_train.next():
+                                import numpy as _np
+
+                                from .io.data import DataBatch as _DB
+
+                                v = self.itr_train.value()
+                                staged_next = _DB(
+                                    data=_np.array(v.data),
+                                    label=_np.array(v.label),
+                                    num_batch_padd=v.num_batch_padd,
+                                    extra_data=[_np.array(e)
+                                                for e in v.extra_data],
+                                )
+                                self.net_trainer.stage_batch(staged_next)
+                            else:
+                                exhausted = True
+                        t0 = time.perf_counter()
                         self.net_trainer.sync()
+                        pipeline_stats().add(
+                            "device_wait", time.perf_counter() - t0,
+                            rows=self.net_trainer.batch_size,
+                        )
                     timer.stop()
                     self._global_step += 1
                     pipe_mark = time.perf_counter()  # span was timed
@@ -1039,6 +1165,7 @@ class LearnTask:
 
         prev = {s: _signal.signal(s, _stop)
                 for s in (_signal.SIGTERM, _signal.SIGINT)}
+        tuner = self._start_serve_controller(engine)
         try:
             serve_forever(
                 engine,
@@ -1052,6 +1179,8 @@ class LearnTask:
         finally:
             for s, p in prev.items():
                 _signal.signal(s, p)
+            if tuner is not None:
+                tuner.stop()
             engine.close()
         print("serve: shutdown complete", flush=True)
 
@@ -1142,6 +1271,7 @@ class LearnTask:
 
         prev = {s: _signal.signal(s, _stop)
                 for s in (_signal.SIGTERM, _signal.SIGINT)}
+        tuner = self._start_serve_controller(engine)
         try:
             serve_forever(
                 engine,
@@ -1157,6 +1287,8 @@ class LearnTask:
         finally:
             for s, p in prev.items():
                 _signal.signal(s, p)
+            if tuner is not None:
+                tuner.stop()
             loop.stop()
             if loop_thread.is_alive():
                 loop_thread.join(timeout=max(self.drain_timeout_s, 5.0))

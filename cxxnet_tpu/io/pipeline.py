@@ -123,6 +123,11 @@ class ParallelAugmentIterator(InstIterator):
         self._watchdog: Optional[Watchdog] = None
         self._out: Optional[DataInst] = None
         self._closed = False
+        self._init_done = False
+        self._pool_started = False
+        self._pool_lock = threading.Lock()  # guards _threads membership
+        self._worker_seq = 0                # monotonic worker name ids
+        self._poison_pending = 0            # shrink tokens in flight
 
     # ------------------------------------------------------------------
     def supports_dist_shard(self) -> bool:
@@ -143,12 +148,87 @@ class ParallelAugmentIterator(InstIterator):
 
     @property
     def parallel(self) -> bool:
-        return self.num_workers > 1
+        return self._pool_started or self.num_workers > 1
 
     def init(self):
         self.aug.init()
-        if not self.parallel:
-            return
+        self._init_done = True
+        if self.num_workers > 1:
+            self._start_pool()
+
+    # ------------------------------------------------------------------
+    # runtime resize (the self-tuning controller's live knobs;
+    # doc/performance.md "Self-tuning runtime")
+    def request_workers(self, n: int) -> int:
+        """Set the decode-pool worker target at runtime (thread-safe).
+
+        An active pool resizes immediately — new threads are spawned,
+        surplus ones drain out via poison tokens; record order and the
+        augmentation stream are unaffected (ordering is sequence-number
+        based, RNG draws are per-record).  A chain still on the serial
+        path grows its pool at the next :meth:`before_first` (the safe
+        point — mid-epoch the consumer owns the source cursor).  Once a
+        pool exists it never tears back down to the serial path; a
+        target of 1 runs the pool with one worker, which is bitwise
+        identical and within noise of the serial path."""
+        n = max(1, int(n))
+        self.num_workers = n
+        if self._closed:
+            return n
+        if self._pool_started:
+            self._reconcile_pool()
+        from ..tune.controller import set_effective
+
+        set_effective("num_decode_workers", n)
+        return n
+
+    def set_queue_depth(self, n: int) -> int:
+        """Resize the in-flight chunk window at runtime (immediate:
+        the consumer re-reads it on every refill; shrinking below the
+        current in-flight count just pauses submission until consumed)."""
+        n = max(1, int(n))
+        self.queue_depth = n
+        from ..tune.controller import set_effective
+
+        set_effective("decode_queue_depth", n)
+        return n
+
+    def effective_workers(self) -> int:
+        """Worker threads currently alive (the resize ground truth)."""
+        with self._pool_lock:
+            return sum(1 for t in self._threads if t.is_alive())
+
+    def _spawn_worker(self) -> None:
+        t = threading.Thread(
+            target=self._worker, daemon=True,
+            name=f"decode-worker-{self._worker_seq}",
+        )
+        self._worker_seq += 1
+        t.start()
+        self._threads.append(t)
+
+    def _reconcile_pool(self) -> None:
+        """Converge live worker threads toward ``num_workers``: spawn
+        the shortfall, poison the surplus (each None token retires one
+        worker).  Tokens still in flight count against the surplus —
+        without that, back-to-back shrinks would over-poison the pool
+        down to zero workers and wedge the consumer — and tokens
+        drained by a generation flip are re-credited there, so the
+        count converges, never wedges."""
+        with self._pool_lock:
+            self._threads = [t for t in self._threads if t.is_alive()]
+            alive = len(self._threads)
+            target = max(1, self.num_workers)
+            effective = alive - self._poison_pending
+            if effective < target:
+                for _ in range(target - effective):
+                    self._spawn_worker()
+            else:
+                for _ in range(effective - target):
+                    self._in_q.put(None)
+                    self._poison_pending += 1
+
+    def _start_pool(self):
         src = self.aug.base
         if (getattr(src, "next_raw", None) is not None
                 and getattr(src, "raw_available", lambda: False)()):
@@ -176,13 +256,14 @@ class ParallelAugmentIterator(InstIterator):
         self._watchdog = Watchdog(
             what="decode pool", timeout_s=self.watchdog_timeout_s,
         )
-        for i in range(self.num_workers):
-            t = threading.Thread(
-                target=self._worker, daemon=True,
-                name=f"decode-worker-{i}",
-            )
-            t.start()
-            self._threads.append(t)
+        with self._pool_lock:
+            for _ in range(self.num_workers):
+                self._spawn_worker()
+        self._pool_started = True
+        from ..tune.controller import set_effective
+
+        set_effective("num_decode_workers", self.num_workers)
+        set_effective("decode_queue_depth", self.queue_depth)
         if not self.silent:
             mode = ("decode+crop (split float tail)" if self._pil_mode
                     else "decode+augment" if self._raw_source
@@ -197,6 +278,18 @@ class ParallelAugmentIterator(InstIterator):
         while True:
             task = self._in_q.get()
             if task is None:
+                # a shrink token (or close()): retire.  The token count
+                # and this thread's pool membership flip together under
+                # the lock, so a concurrent reconcile always sees a
+                # consistent (alive - pending) and can never over-
+                # poison through the thread-teardown window.  close()'s
+                # tokens were never counted pending — clamp at zero.
+                with self._pool_lock:
+                    self._poison_pending = max(0, self._poison_pending - 1)
+                    try:
+                        self._threads.remove(threading.current_thread())
+                    except ValueError:
+                        pass
                 return
             gen, seq, epoch, mode, items = task
             try:
@@ -334,19 +427,35 @@ class ParallelAugmentIterator(InstIterator):
             return self._results.pop(seq)
 
     def before_first(self):
-        if not self.parallel:
+        if (not self._pool_started and self.num_workers > 1
+                and self._init_done and not self._closed):
+            # a runtime request_workers() on a serial chain lands here:
+            # the epoch boundary is the safe point to grow the pool (the
+            # consumer owns the source cursor mid-epoch)
+            self._start_pool()
+        if not self._pool_started:
             self.aug.before_first()
             return
         with self._cond:
             self._gen += 1
             self._results.clear()
         # drain queued-but-unstarted tasks of the old generation so the
-        # workers don't burn time decoding records nobody will consume
+        # workers don't burn time decoding records nobody will consume;
+        # swallowed shrink tokens are re-credited so reconcile re-issues
+        # exactly the surplus
+        drained_tokens = 0
         try:
             while True:
-                self._in_q.get_nowait()
+                if self._in_q.get_nowait() is None:
+                    drained_tokens += 1
         except queue.Empty:
             pass
+        if drained_tokens:
+            with self._pool_lock:
+                self._poison_pending = max(
+                    0, self._poison_pending - drained_tokens)
+        # apply any pending resize AFTER the drain, prune dead
+        self._reconcile_pool()
         self._seq_submit = 0
         self._seq_take = 0
         self._exhausted = False
@@ -360,7 +469,7 @@ class ParallelAugmentIterator(InstIterator):
             self._watchdog.beat()
 
     def next(self) -> bool:
-        if not self.parallel:
+        if not self._pool_started:
             if not self.aug.next():
                 return False
             self._out = self.aug.value()

@@ -80,6 +80,7 @@ class NetTrainer:
         self._grad_accum = None
         self._rng_key = None
         self._jit_cache: Dict[tuple, object] = {}
+        self._staged = None  # double-buffered device feed (stage_batch)
 
     # ------------------------------------------------------------------
     def set_param(self, name: str, val: str) -> None:
@@ -162,6 +163,7 @@ class NetTrainer:
         graph.configure(self.cfg)
         self.graph = graph
         self._jit_cache.clear()  # drop closures over any previous net/mesh
+        self._staged = None      # staged transfers belong to the old net
         self.net = FunctionalNet(graph)
         if self.net.batch_size:
             self.batch_size = self.net.batch_size
@@ -885,10 +887,25 @@ class NetTrainer:
         self._rng_key, sub = jax.random.split(self._rng_key)
         return sub
 
-    def _to_device(self, x: np.ndarray, count_rows: bool = False) -> jax.Array:
+    def _h2d_sharding(self):
+        """The explicit H2D placement for batch-major host arrays: the
+        mesh's data sharding (``jax.device_put`` target), or None when
+        no mesh exists yet (fall back to ``jnp.asarray``)."""
+        plan = self.mesh_plan
+        return plan.data_sharding() if plan is not None else None
+
+    def _to_device(self, x: np.ndarray, count_rows: bool = False,
+                   own: bool = False) -> jax.Array:
         """Batch-major host array → (possibly multi-process) global array.
 
-        Single process: plain transfer, jit's in_shardings places it.
+        Single process: explicit sharding-aware ``jax.device_put`` onto
+        the mesh's data axis (replacing the former plain
+        ``jnp.asarray`` — the exact site of the bisected jaxlib
+        ``batched_device_put`` flake), so the array arrives already
+        placed where jit's in_shardings want it.  ``device_put`` may
+        ALIAS host memory (CPU zero-copy), so the source is copied
+        first unless ``own=True`` promises the caller's buffer is never
+        reused/mutated (iterator buffers ARE reused by ``next()``).
         Multi-process (jax.distributed job): this process holds only its
         shard of the global batch; assemble the global array over the
         data axis (the DCN-spanning-mesh analog of the reference's
@@ -906,7 +923,12 @@ class NetTrainer:
 
         t0 = _time.perf_counter()
         if jax.process_count() == 1:
-            out = jnp.asarray(x)
+            sh = self._h2d_sharding()
+            if sh is None:
+                out = jnp.asarray(x)
+            else:
+                src = x if own else np.array(x, copy=True)
+                out = jax.device_put(src, sh)
         else:
             out = jax.make_array_from_process_local_data(
                 self.mesh_plan.data_sharding(), np.asarray(x)
@@ -914,6 +936,62 @@ class NetTrainer:
         rows = (x.shape[0] if count_rows and getattr(x, "ndim", 0) else 0)
         pipeline_stats().add("h2d", _time.perf_counter() - t0, rows=rows)
         return out
+
+    def _transfer_batch(self, data_np, label_np, mask_np, extras_np,
+                        own: bool = False):
+        """One sharding-aware H2D for a whole train batch.
+
+        Single-process with a mesh: ONE batched ``jax.device_put`` of
+        the (data, labels, mask, extras) pytree onto the data sharding
+        — one dispatch instead of four, and the natural unit the
+        double-buffered feed stages ahead of time.  Other
+        configurations fall back to per-array :meth:`_to_device`.
+        Returns ``(data, labels, mask, extras)`` device arrays; billed
+        to the ``h2d`` stage with the batch's row count."""
+        from ..utils.profiler import pipeline_stats
+        import time as _time
+
+        sh = self._h2d_sharding()
+        if jax.process_count() != 1 or sh is None:
+            data = self._to_device(data_np, count_rows=True, own=own)
+            labels = self._to_device(label_np, own=own)
+            mask = self._to_device(mask_np, own=own)
+            extras = tuple(self._to_device(e, own=own) for e in extras_np)
+            return data, labels, mask, extras
+        t0 = _time.perf_counter()
+        leaves = (data_np, label_np, mask_np) + tuple(extras_np)
+        if not own:
+            # device_put may alias host memory (CPU zero-copy); copy
+            # anything we do not own — same cost jnp.asarray paid
+            leaves = tuple(np.array(a, copy=True) for a in leaves)
+        placed = jax.device_put(leaves, sh)
+        data, labels, mask = placed[0], placed[1], placed[2]
+        extras = tuple(placed[3:])
+        pipeline_stats().add("h2d", _time.perf_counter() - t0,
+                             rows=data_np.shape[0])
+        return data, labels, mask, extras
+
+    def stage_batch(self, batch: DataBatch) -> bool:
+        """Double-buffered device feed: begin the (async) H2D of the
+        NEXT batch while the current step still executes, so transfer
+        overlaps compute instead of serializing with the next dispatch.
+
+        The caller MUST own ``batch``'s arrays (no iterator buffer
+        reuse) — the transfer aliases them zero-copy where the backend
+        allows.  The staged transfer is consumed by the next
+        :meth:`update` call carrying the SAME batch object; any other
+        batch simply transfers normally and the staged arrays are
+        dropped.  Returns True when staged (single-process with a mesh
+        only — the multi-process assembly path fences internally)."""
+        if jax.process_count() != 1 or self._h2d_sharding() is None:
+            return False
+        data_np, label_np, extras_np, mask_np, n_real = (
+            self._pad_train_batch(batch)
+        )
+        arrays = self._transfer_batch(data_np, label_np, mask_np,
+                                      extras_np, own=True)
+        self._staged = (batch, arrays, n_real)
+        return True
 
     def _pad_train_batch(self, batch: DataBatch):
         """Zero-pad a short final train batch to the compiled batch size.
@@ -1008,13 +1086,18 @@ class NetTrainer:
     def update(self, batch: DataBatch) -> None:
         """One micro-batch: fwd/bwd + (every update_period-th call) update."""
         assert self.net is not None, "init_model/load_model first"
-        data_np, label_np, extras_np, mask_np, n_real = (
-            self._pad_train_batch(batch)
-        )
-        data = self._to_device(data_np, count_rows=True)
-        labels = self._to_device(label_np)
-        mask = self._to_device(mask_np)
-        extras = tuple(self._to_device(e) for e in extras_np)
+        staged, self._staged = self._staged, None
+        if staged is not None and staged[0] is batch:
+            # double-buffered feed: this batch's H2D was issued by
+            # stage_batch while the PREVIOUS step executed
+            (data, labels, mask, extras), n_real = staged[1], staged[2]
+        else:
+            data_np, label_np, extras_np, mask_np, n_real = (
+                self._pad_train_batch(batch)
+            )
+            data, labels, mask, extras = self._transfer_batch(
+                data_np, label_np, mask_np, extras_np
+            )
         step = jnp.asarray(self.epoch_counter, jnp.int32)
         node_cache = {}
         if self.eval_train and self.train_metric.need_nodes():

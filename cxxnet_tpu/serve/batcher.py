@@ -135,6 +135,22 @@ class MicroBatcher:
         self._worker.start()
 
     # ------------------------------------------------------------------
+    # live knobs (the self-tuning controller's setters; both are read
+    # fresh by the worker at the top of every coalesced batch, so a
+    # resize applies on the next batch without pausing traffic)
+    def set_max_batch_size(self, n: int) -> int:
+        """Resize the coalescing limit at runtime.  The in-progress
+        batch finishes under the old limit; a request larger than the
+        new limit still executes alone (the oldest request is always
+        taken unconditionally), so nothing already admitted can wedge."""
+        self.max_batch_size = max(1, int(n))
+        return self.max_batch_size
+
+    def set_batch_timeout_ms(self, ms: float) -> float:
+        """Retune the batch-open window at runtime (next batch on)."""
+        self.batch_timeout = max(0.0, float(ms)) / 1e3
+        return self.batch_timeout * 1e3
+
     def pending_count(self) -> int:
         with self._lock:
             return len(self._queue)
@@ -196,12 +212,15 @@ class MicroBatcher:
         batch = [first]
         key = first.group_key()
         rows = first.data.shape[0]
+        # snapshot the live knobs once per batch: a concurrent resize
+        # (self-tuning controller) applies atomically at the next batch
+        limit = self.max_batch_size
         window_end = time.monotonic() + self.batch_timeout
-        while rows < self.max_batch_size:
+        while rows < limit:
             with self._nonempty:
                 # sweep the queue for compatible, unexpired requests
                 i = 0
-                while i < len(self._queue) and rows < self.max_batch_size:
+                while i < len(self._queue) and rows < limit:
                     r = self._queue[i]
                     if (r.deadline_t is not None
                             and time.monotonic() > r.deadline_t):
@@ -211,14 +230,13 @@ class MicroBatcher:
                         ))
                         continue
                     if (r.group_key() == key
-                            and rows + r.data.shape[0]
-                            <= self.max_batch_size):
+                            and rows + r.data.shape[0] <= limit):
                         self._queue.pop(i)
                         batch.append(r)
                         rows += r.data.shape[0]
                         continue
                     i += 1
-                if rows >= self.max_batch_size or self._closed:
+                if rows >= limit or self._closed:
                     break
                 remain = window_end - time.monotonic()
                 if remain <= 0:

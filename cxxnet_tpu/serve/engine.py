@@ -169,6 +169,14 @@ class Engine:
         self.stats = ServingStats()
         self._cache = ShapeBucketCache(self._trainer, max_batch_size)
         self._row_shapes = self._allowed_row_shapes(self._trainer)
+        # request-shape histogram: (pow2 bucket, row shape) -> request
+        # count, fed by submit(); the speculative prewarm reads it to
+        # compile buckets BEFORE the first coalesced batch of that size
+        # stalls on XLA.  The row shape is part of the key because the
+        # compiled programs are specialized per row shape too (native
+        # 4-D vs the flat wrapper spelling are different programs).
+        self._req_buckets: Dict[tuple, int] = {}
+        self._req_lock = threading.Lock()
         self.batcher = MicroBatcher(
             self._run_batch,
             max_batch_size=max_batch_size,
@@ -178,6 +186,10 @@ class Engine:
             watchdog_timeout_s=watchdog_timeout_s,
         )
         self._closed = False
+        from ..tune.controller import set_effective
+
+        set_effective("max_batch_size", self.batcher.max_batch_size)
+        set_effective("batch_timeout_ms", self.batcher.batch_timeout * 1e3)
         obs_events.emit("serve.start", round=self._round,
                         model=self._model_path,
                         max_batch_size=self.max_batch_size)
@@ -307,7 +319,12 @@ class Engine:
         if kind == "extract" and not node:
             raise ValueError("extract requests need a node name")
         arr = self._validate(data)
-        self.stats.record_request(arr.shape[0])
+        with self._model_lock:
+            bucket = self._cache.bucket_for(arr.shape[0])
+        hkey = (bucket, tuple(arr.shape[1:]))
+        with self._req_lock:
+            self._req_buckets[hkey] = self._req_buckets.get(hkey, 0) + 1
+        self.stats.record_request(arr.shape[0], bucket=bucket)
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         t0 = time.monotonic()
@@ -426,6 +443,97 @@ class Engine:
                           f"shape {row_shape}", flush=True)
 
     # ------------------------------------------------------------------
+    # live knobs + speculative prewarm (the self-tuning controller's
+    # surface; doc/performance.md "Self-tuning runtime")
+    def set_max_batch_size(self, n: int, prewarm: bool = True) -> int:
+        """Retune the micro-batcher's coalescing limit at runtime,
+        clamped to the engine's configured ``max_batch_size`` (the
+        request-validation cap and largest compiled bucket).  With
+        ``prewarm`` (the default) the new limit's bucket is compiled
+        BEFORE the limit applies, on the calling thread — the first
+        bigger coalesced batch then hits a warm program instead of
+        stalling every submitter behind XLA."""
+        n = max(1, min(int(n), self.max_batch_size))
+        if prewarm:
+            # warm the DOMINANT observed request row shape (or the
+            # native shape before any traffic) — programs specialize
+            # per row shape, so warming the wrong one buys nothing
+            self._warm_bucket(self._bucket_for(n),
+                              self._dominant_row_shape())
+        self.batcher.set_max_batch_size(n)
+        from ..tune.controller import set_effective
+
+        set_effective("max_batch_size", n)
+        return n
+
+    def set_batch_timeout_ms(self, ms: float) -> float:
+        """Retune the micro-batcher's batch-open window at runtime."""
+        out = self.batcher.set_batch_timeout_ms(ms)
+        from ..tune.controller import set_effective
+
+        set_effective("batch_timeout_ms", out)
+        return out
+
+    def _bucket_for(self, n: int) -> int:
+        with self._model_lock:
+            return self._cache.bucket_for(n)
+
+    def _dominant_row_shape(self) -> Tuple[int, ...]:
+        """The most-requested row shape so far (native shape before
+        any traffic) — what a speculative warm should compile for."""
+        with self._req_lock:
+            if self._req_buckets:
+                (_b, shape), _ = max(self._req_buckets.items(),
+                                     key=lambda kv: kv[1])
+                return tuple(shape)
+        return tuple(self._row_shapes[0])
+
+    def _warm_bucket(self, bucket: int,
+                     row_shape: Tuple[int, ...]) -> bool:
+        """Compile the predict program for ``bucket`` rows of
+        ``row_shape`` (no-op when that exact program is already warm —
+        programs specialize per row shape, so the native 4-D and the
+        flat wrapper spelling are distinct entries).  Thread-safe
+        against the batcher — JAX dispatch is; the model lock is only
+        held to snapshot the cache pointer."""
+        row_shape = tuple(row_shape)
+        with self._model_lock:
+            cache = self._cache
+        if any(k[1] == "out" and k[3] == bucket
+               and tuple(k[4]) == row_shape
+               for k in cache.keys_snapshot()):
+            return False
+        zeros = np.zeros((bucket,) + row_shape, np.float32)
+        try:
+            cache._run("out", None, zeros)
+        except Exception as e:  # noqa: BLE001 - a failed warm only costs
+            obs_events.log_exception_once(   # the later cold compile
+                "serve.prewarm", e, kind="tune.error", bucket=bucket)
+            return False
+        return True
+
+    def prewarm_buckets(self, max_new: int = 2) -> list:
+        """Speculatively compile the hottest not-yet-warm
+        (bucket, row shape) programs from the request-shape histogram
+        (``serve_request_bucket_total`` / ``/statsz`` request_buckets),
+        up to the current live batch limit.  Cheap when everything hot
+        is already warm; the controller runs it once per tick."""
+        with self._req_lock:
+            hist = sorted(self._req_buckets.items(), key=lambda kv: -kv[1])
+        ceiling = self._bucket_for(self.batcher.max_batch_size)
+        warmed = []
+        for (bucket, shape), count in hist:
+            if len(warmed) >= max_new:
+                break
+            if bucket > ceiling:
+                continue
+            if self._warm_bucket(bucket, shape):
+                warmed.append(bucket)
+                obs_events.emit("tune.prewarm", bucket=bucket,
+                                row_shape=list(shape), requests=count)
+        return warmed
+
+    # ------------------------------------------------------------------
     # introspection
     @property
     def round(self) -> int:
@@ -491,6 +599,19 @@ class Engine:
             "batch_timeout_ms": self.batcher.batch_timeout * 1e3,
             "queue_limit": self.batcher.queue_limit,
         }
+        # the CURRENT effective knob values (the batcher block reports
+        # the same numbers but this block is the stable tuning surface:
+        # what the controller chose, mirrored as tune_effective{knob}
+        # gauges in /metricsz)
+        out["tune_effective"] = {
+            "max_batch_size": self.batcher.max_batch_size,
+            "batch_timeout_ms": self.batcher.batch_timeout * 1e3,
+        }
+        agg: Dict[int, int] = {}
+        with self._req_lock:
+            for (b, _shape), c in self._req_buckets.items():
+                agg[b] = agg.get(b, 0) + c
+        out["request_buckets"] = {str(k): v for k, v in sorted(agg.items())}
         out["reload_breaker"] = self.reload_breaker.snapshot()
         return out
 

@@ -93,6 +93,14 @@ class _ServeMetrics:
             "serve_queue_depth_errors_total",
             "Queue-depth gauge sampling failures.",
         )
+        # request-shape histogram (pow2 bucket of each request's row
+        # count) — what the speculative bucket prewarm and the tuning
+        # controller read to anticipate compiled-program demand
+        self.request_buckets = reg.counter(
+            "serve_request_bucket_total",
+            "Requests by pow2 row-count bucket (shape histogram).",
+            labelnames=("bucket",),
+        )
 
 
 _METRICS: Optional[_ServeMetrics] = None
@@ -153,12 +161,15 @@ class ServingStats:
         self._queue_depth = fn
         self._m.queue_depth.set_function(fn)
 
-    def record_request(self, rows: int) -> None:
+    def record_request(self, rows: int,
+                       bucket: Optional[int] = None) -> None:
         with self._lock:
             self.requests += 1
             self.rows_in += rows
         self._m.requests.inc()
         self._m.rows_in.inc(rows)
+        if bucket is not None:
+            self._m.request_buckets.labels(bucket=bucket).inc()
 
     def record_outcome(self, outcome: str,
                        latency_s: Optional[float] = None) -> None:

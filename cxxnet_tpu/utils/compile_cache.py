@@ -46,6 +46,33 @@ def enable(path: str, silent: bool = True) -> bool:
     os.makedirs(path, exist_ok=True)
     import jax
 
+    # KNOWN SHARP EDGE (jaxlib 0.4.3x, root-caused in PR 8): enabling
+    # the cache MID-PROCESS — after donated-buffer programs (the fused
+    # train step) have already compiled — intermittently corrupts
+    # subsequent re-jitted programs: silent numeric garbage or a glibc
+    # SIGSEGV/Abort inside batched_device_put.  This was tier-1's
+    # multi-file flake (a test enabled the cache mid-suite; every
+    # later trainer rebuild re-jitted through it).  The CLI and the
+    # serving engine enable the cache BEFORE any jit (config order
+    # guarantees it), which is verified safe; anything else gets a
+    # loud warning instead of a latent heisenbug.
+    try:
+        from jax._src import xla_bridge as _xb
+
+        mid_process = bool(getattr(_xb, "_backends", None))
+    except Exception:  # pragma: no cover - jax internals moved
+        mid_process = False
+    if mid_process:
+        from ..obs import events as obs_events
+
+        obs_events.emit("compile_cache.mid_process_enable", dir=path)
+        print(
+            "WARNING: compile_cache enabled after a JAX backend was "
+            "already initialized; on jaxlib 0.4.3x re-jitting donated "
+            "programs through a mid-process-enabled cache can corrupt "
+            "results or crash — enable compile_cache_dir before the "
+            "first jit (the CLI/serve engine order)", flush=True,
+        )
     jax.config.update("jax_compilation_cache_dir", path)
     for knob, val in (
         # cache every program no matter how small/fast to compile —

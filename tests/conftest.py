@@ -62,6 +62,63 @@ def _disarm_faults():
     faults.reset()
 
 
+# ----------------------------------------------------------------------
+# daemon-thread leak accounting.  The multi-file tier-1 flake (see
+# CHANGES.md, PR 7) had ~24 leaked daemon threads alive at crash time;
+# this guard bounds that suspect: every module gets a grace period to
+# join the threads it started, the survivors are accounted, and a module
+# that leaks more than CXXNET_THREAD_LEAK_LIMIT (default 12) fails
+# loudly with their names instead of letting the leak compound silently
+# across the suite.
+_THREAD_LEAKS = {}  # module name -> [thread names] (session accounting)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _thread_leak_guard(request):
+    import threading
+    import time
+
+    # object identity, not ident: thread idents are recycled by the OS,
+    # so an ident set would mistake a fresh thread for a finished one
+    before = set(threading.enumerate())
+    yield
+    deadline = time.monotonic() + 3.0
+
+    def _leaked():
+        return [
+            t for t in threading.enumerate()
+            if t.is_alive() and t not in before
+            and t is not threading.current_thread()
+        ]
+
+    new = _leaked()
+    while new and time.monotonic() < deadline:
+        for t in new:  # join what exits on its own (close() in flight)
+            t.join(timeout=0.2)
+        new = _leaked()
+    if not new:
+        return
+    names = sorted(t.name for t in new)
+    _THREAD_LEAKS[request.module.__name__] = names
+    limit = int(os.environ.get("CXXNET_THREAD_LEAK_LIMIT", "12"))
+    if len(new) > limit:
+        pytest.fail(
+            f"{request.module.__name__} leaked {len(new)} daemon "
+            f"threads (> limit {limit}): {names} — close your "
+            "iterators/engines/evaluators (CXXNET_THREAD_LEAK_LIMIT "
+            "overrides)", pytrace=False,
+        )
+
+
+def pytest_terminal_summary(terminalreporter):
+    if _THREAD_LEAKS:
+        total = sum(len(v) for v in _THREAD_LEAKS.values())
+        terminalreporter.write_sep(
+            "-", f"daemon-thread leak accounting: {total} leaked")
+        for mod, names in sorted(_THREAD_LEAKS.items()):
+            terminalreporter.write_line(f"  {mod}: {len(names)} {names}")
+
+
 def run_cli(args, cwd, timeout=300, module=True):
     """Shared subprocess harness for driving the CLI (or a tool script,
     module=False with args[0] an absolute script path) in tests.

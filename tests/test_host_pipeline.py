@@ -70,6 +70,10 @@ iter = end
 
 
 def _epochs(it, n_epochs=2):
+    """Collect ``n_epochs`` of batches, then CLOSE the chain — every
+    call site's last use of its iterator.  Leaving decode pools alive
+    was this module's contribution to the suite-wide daemon-thread
+    leak (the multi-file flake suspect conftest now bounds)."""
     out = []
     for _ in range(n_epochs):
         it.before_first()
@@ -77,6 +81,7 @@ def _epochs(it, n_epochs=2):
             b = it.value()
             out.append((b.data.tobytes(), b.label.tobytes(),
                         b.num_batch_padd))
+    it.close()
     return out
 
 
@@ -361,44 +366,76 @@ def test_pool_watchdog_and_close_are_clean(tmp_path):
 
 
 # ----------------------------------------------------------------------
-# persistent compile cache
-def test_compile_cache_dir_persists_programs(tmp_path):
-    from cxxnet_tpu.nnet.trainer import NetTrainer
-    from cxxnet_tpu.io.data import DataBatch
+# persistent compile cache.  BOTH tests run in a SUBPROCESS: enabling
+# jax's persistent compilation cache is process-global and permanent,
+# and enabling it MID-PROCESS — after donated-buffer programs already
+# compiled — intermittently corrupts later re-jitted programs on
+# jaxlib 0.4.3x (silent numeric garbage or a SIGSEGV in
+# batched_device_put).  Running these in-process was the root cause of
+# tier-1's multi-file loop-gate flake (PR 8 bisect; see
+# utils/compile_cache.py for the production-order guarantee).
+def _run_py(script, cwd):
+    import subprocess
+    import sys
 
-    cache_dir = tmp_path / "xla_cache"
-    cfg = [
-        ("compile_cache_dir", str(cache_dir)),
-        ("dev", "cpu"), ("batch_size", "8"), ("input_shape", "1,1,6"),
-        ("seed", "3"), ("eta", "0.1"),
-        ("netconfig", "start"),
-        ("layer[0->1]", "fullc:fc"), ("nhidden", "4"),
-        ("layer[1->1]", "softmax"),
-        ("netconfig", "end"),
-    ]
-    tr = NetTrainer()
-    tr.set_params(cfg)
-    tr.init_model()
-    rng = np.random.RandomState(0)
-    tr.update(DataBatch(
-        data=rng.randn(8, 6).astype(np.float32),
-        label=rng.randint(0, 4, (8, 1)).astype(np.float32),
-    ))
-    assert cache_dir.is_dir()
-    entries = list(cache_dir.iterdir())
-    assert entries, "persistent compile cache wrote no entries"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo
+    return subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        cwd=str(cwd), env=env, timeout=240,
+    )
+
+
+def test_compile_cache_dir_persists_programs(tmp_path):
+    r = _run_py(f"""
+import numpy as np
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.io.data import DataBatch
+
+cache_dir = {str(tmp_path / "xla_cache")!r}
+cfg = [
+    ("compile_cache_dir", cache_dir),
+    ("dev", "cpu"), ("batch_size", "8"), ("input_shape", "1,1,6"),
+    ("seed", "3"), ("eta", "0.1"),
+    ("netconfig", "start"),
+    ("layer[0->1]", "fullc:fc"), ("nhidden", "4"),
+    ("layer[1->1]", "softmax"),
+    ("netconfig", "end"),
+]
+tr = NetTrainer()
+tr.set_params(cfg)
+tr.init_model()
+rng = np.random.RandomState(0)
+tr.update(DataBatch(
+    data=rng.randn(8, 6).astype(np.float32),
+    label=rng.randint(0, 4, (8, 1)).astype(np.float32),
+))
+import os
+entries = os.listdir(cache_dir)
+assert entries, "persistent compile cache wrote no entries"
+print("CACHE_OK", len(entries))
+""", tmp_path)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "CACHE_OK" in r.stdout
 
 
 def test_compile_cache_configure_scans_cfg(tmp_path):
-    from cxxnet_tpu.utils import compile_cache
+    r = _run_py(f"""
+from cxxnet_tpu.utils import compile_cache
 
-    d = tmp_path / "cc"
-    assert compile_cache.configure([("foo", "1"),
-                                    ("compile_cache_dir", str(d))])
-    assert compile_cache.enabled_dir() == str(d)
-    assert d.is_dir()
-    # idempotent
-    assert not compile_cache.configure([("compile_cache_dir", str(d))])
+d = {str(tmp_path / "cc")!r}
+assert compile_cache.configure([("foo", "1"), ("compile_cache_dir", d)])
+assert compile_cache.enabled_dir() == d
+import os
+assert os.path.isdir(d)
+# idempotent
+assert not compile_cache.configure([("compile_cache_dir", d)])
+print("CONFIGURE_OK")
+""", tmp_path)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "CONFIGURE_OK" in r.stdout
 
 
 # ----------------------------------------------------------------------

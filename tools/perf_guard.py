@@ -160,21 +160,25 @@ def compare(bench: str, metrics: Dict[str, float], history: List[dict],
                  if isinstance(e["metrics"].get(name), (int, float))]
         if prior:
             baseline[name] = _median(prior)
+    # orientation-aware noise banding shared with the self-tuning
+    # controller's keep/rollback verdicts (cxxnet_tpu/tune): a bench
+    # delta the controller would keep is exactly one the sentinel
+    # would call an improvement, and vice versa
+    from cxxnet_tpu.tune.controller import band_verdict
+
     regressions, improvements = [], []
     for name, value in sorted(metrics.items()):
         base = baseline.get(name)
         if base is None or base == 0:
             continue
         ratio = value / base
-        worse = ratio > 1 + band if lower_is_better(name) \
-            else ratio < 1 - band
-        better = ratio < 1 - band if lower_is_better(name) \
-            else ratio > 1 + band
+        verdict_ = band_verdict(value, base, band,
+                                lower_is_better=lower_is_better(name))
         row = {"metric": name, "value": value, "baseline": base,
                "ratio": round(ratio, 4)}
-        if worse:
+        if verdict_ == "worse":
             regressions.append(row)
-        elif better:
+        elif verdict_ == "better":
             improvements.append(row)
     verdict = ("baseline" if not baseline
                else "regression" if regressions else "ok")

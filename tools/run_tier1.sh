@@ -17,6 +17,16 @@
 #                                     # eval gate rejecting a poisoned
 #                                     # update and publishing+reloading
 #                                     # an improving one (JSON verdict)
+#        TUNE=1 tools/run_tier1.sh    # also run the self-tuning smoke:
+#                                     # io_bench + serve_bench --autotune
+#                                     # start from deliberately bad knobs
+#                                     # (1 worker / queue 1 / batch 1 /
+#                                     # 1 ms window) and the controller
+#                                     # must recover >= 90% of the hand-
+#                                     # tuned throughput (JSON verdicts,
+#                                     # schema-validated by the tools);
+#                                     # both reports append to a
+#                                     # perf_guard history
 #        OBS=1 tools/run_tier1.sh     # also run the observability smoke:
 #                                     # short telemetry=1 train + serve
 #                                     # scrape of /metricsz + /alertz
@@ -48,6 +58,26 @@ if [ "${LOOP:-0}" = "1" ]; then
   echo "=== opt-in closed-loop smoke (LOOP=1) ==="
   timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python tools/loop_smoke.py || rc=1
+fi
+if [ "${TUNE:-0}" = "1" ]; then
+  echo "=== opt-in self-tuning smoke (TUNE=1) ==="
+  tune_out=/tmp/_tune_smoke
+  rm -rf "$tune_out"; mkdir -p "$tune_out"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python tools/io_bench.py 1024 160 --autotune \
+      --json "$tune_out/io_autotune.json" || rc=1
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python tools/serve_bench.py --autotune --autotune-seconds 20 \
+      --json "$tune_out/serve_autotune.json" > /dev/null || rc=1
+  timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python tools/perf_guard.py --bench io_bench \
+      --input "$tune_out/io_autotune.json" \
+      --history "$tune_out/bench_history.jsonl" > /dev/null || rc=1
+  timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python tools/perf_guard.py --bench serve_bench \
+      --input "$tune_out/serve_autotune.json" \
+      --history "$tune_out/bench_history.jsonl" > /dev/null || rc=1
+  echo "TUNE lane verdicts: $tune_out/{io,serve}_autotune.json"
 fi
 if [ "${OBS:-0}" = "1" ]; then
   echo "=== opt-in observability smoke (OBS=1) ==="

@@ -153,6 +153,130 @@ def open_loop(eng, x, rate, duration):
     return out
 
 
+def run_autotune(args) -> dict:
+    """Bad-knobs recovery for the serve plane: start the micro-batcher
+    at deliberately bad settings (batch 1, 1 ms window), drive
+    closed-loop traffic while the self-tuning controller
+    (``cxxnet_tpu/tune``) retunes it — with speculative bucket prewarm
+    compiling each bigger bucket BEFORE it goes live — then re-measure
+    cleanly and compare against the hand-tuned defaults.  The TUNE=1
+    lane asserts ``recovery_ratio >= threshold``."""
+    import threading as _thr
+
+    from cxxnet_tpu.tune import KnobController, batcher_knobs
+
+    # hand-tuned reference engine: the defaults (max-batch capacity,
+    # 2 ms).  Built and warmed now, MEASURED at the end interleaved
+    # with the tuned engine — measuring the two legs ~30 s apart made
+    # the recovery ratio hostage to machine-load drift between the
+    # windows (the same fix io_bench.run_autotune carries).
+    hand_eng, x = build_engine(args)
+    for _ in range(8):
+        hand_eng.predict(x)
+
+    # bad knobs + controller; a fresh engine so stats stay per-leg
+    eng2, x = build_engine(args)
+    eng2.set_max_batch_size(1, prewarm=False)
+    eng2.set_batch_timeout_ms(1.0)
+    for _ in range(8):
+        eng2.predict(x)
+    bad = closed_loop(eng2, x, args.concurrency,
+                      max(8, args.requests // 8))
+    ctrl = KnobController(
+        lambda: float(eng2.stats.batch_rows), batcher_knobs(eng2),
+        period_s=args.tune_period, band=args.tune_band,
+        name="serve_bench", on_tick=eng2.prewarm_buckets,
+    )
+    stop_evt = _thr.Event()
+
+    def _traffic():
+        while not stop_evt.is_set():
+            try:
+                eng2.predict(x)
+            except Exception:
+                time.sleep(0.01)
+
+    threads = [_thr.Thread(target=_traffic, daemon=True)
+               for _ in range(args.concurrency)]
+    ctrl.start()
+    for t in threads:
+        t.start()
+    time.sleep(args.autotune_seconds)
+    ctrl.stop()
+    stop_evt.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    snap = ctrl.snapshot()
+    # interleaved clean re-measures: tuned / hand / tuned / hand, back
+    # to back, best-of per leg — drift hits both legs equally
+    half = max(8, args.requests // 2)
+    tuned_runs, hand_runs = [], []
+    for _ in range(2):
+        tuned_runs.append(closed_loop(eng2, x, args.concurrency, half))
+        hand_runs.append(closed_loop(hand_eng, x, args.concurrency, half))
+    final = max(tuned_runs, key=lambda r: r["req_per_sec"])
+    hand = max(hand_runs, key=lambda r: r["req_per_sec"])
+    stats = eng2.snapshot_stats()
+    eng2.close()
+    hand_eng.close()
+    recovery = (final["req_per_sec"] / hand["req_per_sec"]
+                if hand["req_per_sec"] > 0 else 0.0)
+    threshold = args.recovery
+    return {
+        "model": args.model,
+        "dev": args.dev,
+        "rows_per_request": args.rows,
+        "closed_loop": {"concurrent": final},
+        "autotune": {
+            "seconds": args.autotune_seconds,
+            "period_s": args.tune_period,
+            "band": args.tune_band,
+            "initial": {"max_batch_size": 1, "batch_timeout_ms": 1.0,
+                        "req_per_sec": bad["req_per_sec"],
+                        "p50_ms": bad["latency_ms"]["p50"]},
+            "hand": {"max_batch_size": args.max_batch,
+                     "batch_timeout_ms": args.timeout_ms,
+                     "req_per_sec": hand["req_per_sec"],
+                     "p50_ms": hand["latency_ms"]["p50"]},
+            "tuned": {"max_batch_size": snap["knobs"]["max_batch_size"],
+                      "batch_timeout_ms":
+                          snap["knobs"]["batch_timeout_ms"],
+                      "req_per_sec": final["req_per_sec"],
+                      "p50_ms": final["latency_ms"]["p50"]},
+            "controller": snap,
+            "recovery_ratio": recovery,
+            "threshold": threshold,
+            "ok": bool(recovery >= threshold),
+        },
+        "serving_stats": stats,
+    }
+
+
+def validate_autotune(doc: dict) -> None:
+    """Schema check for the serve ``--autotune`` verdict (the TUNE=1
+    lane's contract); raises ValueError on drift."""
+    import math
+
+    at = doc.get("autotune")
+    if not isinstance(at, dict):
+        raise ValueError("serve autotune report: missing autotune section")
+    for key in ("initial", "hand", "tuned", "recovery_ratio",
+                "threshold", "ok", "controller"):
+        if key not in at:
+            raise ValueError(f"serve autotune report: missing {key!r}")
+    for leg in ("initial", "hand", "tuned"):
+        for field in ("req_per_sec", "p50_ms"):
+            v = at[leg].get(field)
+            if not (isinstance(v, (int, float)) and math.isfinite(v)
+                    and v > 0):
+                raise ValueError(
+                    f"serve autotune report: bad {leg}.{field} {v!r}")
+    conc = doc.get("closed_loop", {}).get("concurrent", {})
+    if "req_per_sec" not in conc:
+        raise ValueError(
+            "serve autotune report: closed_loop.concurrent missing")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--model", default="mnist_mlp")
@@ -167,7 +291,35 @@ def main(argv=None):
     ap.add_argument("--open-rates", default="",
                     help="comma-separated offered req/s for open-loop runs")
     ap.add_argument("--open-duration", type=float, default=3.0)
+    ap.add_argument("--json", dest="json_path", default="",
+                    help="also write the JSON report here")
+    ap.add_argument("--autotune", action="store_true",
+                    help="bad-knobs recovery via the tune controller "
+                         "(TUNE=1 lane); exits 1 below --recovery")
+    ap.add_argument("--autotune-seconds", type=float, default=15.0)
+    ap.add_argument("--tune-period", type=float, default=0.5)
+    ap.add_argument("--tune-band", type=float, default=0.1)
+    ap.add_argument("--recovery", type=float, default=0.9,
+                    help="autotune pass bar vs the hand-tuned rate")
     args = ap.parse_args(argv)
+
+    if args.autotune:
+        result = run_autotune(args)
+        validate_autotune(result)
+        at = result["autotune"]
+        print(json.dumps(result, indent=1))
+        if args.json_path:
+            with open(args.json_path, "w", encoding="utf-8") as f:
+                json.dump(result, f, indent=1)
+        print(f"# autotune: bad {at['initial']['req_per_sec']:.0f} req/s "
+              f"-> tuned {at['tuned']['req_per_sec']:.0f} req/s "
+              f"(batch={at['tuned']['max_batch_size']}, "
+              f"timeout={at['tuned']['batch_timeout_ms']:.2f}ms) vs hand "
+              f"{at['hand']['req_per_sec']:.0f} req/s: recovery "
+              f"{at['recovery_ratio']:.2f} "
+              f"({'OK' if at['ok'] else 'FAIL'} at >= {at['threshold']})",
+              file=sys.stderr, flush=True)
+        return 0 if at["ok"] else 1
 
     eng, x = build_engine(args)
     for _ in range(8):
@@ -196,6 +348,9 @@ def main(argv=None):
     result["serving_stats"] = eng.snapshot_stats()
     eng.close()
     print(json.dumps(result, indent=1))
+    if args.json_path:
+        with open(args.json_path, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=1)
     return 0
 
 
