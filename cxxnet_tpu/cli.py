@@ -448,6 +448,7 @@ class LearnTask:
                     net_fp=self.net_trainer.net_fp(),
                     save_ustate=self.net_trainer.save_ustate,
                     retry=True, silent=bool(self.silent),
+                    mesh=self.net_trainer.mesh_manifest(),
                 )
                 if self.keep_latest > 0:
                     ckpt.apply_retention(
@@ -599,6 +600,32 @@ class LearnTask:
             name="serve",
         )
 
+    def _print_mesh_summary(self) -> None:
+        """One line of SPMD layout truth at train start: mesh shape,
+        ZeRO level, and the measured per-device train-state bytes vs
+        the replicated footprint — the memory headroom the sharded
+        weight update bought, stated where an operator reads logs
+        (the same numbers live as ``train_state_shard_bytes{device}``
+        in ``/metricsz``)."""
+        tr = self.net_trainer
+        if self.silent or tr is None or tr.mesh_plan is None:
+            return
+        plan = tr.mesh_plan
+        if plan.n_devices <= 1:
+            return
+        try:
+            per_device, total = tr.state_shard_bytes()
+            worst = max(per_device.values()) if per_device else total
+        except Exception:  # noqa: BLE001 - a log line must never abort
+            return
+        print(
+            f"mesh: {plan.describe(zero=tr.zero)}"
+            f" | train state {total / 1e6:.2f} MB replicated -> "
+            f"{worst / 1e6:.2f} MB/device "
+            f"({worst / total if total else 1:.2%} of a full copy)",
+            flush=True,
+        )
+
     def task_train(self) -> None:
         from .parallel.distributed import any_process_flag, process_info
         from .utils.checkpoint import DivergenceError, PreemptionHandler
@@ -622,6 +649,7 @@ class LearnTask:
         timer = StepTimer()
         tracer = TraceController()
         tracer.configure(self.cfg)
+        self._print_mesh_summary()
         obs_emit("train.start", task=self.task, round=self.start_counter,
                  num_round=self.num_round)
         self._global_step = 0
@@ -1325,8 +1353,7 @@ class LearnTask:
         print(f"total parameters: {total:,} "
               f"({total * 4 / 1e6:.1f} MB f32)")
         if tr.mesh_plan is not None:
-            print(f"mesh: data={tr.mesh_plan.n_data} "
-                  f"model={tr.mesh_plan.n_model} zero={tr.zero}")
+            print(f"mesh: {tr.mesh_plan.describe(zero=tr.zero)}")
 
     def task_generate(self) -> None:
         """``task=generate``: autoregressive byte sampling from a trained

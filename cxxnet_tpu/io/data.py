@@ -62,24 +62,44 @@ class DataBatch:
         return self.sparse_index[lo:hi], self.sparse_value[lo:hi]
 
 
-def shard_rows(n_rows: int, rank: int, nworker: int):
+def shard_rows(n_rows: int, rank: int, nworker: int, block: int = 1):
     """Equal-length row shard for distributed data parallelism.
 
-    Worker ``rank`` takes rows ``rank::nworker`` truncated to
-    ``n_rows // nworker``: shards are disjoint AND the same length, so
-    every process runs the same number of batches per round.  Unequal
+    ``block = 1`` (default): worker ``rank`` takes rows ``rank::nworker``
+    truncated to ``n_rows // nworker`` — disjoint AND class-mixed even
+    on unshuffled data.  Shards are always the same length: unequal
     shards (plain ``k::n`` slicing) deadlock the SPMD train loop — the
     process with one extra batch issues a collective the others never
-    join.  Returns an index array.
+    join.
+
+    ``block > 1`` (``dist_shard = block`` with the LOCAL batch size):
+    rows are dealt out in contiguous blocks of ``block`` round-robin,
+    so worker ``rank``'s k-th local batch is exactly rows
+    ``[k*B*nworker + rank*B, ... + B)`` of the global stream — the
+    global SPMD batch assembled across workers is the IDENTICAL rows in
+    the IDENTICAL order a single-process run of the same mesh feeds.
+    That alignment is what makes the multi-process trainer bitwise equal
+    to the single-process one (the MESH=1 parity lane): interleaved
+    shards permute rows across data-axis shards, which reorders the
+    gradient reduction and drifts ~1 ulp/step.  Returns an index array.
     """
     import numpy as _np
 
-    per = n_rows // nworker
-    if per == 0:
+    if block <= 1:
+        per = n_rows // nworker
+        if per == 0:
+            raise ValueError(
+                f"cannot shard {n_rows} rows over {nworker} workers"
+            )
+        return _np.arange(rank, n_rows, nworker)[:per]
+    nblocks = n_rows // (block * nworker)
+    if nblocks == 0:
         raise ValueError(
-            f"cannot shard {n_rows} rows over {nworker} workers"
+            f"cannot shard {n_rows} rows over {nworker} workers in "
+            f"blocks of {block}"
         )
-    return _np.arange(rank, n_rows, nworker)[:per]
+    starts = (_np.arange(nblocks) * nworker + rank) * block
+    return (starts[:, None] + _np.arange(block)[None, :]).reshape(-1)
 
 
 class DataIter:

@@ -58,6 +58,7 @@ class MNISTIterator(DataIter):
         self.seed = 0
         self.dist_num_worker = 1
         self.dist_worker_rank = 0
+        self.dist_shard = "interleave"  # or "block": contiguous batches
         self._loc = 0
         self._img: np.ndarray | None = None
         self._label: np.ndarray | None = None
@@ -84,6 +85,11 @@ class MNISTIterator(DataIter):
             self.dist_num_worker = int(val)
         elif name == "dist_worker_rank":
             self.dist_worker_rank = int(val)
+        elif name == "dist_shard":
+            if val not in ("interleave", "block"):
+                raise ValueError(
+                    f"dist_shard={val!r}: must be interleave or block")
+            self.dist_shard = val
 
     def init(self):
         imgs = read_idx_images(self.path_img).astype(np.float32) / 256.0
@@ -98,11 +104,17 @@ class MNISTIterator(DataIter):
         if self.dist_num_worker > 1:
             # distributed data sharding after the deterministic shuffle
             # so shards are disjoint AND mixed; equal-truncated so every
-            # worker runs the same batch count (see data.shard_rows)
+            # worker runs the same batch count (see data.shard_rows).
+            # dist_shard = block deals rows out in local-batch-size
+            # blocks instead: the assembled global SPMD batch is then
+            # row-identical to a single-process run — the bitwise
+            # parity contract of the MESH=1 lane
             from .data import shard_rows
 
             sl = shard_rows(
-                len(labels), self.dist_worker_rank, self.dist_num_worker
+                len(labels), self.dist_worker_rank, self.dist_num_worker,
+                block=(self.batch_size if self.dist_shard == "block"
+                       else 1),
             )
             imgs, labels, inst = imgs[sl], labels[sl], inst[sl]
         if self.input_flat:

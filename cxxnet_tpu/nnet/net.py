@@ -308,12 +308,31 @@ class FunctionalNet:
 
     @staticmethod
     def _apply_fused_1x1(stride: int, gparams: List[dict], x):
-        """One conv for the whole sibling group; per-member outputs."""
+        """One conv for the whole sibling group; per-member outputs.
+
+        The group kernel is assembled by SCATTERING each member into a
+        zeros block (``.at[].set``), NOT ``jnp.concatenate``: under a
+        model-parallel mesh the member kernels arrive sharded on their
+        output-channel axis, and this jaxlib's GSPMD partitioner
+        miscompiles concatenate-along-the-sharded-axis feeding a
+        convolution (silently wrong values, ~0.5 absolute on unit-scale
+        activations; verified jaxlib 0.4.36 CPU, 2- and 4-way model
+        axes).  The dynamic-update-slice lowering partitions correctly
+        — bit-identical to the unfused path in the mp=1 case and within
+        SPMD parity tolerance under TP (tests/test_parallel.py
+        ``test_fuse_1x1_matches_under_mesh``)."""
         from jax import lax
 
         ws = [d["wmat"].astype(x.dtype) for d in gparams]
+        cin = ws[0].shape[2]
+        nout = sum(w.shape[3] for w in ws)
+        wk = jnp.zeros((1, 1, cin, nout), x.dtype)
+        off = 0
+        for w in ws:
+            wk = wk.at[:, :, :, off:off + w.shape[3]].set(w)
+            off += w.shape[3]
         y = lax.conv_general_dilated(
-            x, jnp.concatenate(ws, axis=3),
+            x, wk,
             window_strides=(stride, stride), padding=((0, 0), (0, 0)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
@@ -488,8 +507,14 @@ class FunctionalNet:
         ``wmat`` (HWIO) — static under trace."""
         from jax import lax
 
-        assert all(xi.shape[:3] == xs[0].shape[:3] for xi in xs), \
-            "branch-embed members must share input spatial dims"
+        if not all(xi.shape[:3] == xs[0].shape[:3] for xi in xs):
+            # explicit raise (not assert — stripped under python -O): a
+            # planner regression must surface as this message, not as an
+            # opaque concatenate shape error downstream
+            raise ValueError(
+                "branch-embed members must share input spatial dims: "
+                f"{[tuple(xi.shape) for xi in xs]}"
+            )
         ws = [d["wmat"].astype(xs[0].dtype) for d in gparams]
         kmax = max(w.shape[0] for w in ws)
         pad = (kmax - 1) // 2

@@ -127,10 +127,14 @@ class NetTrainer:
             # fault-injection harness: treat the loss at this epoch as
             # NaN (one transient blow-up) so recovery paths are testable
             self.inject_nan_step = int(val)
-        elif name in ("zero", "fsdp"):
+        elif name in ("zero", "fsdp", "shard_weight_update"):
             # zero = 1: optimizer state sharded over the data axis
             # (update_on_server's modern spelling); zero = 3 / fsdp = 1:
             # params themselves sharded too (MeshPlan.fsdp_sharding).
+            # shard_weight_update = 1 is the conf-level name for the
+            # ZeRO-1 cross-replica weight-update sharding (arXiv
+            # 2004.13336): reduce-scatter gradients, each replica
+            # updates its 1/N shard, gather the new weights.
             # ZeRO-2 has no distinct GSPMD expression here: gradients
             # are transient inside the fused step, so 2 would silently
             # equal 1 — reject it rather than mislead.
@@ -138,6 +142,11 @@ class NetTrainer:
                 if int(val) not in (0, 1):
                     raise ValueError(f"fsdp={val}: must be 0 or 1")
                 z = 3 if int(val) else 0
+            elif name == "shard_weight_update":
+                if int(val) not in (0, 1):
+                    raise ValueError(
+                        f"shard_weight_update={val}: must be 0 or 1")
+                z = 1 if int(val) else 0
             else:
                 z = int(val)
             if z not in (0, 1, 3):
@@ -227,6 +236,7 @@ class NetTrainer:
         self.epoch_counter = 0
         self.sample_counter = 0
         self._grad_accum = None
+        self._place_state()
 
     def _build_mesh(self) -> None:
         """dev=tpu:0-3 → ('data','model') mesh; the mshadow-ps replacement."""
@@ -265,14 +275,102 @@ class NetTrainer:
             ush = jax.tree_util.tree_map(spec, self.ustates)
         return psh, ush
 
+    def _place_state(self) -> None:
+        """Explicitly place params / updater state / aux onto their mesh
+        shardings (one ``jax.device_put`` per pytree).
+
+        Called at the end of ``init_model`` / ``load_model`` /
+        ``copy_model_from`` so the train state LIVES in its SPMD layout
+        from step 0 rather than only after the first donated step
+        resharded it: ZeRO-sharded runs get their ~1/N per-device
+        params+state footprint immediately (the memory headroom is
+        available for the first compile, which is when XLA sizes its
+        temporary buffers), donation in the fused step is alias-clean
+        (inputs already match ``in_shardings`` — no hidden copy), and a
+        checkpoint written on one mesh re-shards onto the CURRENT mesh
+        at load (resume on a different device count just works).
+        Placement only — bitwise no-op on the training math."""
+        if self.params is None or self.mesh_plan is None:
+            self._export_state_bytes()
+            return
+        if self.mesh_plan.n_devices > 1:
+            psh, ush = self._param_sh()
+            self.params = jax.device_put(self.params, psh)
+            if self.ustates:
+                self.ustates = jax.device_put(self.ustates, ush)
+            if self.aux:
+                rep = self.mesh_plan.replicated()
+                self.aux = jax.device_put(
+                    self.aux,
+                    jax.tree_util.tree_map(lambda _: rep, self.aux),
+                )
+        self._export_state_bytes()
+
+    def state_shard_bytes(self):
+        """Per-device addressable bytes of params + updater state, plus
+        the replicated-equivalent total.
+
+        Returns ``(per_device, total)`` where ``per_device`` maps
+        ``"platform:id"`` to the bytes of train state RESIDENT on that
+        device and ``total`` is what one full replica costs — the
+        denominator of the ZeRO memory win (per-device ≈ total/N when
+        every dim shards; unshardable leaves keep it slightly above).
+        """
+        per_device: Dict[str, float] = {}
+        total = 0
+        for tree in (self.params, self.ustates):
+            for leaf in jax.tree_util.tree_leaves(tree or {}):
+                nbytes = getattr(leaf, "nbytes", None)
+                if nbytes is None:
+                    nbytes = int(np.asarray(leaf).nbytes)
+                total += int(nbytes)
+                shards = getattr(leaf, "addressable_shards", None)
+                if shards:
+                    for s in shards:
+                        dev = f"{s.device.platform}:{s.device.id}"
+                        per_device[dev] = (
+                            per_device.get(dev, 0) + int(s.data.nbytes)
+                        )
+                else:
+                    per_device["host:0"] = (
+                        per_device.get("host:0", 0) + int(nbytes)
+                    )
+        return per_device, total
+
+    def _export_state_bytes(self) -> None:
+        """Publish ``train_state_shard_bytes{device}`` (and the
+        replicated-total gauge) so the ZeRO memory win is observable
+        next to ``xla_device_memory_bytes`` — fail-open like the rest
+        of the device plane."""
+        try:
+            per_device, total = self.state_shard_bytes()
+            obs_device.set_train_state_bytes(per_device, total)
+        except Exception:  # noqa: BLE001 - telemetry must never raise
+            pass
+
     # ------------------------------------------------------------------
     # jitted step functions (built lazily, cached per (train, accum) kind)
     def _n_extras(self) -> int:
         return self.graph.extra_data_num if self.graph else 0
 
     @staticmethod
-    def _apply_updates(updaters, params, ustates, grads, epoch):
-        """Per-tensor updater math over the param pytree (trace-time loop)."""
+    def _apply_updates(updaters, params, ustates, grads, epoch,
+                       gspec=None):
+        """Per-tensor updater math over the param pytree (trace-time loop).
+
+        ``gspec`` (shape → NamedSharding, set for ZeRO runs on a
+        non-trivial mesh) pins each gradient to the updater state's
+        data-axis sharding before the update math: the cross-replica
+        gradient sum then lands sharded (reduce-scatter, or all-reduce
+        + local slice where the backend lacks the fused pattern — this
+        jaxlib's CPU partitioner does the latter), the updater applies
+        shard-locally (each replica updates only its 1/N slice —
+        momentum/Adam moments never materialize whole), and the
+        program's replicated ``out_shardings`` on the new weights
+        becomes the trailing all-gather — the arXiv 2004.13336
+        weight-update-sharding dataflow, expressed purely as sharding
+        annotations.  Placement only; the parity suites pin the math.
+        """
         new_p = {}
         new_s = {}
         for key, tags in params.items():
@@ -280,10 +378,24 @@ class NetTrainer:
             new_s[key] = {}
             for tag, w in tags.items():
                 up = updaters[(key, tag)]
-                w2, s2 = up.apply(w, grads[key][tag], ustates[key][tag], epoch)
+                g = grads[key][tag]
+                if gspec is not None:
+                    g = jax.lax.with_sharding_constraint(
+                        g, gspec(np.shape(w)))
+                w2, s2 = up.apply(w, g, ustates[key][tag], epoch)
                 new_p[key][tag] = w2
                 new_s[key][tag] = s2
         return new_p, new_s
+
+    def _grad_spec(self):
+        """The gradient sharding hook for :meth:`_apply_updates`: the
+        state sharding on ZeRO runs over a real mesh, else None (a
+        1-device mesh must stay annotation-free — see ``_jit``)."""
+        plan = self.mesh_plan
+        if (plan is None or plan.n_devices <= 1
+                or not (self.update_on_server or self.zero >= 1)):
+            return None
+        return lambda shape: plan.state_sharding(shape)
 
     def _loss_and_out(self, params, aux, data, labels, mask, rng, epoch,
                       extras):
@@ -339,6 +451,7 @@ class NetTrainer:
             psh, ush = self._param_sh()
             loss_and_out = self._loss_and_out
             apply_updates = self._apply_updates
+            gspec = self._grad_spec()
 
             def step(params, ustates, aux, data, labels, mask, rng, epoch,
                      extras):
@@ -348,7 +461,8 @@ class NetTrainer:
                     ),
                     has_aux=True,
                 )(params)
-                new_p, new_s = apply_updates(updaters, params, ustates, grads, epoch)
+                new_p, new_s = apply_updates(updaters, params, ustates,
+                                             grads, epoch, gspec=gspec)
                 return new_p, new_s, new_aux, loss, out
 
             self._jit_cache["fused"] = self._jit(
@@ -385,6 +499,7 @@ class NetTrainer:
             psh, ush = self._param_sh()
             loss_and_out = self._loss_and_out
             apply_updates = self._apply_updates
+            gspec = self._grad_spec()
 
             def one_step(params, ustates, aux, data, labels, rng, epoch):
                 (loss, (out, new_aux)), grads = jax.value_and_grad(
@@ -394,7 +509,7 @@ class NetTrainer:
                     has_aux=True,
                 )(params)
                 new_p, new_s = apply_updates(
-                    updaters, params, ustates, grads, epoch
+                    updaters, params, ustates, grads, epoch, gspec=gspec
                 )
                 return new_p, new_s, new_aux, loss, out
 
@@ -657,9 +772,11 @@ class NetTrainer:
         if "apply" not in self._jit_cache:
             updaters = dict(self.updaters)
             apply_updates = self._apply_updates
+            gspec = self._grad_spec()
 
             def f(params, ustates, grads, epoch):
-                return apply_updates(updaters, params, ustates, grads, epoch)
+                return apply_updates(updaters, params, ustates, grads,
+                                     epoch, gspec=gspec)
 
             rep = self._sh()[0]
             psh, ush = self._param_sh()
@@ -1303,7 +1420,15 @@ class NetTrainer:
         cur = fetch_array(self.params[key][tag])
         new = self._from_2d(np.asarray(weight, np.float32), cur.shape,
                             self.graph.layers[i].type_name, tag)
-        self.params[key][tag] = jnp.asarray(new)
+        plan = self.mesh_plan
+        if plan is not None and plan.n_devices > 1:
+            # keep the leaf on its SPMD placement (a hand-set weight
+            # must not silently break the sharded-state invariant)
+            spec = (plan.fsdp_sharding if self.zero >= 3
+                    else plan.param_sharding)(new.shape)
+            self.params[key][tag] = jax.device_put(new, spec)
+        else:
+            self.params[key][tag] = jnp.asarray(new)
 
     @staticmethod
     def _to_2d(w: np.ndarray, type_name: str, tag: str) -> np.ndarray:
@@ -1404,6 +1529,19 @@ class NetTrainer:
         """Fingerprint of the current net structure (manifest field)."""
         return ckpt.net_fingerprint(self.graph.structure_to_json())
 
+    def mesh_manifest(self) -> Optional[dict]:
+        """The SPMD layout that writes checkpoints (manifest ``mesh``
+        field) — informational, since the payload is always gathered
+        full arrays and load re-shards onto the current mesh."""
+        if self.mesh_plan is None:
+            return None
+        return {
+            "n_data": self.mesh_plan.n_data,
+            "n_model": self.mesh_plan.n_model,
+            "zero": self.zero,
+            "processes": jax.process_count(),
+        }
+
     def save_model(self, path: str, round_: Optional[int] = None,
                    manifest: bool = True) -> None:
         """Atomic checkpoint write (temp + fsync + rename) plus a sidecar
@@ -1416,6 +1554,7 @@ class NetTrainer:
                 round_=self.round if round_ is None else round_,
                 net_fp=self.net_fp(),
                 save_ustate=self.save_ustate,
+                mesh=self.mesh_manifest(),
             )
         else:
             ckpt.atomic_write_bytes(path, blob)
@@ -1472,6 +1611,9 @@ class NetTrainer:
                     self.ustates[key][tag] = {
                         sl: jnp.asarray(w) for sl, w in slots.items()
                     }
+        # checkpoints hold GATHERED (full) arrays — re-shard onto the
+        # CURRENT mesh, whatever mesh (or process count) wrote them
+        self._place_state()
 
     def copy_model_from(self, path: str) -> None:
         """Finetune: fresh init, then copy name-matched layers' weights
@@ -1499,3 +1641,4 @@ class NetTrainer:
                     for tag in dst:
                         dst[tag] = jnp.asarray(src[tag])
         self.epoch_counter = 0
+        self._place_state()  # copied leaves land on the mesh shardings

@@ -55,6 +55,7 @@ __all__ = [
     "install_compile_listener",
     "register_memory_collector",
     "maybe_sample_step",
+    "set_train_state_bytes",
     "summary",
     "device_metrics",
     "reset",
@@ -449,6 +450,39 @@ def instrument(fn: Callable, kind: str,
     install_compile_listener()
     register_memory_collector()
     return InstrumentedJit(fn, kind, data_arg=data_arg)
+
+
+# ----------------------------------------------------------------------
+# train-state residency (the ZeRO memory win, made scrapeable)
+def set_train_state_bytes(per_device, total: float) -> None:
+    """Publish the trainer's state-residency gauges.
+
+    ``train_state_shard_bytes{device}`` — bytes of params + updater
+    state addressable on each local device after placement (the
+    ``xla_device_memory_bytes``-adjacent number CPU backends cannot
+    report from ``memory_stats()``); ``train_state_total_bytes`` — what
+    ONE full replica costs.  On an N-way ZeRO mesh the per-device gauge
+    sits at ~total/N; per-device == total is the replicated baseline.
+    Called by ``NetTrainer`` whenever state is (re)placed — init, load,
+    copy — so a resume onto a different mesh re-reports immediately.
+    """
+    try:
+        reg = obs_registry()
+        g = reg.gauge(
+            "train_state_shard_bytes",
+            "Params + updater-state bytes resident per device "
+            "(~1/N of the replicated total on a ZeRO mesh).",
+            labelnames=("device",),
+        )
+        for dev, nbytes in sorted(per_device.items()):
+            g.labels(device=dev).set(float(nbytes))
+        reg.gauge(
+            "train_state_total_bytes",
+            "Bytes one full (replicated) copy of params + updater "
+            "state costs — the ZeRO memory-win denominator.",
+        ).set(float(total))
+    except Exception:  # noqa: BLE001 - telemetry must never raise
+        pass
 
 
 # ----------------------------------------------------------------------

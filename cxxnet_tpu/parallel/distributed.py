@@ -76,11 +76,31 @@ def maybe_init_distributed(cfg: Sequence[ConfigEntry]) -> bool:
     if _initialized:
         return True
     coord, num, pid = spec
+    _enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coord, num_processes=num, process_id=pid
     )
     _initialized = True
     return True
+
+
+def _enable_cpu_collectives() -> None:
+    """Arm cross-process CPU collectives (gloo) BEFORE the backend exists.
+
+    The CPU PJRT client is built per-process with a collectives
+    implementation baked in; the default (``none``) rejects any SPMD
+    program whose mesh spans processes ("Multiprocess computations
+    aren't implemented on the CPU backend") — which is exactly the shape
+    of a multi-host mesh trainer rehearsed on CPU (a 2-process x
+    2-device 2x2 data x model mesh).  Selecting the gloo TCP
+    implementation here makes the CPU backend a faithful miniature of
+    the TPU pod: one jit program, partitions on every process, XLA
+    collectives across them.  No-op when the jax build lacks the flag or
+    another platform is primary (TPU/GPU ignore it)."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 - older jax: flag absent; keep going
+        pass
 
 
 def process_info() -> Tuple[int, int]:

@@ -138,6 +138,15 @@ class MeshPlan:
         """
         return self.state_sharding(shape)
 
+    def describe(self, zero: int = 0) -> str:
+        """One-line layout summary shared by the CLI's train-start line
+        and ``task=summary`` (one formatter, so logs and dashboards
+        never disagree about the mesh shape)."""
+        import jax
+
+        return (f"data={self.n_data} model={self.n_model} zero={zero} "
+                f"processes={jax.process_count()}")
+
     def check_batch(self, batch_size: int) -> None:
         if batch_size % self.n_data != 0:
             raise ValueError(

@@ -7,6 +7,19 @@ over the parameter pytree.  This replaces the reference's per-tensor
 the AsyncUpdater push/pull engine — on TPU the gradients arrive already
 all-reduced by the compiler, so the update is just math.
 
+Shard-local contract (the ZeRO weight-update sharding, ROADMAP item 1):
+under ``shard_weight_update``/``zero`` the trainer hands ``apply`` a
+weight, gradient and state that live SHARDED over the mesh's data axis
+— each replica holds (and updates) only its 1/N slice.  Every rule here
+is elementwise in (w, g, state), so the math partitions with zero
+communication; the lr/momentum schedules are scalars of the traced
+``epoch``.  The two exceptions are LARS/LAMB, whose trust ratios need
+the layer-global ``||w||``/``||g||`` — those ``jnp.sum`` reductions
+become one tiny all-reduce per tensor under GSPMD, inserted by the
+partitioner (correct by construction, and still ~1/N memory).  Keep new
+updaters elementwise-plus-full-tensor-reductions and sharding keeps
+working without edits here.
+
 Update rules (exact parity, including quirks):
 * sgd (``sgd_updater-inl.hpp:72-84``): ``m = mom*m - lr*(clip(g) + wd*w);
   w += m`` where ``clip`` also zeroes NaNs, applied only when

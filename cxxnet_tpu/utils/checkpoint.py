@@ -154,12 +154,20 @@ def write_manifest(
     net_fp: Optional[str] = None,
     save_ustate: int = 0,
     blob: Optional[bytes] = None,
+    mesh: Optional[dict] = None,
 ) -> dict:
     """Write the sidecar manifest for an already-written checkpoint.
 
     ``blob`` (the exact bytes written) avoids re-reading the file; the
     manifest itself is written atomically, AFTER the checkpoint, so a
-    manifest's existence implies its checkpoint was fully durable."""
+    manifest's existence implies its checkpoint was fully durable.
+    ``mesh`` (``{"n_data", "n_model", "zero", "processes"}``) records
+    the SPMD layout that wrote the checkpoint — informational only: the
+    payload always holds GATHERED full arrays (rank-0 gather in
+    ``checkpoint_bytes``), and load re-shards onto whatever mesh the
+    loading process runs, so resume across device/process counts needs
+    no translation step.  The field lets tooling answer "what wrote
+    this" without loading it."""
     if blob is not None:
         crc, size = crc32_of(blob), len(blob)
     else:
@@ -173,6 +181,8 @@ def write_manifest(
         "save_ustate": int(save_ustate),
         "time": time.time(),
     }
+    if mesh is not None:
+        man["mesh"] = mesh
     atomic_write_bytes(
         manifest_path(model_path),
         (json.dumps(man, indent=1) + "\n").encode("utf-8"),
@@ -188,6 +198,7 @@ def write_checkpoint(
     save_ustate: int = 0,
     retry: bool = False,
     silent: bool = True,
+    mesh: Optional[dict] = None,
 ) -> None:
     """THE checkpoint write discipline — atomic payload write, then the
     sidecar manifest — shared by every writer (``NetTrainer.save_model``
@@ -200,7 +211,7 @@ def write_checkpoint(
 
     def _manifest():
         write_manifest(path, round_=round_, net_fp=net_fp,
-                       save_ustate=save_ustate, blob=blob)
+                       save_ustate=save_ustate, blob=blob, mesh=mesh)
 
     from ..obs import emit as obs_emit
     from ..obs import trace as obs_trace

@@ -184,13 +184,7 @@ def test_branch_embed_training_parity():
             )
 
 
-@pytest.mark.parametrize("mp", [
-    1,
-    pytest.param(2, marks=pytest.mark.xfail(
-        reason="seed-inherited: branch-embed training diverges from "
-               "1-device under model_parallel=2 (mp=1 passes); needs "
-               "the ROADMAP item 1 mesh-trainer refactor")),
-])
+@pytest.mark.parametrize("mp", [1, 2])
 def test_branch_embed_matches_single_under_mesh(mp):
     """Composes with DP (and DP x TP) sharding over the 8-device mesh,
     the same discipline as the wino/s2d SPMD parity tests."""

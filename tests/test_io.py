@@ -371,6 +371,27 @@ def test_shard_rows_equal_and_disjoint():
         shard_rows(3, 0, 4)
 
 
+def test_shard_rows_block_mode_reassembles_global_batches():
+    """dist_shard=block contract (the bitwise mesh-parity lane): rank
+    p's k-th local batch is exactly rows [k*B*w + p*B, ...+B) of the
+    global stream, so interleaving the shards batch-by-batch rebuilds
+    the single-process row order."""
+    from cxxnet_tpu.io.data import shard_rows
+
+    n, w, block = 70, 4, 8  # 2 full global batches of 32, tail dropped
+    shards = [shard_rows(n, k, w, block=block) for k in range(w)]
+    assert all(len(s) == 16 for s in shards)  # equal => equal steps
+    rebuilt = []
+    for k in range(2):  # global batch k = ranks' k-th blocks, in order
+        for s in shards:
+            rebuilt.extend(s[k * block:(k + 1) * block].tolist())
+    assert rebuilt == list(range(64))
+    flat = np.concatenate(shards)
+    assert len(set(flat.tolist())) == len(flat)  # still disjoint
+    with pytest.raises(ValueError):
+        shard_rows(31, 0, 4, block=8)  # not even one global batch
+
+
 def test_mnist_dist_shards_run_equal_batch_counts(tmp_path):
     from cxxnet_tpu.io.mnist import (MNISTIterator, write_idx_images,
                                      write_idx_labels)

@@ -166,9 +166,10 @@ def test_indivisible_batch_raises():
         tr.init_model()
 
 
-def _train_tp(ndev: int, model_parallel: int, steps: int = 5):
+def _train_tp(ndev: int, model_parallel: int, steps: int = 5, extra=()):
     cfg = [(k, v.format(n=ndev - 1) if k == "dev" else v) for k, v in MLP_CFG]
     cfg.append(("model_parallel", str(model_parallel)))
+    cfg.extend(extra)
     tr = NetTrainer()
     tr.set_params(cfg)
     tr.init_model()
@@ -186,6 +187,20 @@ def test_tensor_parallel_matches_single():
     ttp = _train_tp(8, 4)  # 2-way data x 4-way tensor parallel
     assert ttp.mesh_plan.n_model == 4 and ttp.mesh_plan.n_data == 2
     _assert_params_close(t1, ttp, "DP and DPxTP runs")
+
+
+def test_2x2_mesh_trains_end_to_end():
+    """THE 2x2 data x model mesh (ROADMAP item 1 acceptance): 4 devices
+    split (2, 2), sharded weight update on, a net trained end to end,
+    weights matching the 1-device run."""
+    t1 = _train(1)
+    t22 = _train_tp(4, 2, extra=(("shard_weight_update", "1"),))
+    assert t22.mesh_plan.n_data == 2 and t22.mesh_plan.n_model == 2
+    # TP placement holds AND the updater state took the data-axis
+    # sharding on top of it (ZeRO-1 over the 2x2 mesh)
+    m = t22.ustates["l0_fc1"]["wmat"]["m"]  # (32, 10)
+    assert set(m.sharding.spec) >= {"model", "data"}
+    _assert_params_close(t1, t22, "1-device and 2x2-mesh runs")
 
 
 def test_tensor_parallel_weights_are_sharded():
@@ -378,13 +393,7 @@ momentum = 0.9
 """
 
 
-@pytest.mark.parametrize("mp", [
-    1,
-    pytest.param(2, marks=pytest.mark.xfail(
-        reason="seed-inherited: fused sibling-1x1 training diverges "
-               "from unfused under model_parallel=2 (mp=1 passes); "
-               "needs the ROADMAP item 1 mesh-trainer refactor")),
-])
+@pytest.mark.parametrize("mp", [1, 2])
 def test_fuse_1x1_matches_under_mesh(mp):
     """The concatenated sibling conv composes with DP (and DP x TP)
     sharding: fused training over the 8-device mesh equals unfused."""

@@ -1,0 +1,284 @@
+"""MESH=1 lane: 4-process CPU-mesh bitwise parity + compile-count guard.
+
+The pod-scale SPMD claim (ROADMAP item 1), proven end to end through the
+real CLI on the MNIST MLP conf:
+
+* **bitwise parity** — a 4-process ``jax.distributed`` job over a
+  4-device CPU mesh trains the same conf as a single-process run of the
+  SAME mesh (4 virtual devices), same seed, same rounds, iterators
+  sharding contiguously (``dist_shard = block``); every checkpoint the
+  two runs write must carry IDENTICAL manifest CRC32s.  One compiler-
+  partitioned program + one collectives implementation (gloo) means the
+  gradient reduction order — and therefore every weight bit — cannot
+  depend on the process layout;
+* **compile-count guard** — each process must compile the SAME number
+  of XLA programs as the single-process run compiles (no per-replica
+  re-jits: the mesh step is ONE program whatever the layout), counted
+  exactly by the ``jax.monitoring`` backend-compile listener
+  (``telemetry=1`` device summaries);
+* the verdict JSON appends to a ``perf_guard`` history
+  (``--bench mesh_parity``), so a future change that starts re-jitting
+  per replica or slows the mesh step trips the regression sentinel.
+
+Usage::
+
+    python tools/mesh_parity.py --out /tmp/_mesh        # the CI lane
+    python tools/perf_guard.py --bench mesh_parity \\
+        --input /tmp/_mesh/mesh_parity.json --history bench_history.jsonl
+
+Exit code: 0 when CRCs match bitwise and compile counts agree; 1
+otherwise (the lane is a hard gate, not weather).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NUM_ROUND = 2
+GLOBAL_BATCH = 32
+N_IMAGES = 128
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def make_data(out_dir: str) -> None:
+    import numpy as np
+
+    from cxxnet_tpu.io.mnist import write_idx_images, write_idx_labels
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (N_IMAGES, 4, 4)).astype(np.uint8)
+    labels = (imgs.reshape(N_IMAGES, -1).mean(1) > 127).astype(np.uint8)
+    write_idx_images(os.path.join(out_dir, "img.idx"), imgs)
+    write_idx_labels(os.path.join(out_dir, "lab.idx"), labels)
+
+
+def make_conf(out_dir: str) -> str:
+    """The MNIST MLP conf both runs share; per-run keys ride as CLI
+    overrides.  ``dist_shard = block``: each rank's local batch is its
+    contiguous slice of the global batch — the row order the SPMD
+    global array assembles, and the bitwise-parity precondition."""
+    conf = os.path.join(out_dir, "mesh.conf")
+    with open(conf, "w", encoding="utf-8") as f:
+        f.write(f"""
+data = train
+iter = mnist
+  path_img = "{out_dir}/img.idx"
+  path_label = "{out_dir}/lab.idx"
+  shuffle = 1
+  dist_shard = block
+iter = end
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.1
+layer[fc1->out] = fullc:fc2
+  nhidden = 2
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = {GLOBAL_BATCH}
+dev = cpu
+num_round = {NUM_ROUND}
+eval_train = 0
+eta = 0.1
+momentum = 0.9
+seed = 7
+shard_weight_update = 1
+metric = error
+silent = 1
+telemetry = 1
+""")
+    return conf
+
+
+def run_job(conf: str, workdir: str, nproc: int, port: int,
+            timeout: float) -> None:
+    """Launch one parity side: ``nproc`` CLI processes (1 device each),
+    or one process holding the whole 4-device mesh.  BOTH initialize
+    jax.distributed (the 1-process run with num_processes=1) so the
+    collectives implementation — and the all-reduce order — match."""
+    ndev = 4 // nproc
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={ndev}",
+    }
+    procs, dirs = [], []
+    for r in range(nproc):
+        d = os.path.join(workdir, f"p{r}")
+        os.makedirs(d, exist_ok=True)
+        dirs.append(d)
+        over = [f"dist_coordinator=localhost:{port}",
+                f"dist_num_proc={nproc}", f"dist_proc_id={r}"]
+        if nproc == 1:
+            over.append("dev=cpu:0-3")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "cxxnet_tpu", conf] + over,
+            env=env, cwd=d,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        ))
+    try:
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:
+        for p in procs:  # bound the damage when a rank hangs
+            if p.poll() is None:
+                p.kill()
+    for p, o in zip(procs, outs):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"mesh_parity: rank process failed "
+                f"(rc={p.returncode}):\n{o.decode()[-4000:]}")
+
+
+def read_crcs(rank_dir: str) -> dict:
+    """{round: manifest crc32} for every checkpoint a run wrote."""
+    from cxxnet_tpu.utils import checkpoint as ckpt
+
+    out = {}
+    mdir = os.path.join(rank_dir, "models")
+    for round_, path in ckpt.list_checkpoints(mdir):
+        man = ckpt.read_manifest(path)
+        if man is not None:
+            out[round_] = man["crc32"]
+    return out
+
+
+def read_device_summary(rank_dir: str) -> dict:
+    """Final telemetry record's device block (compiles / programs);
+    ``{}`` when the run wrote no telemetry — the caller treats missing
+    counts as a FAILURE (a gate that cannot read its signal must not
+    pass vacuously)."""
+    path = os.path.join(rank_dir, "telemetry.jsonl")
+    last = None
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    last = json.loads(line)
+    except (OSError, ValueError):
+        return {}
+    return (last or {}).get("device") or {}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="/tmp/_mesh_parity",
+                    help="scratch + verdict directory")
+    ap.add_argument("--timeout", type=float, default=240.0,
+                    help="per-side wall-clock budget (seconds)")
+    ap.add_argument("--json", dest="json_path", default="",
+                    help="verdict path (default <out>/mesh_parity.json)")
+    args = ap.parse_args()
+
+    os.makedirs(args.out, exist_ok=True)
+    make_data(args.out)
+    conf = make_conf(args.out)
+
+    t0 = time.time()
+    multi_dir = os.path.join(args.out, "multi")
+    run_job(conf, multi_dir, nproc=4, port=_free_port(),
+            timeout=args.timeout)
+    multi_s = time.time() - t0
+    t1 = time.time()
+    single_dir = os.path.join(args.out, "single")
+    run_job(conf, single_dir, nproc=1, port=_free_port(),
+            timeout=args.timeout)
+    single_s = time.time() - t1
+
+    problems = []
+    multi_crcs = [read_crcs(os.path.join(multi_dir, f"p{r}"))
+                  for r in range(4)]
+    single_crcs = read_crcs(os.path.join(single_dir, "p0"))
+    if not single_crcs or len(single_crcs) != NUM_ROUND + 1:
+        problems.append(
+            f"single run wrote {sorted(single_crcs)} rounds, expected "
+            f"{NUM_ROUND + 1} checkpoints")
+    for r in range(1, 4):
+        # rank-0-writes discipline: the peers run in their own working
+        # dirs and must have written NO checkpoints of their own
+        if multi_crcs[r]:
+            problems.append(
+                f"multi rank {r} wrote its own checkpoints "
+                f"{sorted(multi_crcs[r])} — violates the rank-0-writes "
+                "discipline")
+    if multi_crcs[0] != single_crcs:
+        problems.append(
+            f"BITWISE PARITY FAILED: 4-process CRCs {multi_crcs[0]} != "
+            f"single-process CRCs {single_crcs}")
+
+    multi_dev = [read_device_summary(os.path.join(multi_dir, f"p{r}"))
+                 for r in range(4)]
+    single_dev = read_device_summary(os.path.join(single_dir, "p0"))
+    compiles = [d.get("compiles") for d in multi_dev]
+    programs = [d.get("programs") for d in multi_dev]
+    # missing counts FAIL the gate — all-None would otherwise satisfy
+    # both equality checks and let the guard pass vacuously
+    if any(c is None for c in compiles) or single_dev.get(
+            "compiles") is None:
+        problems.append(
+            f"compile counts unreadable (multi {compiles}, single "
+            f"{single_dev.get('compiles')}) — telemetry device block "
+            "missing; the compile-count gate cannot run")
+    elif len(set(compiles)) != 1:
+        problems.append(f"per-rank compile counts differ: {compiles} — "
+                        "a rank re-jitted (not one program)")
+    if any(p is None for p in programs) or single_dev.get(
+            "programs") is None:
+        problems.append(
+            f"program counts unreadable (multi {programs}, single "
+            f"{single_dev.get('programs')}) — telemetry device block "
+            "missing; the one-program gate cannot run")
+    elif len(set(programs)) != 1 or programs[0] != single_dev.get(
+            "programs"):
+        problems.append(
+            f"instrumented train programs differ across layouts: "
+            f"multi {programs} vs single {single_dev.get('programs')}")
+
+    doc = {
+        "bench": "mesh_parity",
+        "ts": time.time(),
+        "rounds": NUM_ROUND,
+        "global_batch": GLOBAL_BATCH,
+        "crc_equal": multi_crcs[0] == single_crcs,
+        "crcs": {str(k): f"{v:#010x}" for k, v in sorted(
+            single_crcs.items())},
+        "multi": {"wall_sec": round(multi_s, 3),
+                  "compiles": compiles[0],
+                  "programs": programs[0]},
+        "single": {"wall_sec": round(single_s, 3),
+                   "compiles": single_dev.get("compiles"),
+                   "programs": single_dev.get("programs")},
+        "problems": problems,
+        "verdict": "ok" if not problems else "fail",
+    }
+    json_path = args.json_path or os.path.join(args.out,
+                                               "mesh_parity.json")
+    with open(json_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc, indent=1))
+    for p in problems:
+        print(f"FAIL {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

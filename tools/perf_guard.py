@@ -49,8 +49,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 VERDICTS = ("baseline", "ok", "regression")
 
-#: substrings marking a metric as lower-is-better (latencies)
-_LOWER_MARKERS = ("latency", "_ms", "p50", "p95", "p99", "wall_sec")
+#: substrings marking a metric as lower-is-better (latencies, and the
+#: mesh lane's compile counts — MORE compiles is the re-jit regression)
+_LOWER_MARKERS = ("latency", "_ms", "p50", "p95", "p99", "wall_sec",
+                  "compiles", "programs")
 
 
 def lower_is_better(name: str) -> bool:
@@ -101,8 +103,25 @@ def flatten_serve_bench(doc: dict) -> Dict[str, float]:
     return out
 
 
+def flatten_mesh_parity(doc: dict) -> Dict[str, float]:
+    """Wall time + compile/program counts from a ``tools/mesh_parity.py``
+    verdict — the one-program claim as a banded series: a change that
+    starts re-jitting per replica moves ``multi.compiles`` (orientation:
+    lower is better) far outside the noise band, and the sentinel flags
+    it even if the lane's exact-count assertions were ever loosened."""
+    out: Dict[str, float] = {}
+    for side in ("multi", "single"):
+        d = doc.get(side, {})
+        for key in ("wall_sec", "compiles", "programs"):
+            v = d.get(key)
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                out[f"{side}.{key}"] = float(v)
+    return out
+
+
 FLATTENERS = {"io_bench": flatten_io_bench,
-              "serve_bench": flatten_serve_bench}
+              "serve_bench": flatten_serve_bench,
+              "mesh_parity": flatten_mesh_parity}
 
 
 # ----------------------------------------------------------------------

@@ -27,6 +27,18 @@
 #                                     # schema-validated by the tools);
 #                                     # both reports append to a
 #                                     # perf_guard history
+#        MESH=1 tools/run_tier1.sh    # also run the SPMD mesh parity
+#                                     # lane: a 4-process CPU-mesh CLI
+#                                     # train must produce checkpoint
+#                                     # CRCs BITWISE equal to the
+#                                     # single-process run of the same
+#                                     # 4-device mesh (MNIST MLP conf,
+#                                     # dist_shard=block, gloo
+#                                     # collectives), with per-rank
+#                                     # compile counts proving the step
+#                                     # is ONE program (no per-replica
+#                                     # re-jits); verdict JSON appends
+#                                     # to a perf_guard history
 #        OBS=1 tools/run_tier1.sh     # also run the observability smoke:
 #                                     # short telemetry=1 train + serve
 #                                     # scrape of /metricsz + /alertz
@@ -78,6 +90,20 @@ if [ "${TUNE:-0}" = "1" ]; then
       --input "$tune_out/serve_autotune.json" \
       --history "$tune_out/bench_history.jsonl" > /dev/null || rc=1
   echo "TUNE lane verdicts: $tune_out/{io,serve}_autotune.json"
+fi
+if [ "${MESH:-0}" = "1" ]; then
+  echo "=== opt-in SPMD mesh parity lane (MESH=1) ==="
+  mesh_out=/tmp/_mesh_parity
+  rm -rf "$mesh_out"; mkdir -p "$mesh_out"
+  # outer budget > 2x the tool's per-side --timeout (240 s each) plus
+  # setup slack, so a slow-but-in-budget run is never killed mid-flight
+  timeout -k 10 560 env JAX_PLATFORMS=cpu \
+    python tools/mesh_parity.py --out "$mesh_out" > /dev/null || rc=1
+  timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python tools/perf_guard.py --bench mesh_parity \
+      --input "$mesh_out/mesh_parity.json" \
+      --history "$mesh_out/bench_history.jsonl" > /dev/null || rc=1
+  echo "MESH lane verdict: $mesh_out/mesh_parity.json"
 fi
 if [ "${OBS:-0}" = "1" ]; then
   echo "=== opt-in observability smoke (OBS=1) ==="
