@@ -93,6 +93,13 @@ class LearnTask:
         self.capture_predict = 0  # log /predict inputs+predictions too
         self.feedback_page_bytes = 1 << 20
         self.feedback_rotate_bytes = 8 << 20
+        # quantized inference (task=export_quant / quant= at serve
+        # time; doc/performance.md "Quantized inference")
+        self.quant = "int8"  # export scheme (serve reads the raw key)
+        self.quant_min_agreement = 0.99
+        self.quant_calib_batches = 0  # 0 = the whole eval set
+        self.quant_out = ""  # artifact path override
+        self.quant_report = ""  # also write the verdict JSON here
         self.cfg: List[tuple] = []
 
     # ------------------------------------------------------------------
@@ -205,6 +212,16 @@ class LearnTask:
             self.feedback_page_bytes = int(val)
         elif name == "feedback_rotate_bytes":
             self.feedback_rotate_bytes = int(val)
+        elif name == "quant":
+            self.quant = "" if val in ("0", "off", "none") else val
+        elif name == "quant_min_agreement":
+            self.quant_min_agreement = float(val)
+        elif name == "quant_calib_batches":
+            self.quant_calib_batches = int(val)
+        elif name == "quant_out":
+            self.quant_out = val
+        elif name == "quant_report":
+            self.quant_report = val
         self.cfg.append((name, val))
 
     # ------------------------------------------------------------------
@@ -239,11 +256,13 @@ class LearnTask:
         compile_cache.configure(self.cfg, silent=bool(self.silent))
         if self.task not in ("train", "finetune", "pred", "pred_raw",
                              "extract", "generate", "summary", "serve",
-                             "serve_train"):
+                             "serve_train", "export_quant"):
             raise ValueError(f"unknown task {self.task!r}")
         self.init()
         if not self.silent:
             print("initializing end, start working")
+        if self.task == "export_quant":
+            return self.task_export_quant()
         if self.task in ("train", "finetune"):
             self.task_train()
         elif self.task in ("pred", "pred_raw"):
@@ -272,6 +291,20 @@ class LearnTask:
         if self.task == "serve":
             # the serving engine owns model discovery/validation and
             # needs no data iterators — see task_serve
+            return
+        if self.task == "export_quant":
+            # the exporter loads its own trainers (f32 reference +
+            # candidate); the driver only supplies the held-out eval
+            # iterator the agreement gate scores on
+            if self.name_model_in == "NULL":
+                raise ValueError(
+                    "task=export_quant needs model_in (the trained f32 "
+                    "checkpoint to quantize)")
+            from .parallel.distributed import process_info
+
+            if process_info()[1] > 1:
+                raise ValueError("task=export_quant is single-process")
+            self._create_iterators()
             return
         if self.task == "serve_train":
             # the engine owns the model; the continuous loop needs the
@@ -477,7 +510,8 @@ class LearnTask:
         for sec in split.sections:
             if sec.kind == "data" and self.task not in ("pred", "pred_raw",
                                                         "generate",
-                                                        "summary"):
+                                                        "summary",
+                                                        "export_quant"):
                 if self.itr_train is not None:
                     raise ValueError("can only have one data section")
                 self.itr_train = create_iterator(sec.entries)
@@ -1239,6 +1273,13 @@ class LearnTask:
             raise ValueError(
                 "task=serve_train needs an eval section — the publish "
                 "gate scores candidates on held-out data")
+        if any(n == "quant" and v not in ("", "0", "off", "none")
+               for n, v in self.cfg):
+            raise ValueError(
+                "task=serve_train cannot serve a quantized model: the "
+                "fine-tune loop trains on the served weights, and "
+                "quantized trainers are inference-only — serve the f32 "
+                "checkpoints and run task=export_quant offline")
         engine = Engine(
             cfg=self.cfg,
             model_dir=self.name_model_dir,
@@ -1323,6 +1364,39 @@ class LearnTask:
             engine.close()
             feedback.close()
         print("serve_train: shutdown complete", flush=True)
+
+    def task_export_quant(self) -> int:
+        """``task=export_quant``: post-training quantized export with
+        the accuracy gate (doc/performance.md "Quantized inference").
+
+        Quantizes ``model_in`` per ``quant`` (default int8), gates it
+        on top-1 agreement with the f32 model over the conf's eval
+        section (``quant_min_agreement`` / ``quant_calib_batches``),
+        falling individual layers back to bf16 until the gate passes,
+        and writes ``<round>.quant.model`` + manifest beside the
+        source.  Prints one JSON verdict line; exit 0 on publish, 3 on
+        reject (nothing written — the f32 artifact keeps serving)."""
+        import json
+
+        from .nnet import quant as nquant
+
+        eval_iter = self.itr_evals[0] if self.itr_evals else None
+        verdict = nquant.export_quantized(
+            self.cfg,
+            self.name_model_in,
+            eval_iter=eval_iter,
+            scheme=self.quant or "int8",
+            min_agreement=self.quant_min_agreement,
+            calib_batches=self.quant_calib_batches,
+            out_path=self.quant_out or None,
+            silent=bool(self.silent),
+        )
+        line = json.dumps(verdict, separators=(",", ":"))
+        print(line, flush=True)
+        if self.quant_report:
+            with open(self.quant_report, "w", encoding="utf-8") as f:
+                f.write(line + "\n")
+        return 0 if verdict["ok"] else 3
 
     def task_summary(self) -> None:
         """``task=summary``: per-layer table — type, name, output node

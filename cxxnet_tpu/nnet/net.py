@@ -38,6 +38,14 @@ from .graph import NetGraph
 ConfigEntry = Tuple[str, str]
 
 
+def _opsq():
+    """Lazy ``ops.quant`` accessor (keeps the quant helpers out of the
+    hot import path for nets that never quantize)."""
+    from ..ops import quant
+
+    return quant
+
+
 class FunctionalNet:
     """Executable form of a NetGraph."""
 
@@ -52,12 +60,25 @@ class FunctionalNet:
         # b128 on the v5e chip; `fuse_1x1 = 0` opts out
         self.fuse_1x1 = 1
         self._fuse_cache = None
-        # branch-embedding fusion is OPT-IN (doc/performance.md "Conv
+        # branch-embedding fusion (doc/performance.md "Conv
         # efficiency"): merge sibling odd-k stride-1 SAME convs (the
         # inception 3x3/5x5 branches) into ONE block-kernel conv — an
-        # adequately-shaped GEMM for ~3.6x more MACs.  Exact; promoted
-        # only on a measured win (tools/googlenet_bisect.py bembed)
-        self.conv_branch_embed = 0
+        # adequately-shaped GEMM for ~3.6x more MACs.  Exact (119->92
+        # contractions on GoogLeNet).  Default -1 = AUTO: ON for
+        # inference program builds (predict/extract/eval — the serve
+        # engine's programs) on ACCELERATOR backends, where the trade
+        # buys MXU shape; OFF on CPU, where the extra MACs are just
+        # extra work (measured 0.14x predict throughput —
+        # tools/wino_bf16_ab.py --bembed-only), and OFF for the train
+        # step, whose on-chip A/B is still queued
+        # (tools/googlenet_bisect.py bembed).  An explicit 0/1 pins
+        # every build.
+        self.conv_branch_embed = -1
+        # the platform this net's programs actually TARGET (the dev=
+        # mesh's platform, bound by the trainer after it builds the
+        # mesh) — auto branch-embed keys on it, NOT on the process's
+        # default backend: dev=cpu on a TPU host must stay unfused
+        self.exec_backend: Optional[str] = None
         self._embed_cache = None
         # instantiate layers (shared layers alias the primary instance)
         self.layer_objs: List[Layer] = []
@@ -323,7 +344,9 @@ class FunctionalNet:
         ``test_fuse_1x1_matches_under_mesh``)."""
         from jax import lax
 
-        ws = [d["wmat"].astype(x.dtype) for d in gparams]
+        from ..ops import quant as opsq
+
+        ws = [opsq.effective_wmat(d, x.dtype) for d in gparams]
         cin = ws[0].shape[2]
         nout = sum(w.shape[3] for w in ws)
         wk = jnp.zeros((1, 1, cin, nout), x.dtype)
@@ -515,7 +538,9 @@ class FunctionalNet:
                 "branch-embed members must share input spatial dims: "
                 f"{[tuple(xi.shape) for xi in xs]}"
             )
-        ws = [d["wmat"].astype(xs[0].dtype) for d in gparams]
+        from ..ops import quant as opsq
+
+        ws = [opsq.effective_wmat(d, xs[0].dtype) for d in gparams]
         kmax = max(w.shape[0] for w in ws)
         pad = (kmax - 1) // 2
         x = jnp.concatenate(xs, axis=3)
@@ -596,7 +621,7 @@ class FunctionalNet:
             self._sibling_1x1_groups() if self.fuse_1x1 else ({}, {})
         )
         embed_items, embed_groups = (
-            self._branch_embed_plan() if self.conv_branch_embed
+            self._branch_embed_plan() if self.use_branch_embed(train)
             else (None, {})
         )
         items = (embed_items if embed_items is not None
@@ -660,6 +685,13 @@ class FunctionalNet:
             else:
                 key = self.param_key[i]
                 lparams = params.get(key, {})
+                if _opsq().is_quantized(lparams):
+                    # int8 entry: dequant-free apply (ops/quant.py) —
+                    # conv/fullc only, by the exporter's construction
+                    nodes[spec.nindex_out[0]] = self._apply_quant_layer(
+                        lay, lparams, inputs
+                    )
+                    continue
                 # shared stateful layers chain their state: a later
                 # occurrence reads the state the earlier one produced
                 if new_aux is not None:
@@ -706,6 +738,51 @@ class FunctionalNet:
             return nodes, total_loss, (new_aux if new_aux is not None else {})
         return nodes, total_loss
 
+    def use_branch_embed(self, train: bool,
+                         backend: Optional[str] = None) -> bool:
+        """Whether THIS program build fuses inception branches: the
+        explicit conf value when set, else auto — on for inference
+        builds (exact, fewer contractions) on accelerator backends,
+        off on CPU (the block kernel's ~3.6x MACs only pay on the
+        MXU; measured 0.14x CPU predict throughput), and off for the
+        train step until its on-chip A/B lands (doc/performance.md).
+        ``backend`` overrides the backend probe (tests)."""
+        if self.conv_branch_embed >= 0:
+            return bool(self.conv_branch_embed)
+        if train:
+            return False
+        if backend is None:
+            backend = self.exec_backend
+        if backend is None:
+            try:
+                backend = jax.default_backend()
+            except Exception:  # noqa: BLE001 - no backend: stay plain
+                return False
+        return backend != "cpu"
+
+    def _apply_quant_layer(self, lay, lparams, inputs):
+        """Dispatch one int8-quantized layer (doc/performance.md
+        "Quantized inference"): the compiled op consumes the RAW codes
+        (the weight at rest stays int8) and the per-channel rescale is
+        folded into the bias add.  The exporter only quantizes plain
+        conv / fullc layers, so anything else here is a plan bug."""
+        from ..layers.conv import ConvolutionLayer
+        from ..layers.linear import FullConnectLayer
+
+        q = _opsq()
+        x = inputs[0]
+        if type(lay) is ConvolutionLayer:
+            p = lay.param
+            return q.conv_apply_q(lparams, x, p.stride, p.pad_y, p.pad_x,
+                                  groups=p.num_group)
+        if type(lay) is FullConnectLayer:
+            return q.fc_apply_q(lparams, x)
+        raise ValueError(
+            f"quantized params on unsupported layer "
+            f"{type(lay).__name__} — the export plan only covers "
+            "conv and fullc"
+        )
+
     def _node0_wants_ints(self) -> bool:
         """True when any consumer of the data node (node 0) declares
         ``integer_input`` (the embedding layer) — keyed to the graph,
@@ -731,6 +808,11 @@ class FunctionalNet:
 
         def cast(key, tags):
             if key in self._f32_param_keys:
+                return tags
+            if _opsq().QKEY in tags:
+                # int8 entry: codes stay int8 (casting them would undo
+                # the 4x), scales/bias stay f32 (the rescale fold runs
+                # in the f32 accumulate)
                 return tags
             keep = self._f32_tag_map.get(key, ())
             return {

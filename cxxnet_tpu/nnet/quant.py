@@ -1,0 +1,339 @@
+"""Post-training quantized export: plan, accuracy gate, artifact.
+
+The serve-side half of ROADMAP item 3 (doc/performance.md "Quantized
+inference"): a trained f32 checkpoint becomes an int8-weight serving
+artifact in one gated step —
+
+1. **plan** — every plain-path conv / fullc kernel is assigned ``int8``
+   (per-output-channel symmetric scales, ``ops/quant.py``); convs on an
+   opt-in algorithmic path (Winograd, space-to-depth) start at ``bf16``
+   so the quantizer never silently overrides a measured kernel choice;
+2. **gate** — the quantized model must agree with the f32 model on
+   held-out data: top-1 agreement >= ``quant_min_agreement`` (default
+   0.99) over ``quant_calib_batches`` eval batches (0 = the whole eval
+   set).  While the gate fails, the int8 layer with the worst relative
+   quantization error falls back to bf16 (2x instead of 4x) and the
+   agreement is re-measured — the eval-gate ethos of the continuous
+   loop's publisher applied to precision instead of fine-tuning;
+3. **artifact** — on pass, the quantized model is written as
+   ``<round>.quant.model`` beside its source through the same atomic
+   write + CRC-manifest machinery as every checkpoint, with a ``quant``
+   manifest field recording scheme / scales dtype / per-precision layer
+   counts / measured agreement.  On reject NOTHING is written — the f32
+   artifact keeps serving.
+
+The artifact stores the int8 codes + f32 scales (and bf16 kernels as
+tagged uint16 words — npz cannot represent ml_dtypes natively) in the
+normal checkpoint container; ``NetTrainer.load_model`` recognizes the
+header's ``quant`` block and serves it directly.  ``quant = int8`` at
+serve time on a PLAIN checkpoint quantizes on load instead — ungated
+(no eval data in the serving process), event-logged as such; use
+``task=export_quant`` when the gate matters.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import events as obs_events
+from ..ops import quant as opsq
+from ..utils import checkpoint as ckpt
+
+__all__ = [
+    "SCHEMES", "build_plan", "apply_plan", "top1_agreement",
+    "export_quantized", "quant_artifact_path",
+]
+
+SCHEMES = ("int8", "bf16")
+
+
+def quant_artifact_path(model_path: str) -> str:
+    """``NNNN.model`` -> ``NNNN.quant.model`` (the sibling artifact the
+    serving engine prefers under ``quant = int8``).  The ``.quant.``
+    infix keeps it invisible to the plain ``*.model`` round discovery —
+    an engine without the key can never accidentally serve codes."""
+    if model_path.endswith(".quant.model"):
+        return model_path
+    if model_path.endswith(".model"):
+        return model_path[:-len(".model")] + ".quant.model"
+    return model_path + ".quant.model"
+
+
+# ----------------------------------------------------------------------
+# plan
+def _layer_kinds(net) -> Dict[str, Tuple[str, object]]:
+    """``param_key -> ("conv"|"fullc", layer)`` for quantizable layers:
+    exactly the types the quantized forward dispatch handles."""
+    from ..layers.conv import ConvolutionLayer
+    from ..layers.linear import FullConnectLayer
+
+    out: Dict[str, Tuple[str, object]] = {}
+    for i, spec in enumerate(net.graph.layers):
+        if spec.type_name == "shared":
+            continue
+        lay = net.layer_objs[i]
+        key = net.param_key[i]
+        if type(lay) is ConvolutionLayer:
+            out[key] = ("conv", lay)
+        elif type(lay) is FullConnectLayer:
+            out[key] = ("fullc", lay)
+    return out
+
+
+def build_plan(trainer, scheme: str = "int8") -> Dict[str, str]:
+    """``param_key -> "int8" | "bf16"`` for every quantizable layer of
+    ``trainer``'s net.  ``scheme = "bf16"`` assigns bf16 everywhere (the
+    2x straight-cast scheme, no scales, no gate sensitivity); ``int8``
+    starts everything at int8 except convs that opted into an
+    algorithmic rewrite path (``conv_wino`` / ``conv_s2d``) — the
+    quantized apply runs the direct conv, so quantizing those would
+    silently override a measured kernel choice."""
+    if scheme not in SCHEMES:
+        raise ValueError(f"quant scheme must be one of {SCHEMES}, "
+                         f"got {scheme!r}")
+    plan: Dict[str, str] = {}
+    for key, (kind, lay) in _layer_kinds(trainer.net).items():
+        if key not in (trainer.params or {}):
+            continue
+        if scheme == "bf16":
+            plan[key] = "bf16"
+        elif kind == "conv" and (lay.conv_wino or lay.conv_s2d):
+            plan[key] = "bf16"
+        else:
+            plan[key] = "int8"
+    return plan
+
+
+def _out_axis(kind: str) -> int:
+    return 3 if kind == "conv" else 0  # HWIO vs (nout, nin)
+
+
+def apply_plan(trainer, plan: Dict[str, str], scheme: str = "int8",
+               source_params=None) -> None:
+    """Replace ``trainer``'s eligible kernels per ``plan`` (int8 codes +
+    scales / bf16 cast), IN PLACE.  ``source_params`` (default: the
+    trainer's current params) supplies the f32 masters — pass the
+    reference trainer's params when re-applying a revised plan so codes
+    are always quantized from the original weights, never from a prior
+    quantization.  Marks the trainer inference-only
+    (``quant_scheme``) and drops its jit cache."""
+    kinds = _layer_kinds(trainer.net)
+    src = source_params if source_params is not None else trainer.params
+    newp = {}
+    for key, tags in src.items():
+        kind = plan.get(key)
+        if kind is None or key not in kinds:
+            newp[key] = dict(tags)
+            continue
+        entry = {t: v for t, v in tags.items() if t != "wmat"}
+        w = np.asarray(tags["wmat"], np.float32)
+        if kind == "int8":
+            q, s = opsq.quantize_weight(w, _out_axis(kinds[key][0]))
+            entry[opsq.QKEY] = jnp.asarray(q)
+            entry[opsq.SKEY] = jnp.asarray(s)
+        else:  # bf16 fallback
+            entry["wmat"] = jnp.asarray(w, jnp.bfloat16)
+        newp[key] = entry
+    trainer.params = newp
+    trainer.quant_scheme = scheme
+    trainer.quant_plan = dict(plan)
+    trainer._jit_cache.clear()
+
+
+# ----------------------------------------------------------------------
+# gate
+def top1_agreement(tr_ref, tr_cand, eval_iter,
+                   max_batches: int = 0) -> Tuple[float, int]:
+    """``(agreement, rows)``: fraction of held-out instances on which
+    the candidate's prediction (argmax / raw-scalar sign bucket — the
+    trainer's own ``predict`` semantics) equals the reference's, over
+    up to ``max_batches`` eval batches (0 = all)."""
+    agree = 0
+    total = 0
+    batches = 0
+    eval_iter.before_first()
+    while eval_iter.next():
+        batch = eval_iter.value()
+        n = batch.batch_size - batch.num_batch_padd
+        pr = np.asarray(tr_ref.predict(batch))[:n]
+        pc = np.asarray(tr_cand.predict(batch))[:n]
+        eq = pr.reshape(n, -1) == pc.reshape(n, -1)
+        agree += int(eq.all(axis=1).sum())
+        total += n
+        batches += 1
+        if max_batches and batches >= max_batches:
+            break
+    if total == 0:
+        raise ValueError(
+            "top1_agreement: the eval iterator yielded no rows — the "
+            "agreement gate needs held-out data")
+    return agree / total, total
+
+
+def _error_ranking(trainer, plan: Dict[str, str]) -> List[Tuple[float, str]]:
+    """Int8 layers by relative quantization error, worst first — the
+    fallback order when the gate fails."""
+    kinds = _layer_kinds(trainer.net)
+    rank = []
+    for key, kind in plan.items():
+        if kind != "int8":
+            continue
+        w = trainer.params[key]["wmat"]
+        rank.append((opsq.quant_error(w, _out_axis(kinds[key][0])), key))
+    return sorted(rank, reverse=True)
+
+
+# ----------------------------------------------------------------------
+# export
+def _strip_quant_cfg(cfg) -> list:
+    """Drop ``quant`` keys: the exporter's trainers must load the f32
+    masters verbatim (plans are applied explicitly here)."""
+    return [(n, v) for n, v in cfg if n != "quant"]
+
+
+def export_quantized(
+    cfg,
+    model_path: str,
+    eval_iter=None,
+    scheme: str = "int8",
+    min_agreement: float = 0.99,
+    calib_batches: int = 0,
+    out_path: Optional[str] = None,
+    silent: bool = True,
+) -> dict:
+    """The gated export step (``task=export_quant``).  Returns the
+    verdict document; writes the artifact only when the gate passes.
+
+    ``min_agreement = 0`` skips the gate (``eval_iter`` may then be
+    None) — an explicit opt-out, for benches and offline pipelines that
+    gate elsewhere."""
+    from .trainer import NetTrainer
+
+    cfg = _strip_quant_cfg(list(cfg))
+    reason = ckpt.validate_checkpoint(model_path)
+    if reason is not None:
+        raise ckpt.CheckpointError(f"{model_path}: {reason}")
+
+    def _load() -> NetTrainer:
+        tr = NetTrainer()
+        tr.set_params(cfg)
+        tr.load_model(model_path)
+        return tr
+
+    ref = _load()
+    if ref.quant_scheme:
+        raise ValueError(
+            f"{model_path} is already a quantized artifact "
+            f"({ref.quant_scheme}) — export from the f32 checkpoint")
+    cand = _load()
+    plan = build_plan(ref, scheme)
+    if not plan:
+        raise ValueError(
+            "no quantizable layers (conv/fullc) in this net — nothing "
+            "to export")
+    gate = min_agreement > 0
+    if gate and eval_iter is None:
+        raise ValueError(
+            "export_quantized: the agreement gate needs an eval "
+            "iterator (set quant_min_agreement=0 to export ungated)")
+    ranking = _error_ranking(ref, plan)
+    agreement, rows = 1.0, 0
+    fallbacks: List[str] = []
+    while True:
+        apply_plan(cand, plan, scheme, source_params=ref.params)
+        if not gate:
+            break
+        agreement, rows = top1_agreement(ref, cand, eval_iter,
+                                         max_batches=calib_batches)
+        if agreement >= min_agreement:
+            break
+        demote = next((key for _e, key in ranking
+                       if plan.get(key) == "int8"), None)
+        if demote is None:
+            break  # every layer already bf16: the gate loses
+        plan[demote] = "bf16"
+        fallbacks.append(demote)
+        if not silent:
+            print(f"quant: agreement {agreement:.4f} < "
+                  f"{min_agreement:g}; falling back {demote} to bf16",
+                  flush=True)
+    ok = (not gate) or agreement >= min_agreement
+    actual, f32_equiv = opsq.weight_bytes(cand.params)
+    n_int8 = sum(1 for v in plan.values() if v == "int8")
+    n_bf16 = sum(1 for v in plan.values() if v == "bf16")
+    verdict = {
+        "ok": bool(ok),
+        "scheme": scheme,
+        "source": model_path,
+        "agreement": (agreement if gate else None),
+        "min_agreement": min_agreement,
+        "gated": gate,
+        "eval_rows": rows,
+        "calib_batches": calib_batches,
+        "layers": dict(plan),
+        "int8_layers": n_int8,
+        "bf16_layers": n_bf16,
+        "fallbacks": fallbacks,
+        "weight_bytes": actual,
+        "weight_bytes_f32": f32_equiv,
+        "bytes_ratio": (f32_equiv / actual) if actual else 0.0,
+        "path": None,
+    }
+    if not ok:
+        # reject: nothing reaches disk — the f32 artifact keeps serving
+        obs_events.emit("quant.reject", source=model_path,
+                        scheme=scheme, agreement=agreement,
+                        min_agreement=min_agreement,
+                        fallbacks=len(fallbacks))
+        _count("rejected")
+        if not silent:
+            print(f"quant: REJECTED — agreement {agreement:.4f} < "
+                  f"{min_agreement:g} even with every layer at bf16",
+                  flush=True)
+        return verdict
+    path = out_path or quant_artifact_path(model_path)
+    man = ckpt.read_manifest(model_path) or {}
+    round_ = man.get("round")
+    if round_ is None:
+        round_ = ckpt.checkpoint_round(model_path)
+    cand.round = round_ if round_ is not None else 0
+    blob = cand.checkpoint_bytes()
+    ckpt.write_checkpoint(
+        path, blob, round_=round_, net_fp=cand.net_fp(),
+        save_ustate=0, silent=silent,
+        quant={
+            "scheme": scheme,
+            "scales_dtype": "float32",
+            "int8_layers": n_int8,
+            "bf16_layers": n_bf16,
+            "agreement": (agreement if gate else None),
+            "source_crc32": man.get("crc32"),
+        },
+    )
+    verdict["path"] = path
+    obs_events.emit("quant.export", source=model_path, path=path,
+                    scheme=scheme,
+                    agreement=(agreement if gate else None),
+                    int8_layers=n_int8, bf16_layers=n_bf16,
+                    bytes_ratio=verdict["bytes_ratio"])
+    _count("published")
+    if not silent:
+        ag = f"{agreement:.4f}" if gate else "ungated"
+        print(f"quant: exported {path} (scheme {scheme}, agreement "
+              f"{ag}, {n_int8} int8 + {n_bf16} bf16 layers, "
+              f"{verdict['bytes_ratio']:.2f}x smaller weights)",
+              flush=True)
+    return verdict
+
+
+def _count(decision: str) -> None:
+    from ..obs.registry import registry
+
+    registry().counter(
+        "quant_export_total",
+        "Gated quantized exports by decision: published / rejected.",
+        labelnames=("decision",),
+    ).labels(decision=decision).inc()

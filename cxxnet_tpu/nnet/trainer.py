@@ -73,6 +73,15 @@ class NetTrainer:
         self.save_ustate = 0
         self.divergence_policy = ""  # "" off | "abort" | "rollback"
         self.inject_nan_step = -1  # fault-injection hook (tests only)
+        # quantized inference (doc/performance.md "Quantized inference"):
+        # quant_scheme is set when the params pytree holds reduced-
+        # precision kernels (int8 codes + scales, or bf16 casts) — the
+        # trainer is then INFERENCE-ONLY; _quant_requested records the
+        # conf's `quant` key, applied after init/load when the loaded
+        # artifact is not already quantized
+        self.quant_scheme = ""
+        self.quant_plan = None
+        self._quant_requested = ""
         self.mesh_plan: Optional[MeshPlan] = None
         self.aux = {}  # non-gradient layer state (BN running stats)
         self.metric = MetricSet()
@@ -127,6 +136,20 @@ class NetTrainer:
             # fault-injection harness: treat the loss at this epoch as
             # NaN (one transient blow-up) so recovery paths are testable
             self.inject_nan_step = int(val)
+        elif name == "quant":
+            # inference-time weight precision: "" / 0 off, int8 (per-
+            # channel scales + bf16 fallback) or bf16 (straight cast).
+            # A pre-exported .quant.model artifact wins over this key;
+            # on a plain checkpoint the quantization happens at load,
+            # UNGATED (use task=export_quant for the gated artifact).
+            if val in ("", "0", "off", "none"):
+                self._quant_requested = ""
+            elif val in ("int8", "bf16"):
+                self._quant_requested = val
+            else:
+                raise ValueError(
+                    f"quant={val!r}: supported schemes are int8 and "
+                    "bf16 (0/off disables)")
         elif name in ("zero", "fsdp", "shard_weight_update"):
             # zero = 1: optimizer state sharded over the data axis
             # (update_on_server's modern spelling); zero = 3 / fsdp = 1:
@@ -236,6 +259,7 @@ class NetTrainer:
         self.epoch_counter = 0
         self.sample_counter = 0
         self._grad_accum = None
+        self._maybe_quantize()
         self._place_state()
 
     def _build_mesh(self) -> None:
@@ -243,6 +267,15 @@ class NetTrainer:
         self.mesh_plan = make_mesh(self.dev, self.model_parallel)
         if self.batch_size:
             self.mesh_plan.check_batch(self.batch_size)
+        if self.net is not None:
+            # bind the platform the programs will actually run on (NOT
+            # the process default backend — dev=cpu on a TPU host must
+            # read as cpu): auto branch-embed keys on it
+            try:
+                devs = self.mesh_plan.mesh.devices.reshape(-1)
+                self.net.exec_backend = str(devs[0].platform)
+            except Exception:  # noqa: BLE001 - fall back to the probe
+                pass
 
     def _sh(self):
         """(replicated, data-sharded, per-extra) shardings for the mesh."""
@@ -567,6 +600,7 @@ class NetTrainer:
         side is the in-flight scan program.
         """
         assert self.net is not None, "init_model/load_model first"
+        self._check_trainable()
         if not sync and self.eval_train:
             raise ValueError(
                 "update_scan(sync=False) cannot overlap with eval_train: "
@@ -1200,9 +1234,36 @@ class NetTrainer:
         cache = {None: base, **node_cache}
         return [cache[node] for node in self.train_metric.nodes]
 
+    def _maybe_quantize(self) -> None:
+        """Apply the conf's ``quant`` scheme to freshly built f32 params
+        (no-op when unrequested or already quantized).  This is the
+        UNGATED on-load path — serving processes have no held-out data
+        to gate on; the event makes that visible."""
+        if not self._quant_requested or self.quant_scheme:
+            return
+        from . import quant as nquant
+        from ..obs import events as obs_events
+
+        plan = nquant.build_plan(self, self._quant_requested)
+        if not plan:
+            return
+        nquant.apply_plan(self, plan, self._quant_requested)
+        obs_events.emit(
+            "quant.on_load", scheme=self.quant_scheme,
+            layers=len(plan), gated=False)
+
+    def _check_trainable(self) -> None:
+        if self.quant_scheme:
+            raise ValueError(
+                f"this trainer serves a quantized model "
+                f"({self.quant_scheme}) and is inference-only — "
+                "gradients through int8 codes are meaningless; train "
+                "on the f32 checkpoint and re-export")
+
     def update(self, batch: DataBatch) -> None:
         """One micro-batch: fwd/bwd + (every update_period-th call) update."""
         assert self.net is not None, "init_model/load_model first"
+        self._check_trainable()
         staged, self._staged = self._staged, None
         if staged is not None and staged[0] is batch:
             # double-buffered feed: this batch's H2D was issued by
@@ -1451,8 +1512,15 @@ class NetTrainer:
 
     # ------------------------------------------------------------------
     # checkpointing: magic | json header | npz params
-    @staticmethod
-    def _read_model_file(path: str):
+    #
+    # npz cannot represent ml_dtypes natively (bfloat16 round-trips as
+    # raw void bytes), so bfloat16 leaves — the quantized artifacts' 2x
+    # fallback kernels — are stored as uint16 words under a "~bf16"
+    # name suffix and re-viewed at read time.
+    _BF16_SUFFIX = "~bf16"
+
+    @classmethod
+    def _read_model_file(cls, path: str):
         """Parse a checkpoint → (header, params, aux, ustates) where
         params/aux are ``{key: {tag: ndarray}}`` and ustates (present
         only for ``save_ustate=1`` checkpoints) is
@@ -1469,16 +1537,22 @@ class NetTrainer:
         aux: Dict[str, dict] = {}
         ust: Dict[str, dict] = {}
         for k in npz.files:
+            arr = npz[k]
+            if k.endswith(cls._BF16_SUFFIX):
+                import ml_dtypes
+
+                k = k[:-len(cls._BF16_SUFFIX)]
+                arr = arr.view(ml_dtypes.bfloat16)
             key, tag = k.rsplit("/", 1)
             if key.startswith("ust:"):
                 tagname, slot = tag.split("@", 1)
                 ust.setdefault(key[4:], {}).setdefault(tagname, {})[
                     slot
-                ] = npz[k]
+                ] = arr
             elif key.startswith("aux:"):
-                aux.setdefault(key[4:], {})[tag] = npz[k]
+                aux.setdefault(key[4:], {})[tag] = arr
             else:
-                params.setdefault(key, {})[tag] = npz[k]
+                params.setdefault(key, {})[tag] = arr
         return header, params, aux, ust
 
     def checkpoint_bytes(self) -> bytes:
@@ -1492,6 +1566,15 @@ class NetTrainer:
             "structure": json.loads(self.graph.structure_to_json()),
             "epoch_counter": self.epoch_counter,
         }
+        if self.quant_scheme:
+            # quantized artifact: load_model restores the scheme/plan so
+            # the served programs (and the bucket-cache key) know what
+            # precision they run — see nnet/quant.py
+            header["quant"] = {
+                "scheme": self.quant_scheme,
+                "scales_dtype": "float32",
+                "layers": dict(self.quant_plan or {}),
+            }
         if self.save_ustate and self._rng_key is not None:
             # exact resume includes the training rng stream (dropout /
             # insanity noise), not just optimizer state; the impl name is
@@ -1506,17 +1589,27 @@ class NetTrainer:
         hjson = json.dumps(header).encode("utf-8")
         buf = _io.BytesIO()
         flat = {}
+
+        def _store(name: str, w) -> None:
+            arr = fetch_array(w)
+            if arr.dtype.name == "bfloat16":
+                # npz-safe spelling: uint16 words + name suffix (see
+                # _read_model_file)
+                flat[name + self._BF16_SUFFIX] = arr.view(np.uint16)
+            else:
+                flat[name] = arr
+
         for key, tags in self.params.items():
             for tag, w in tags.items():
-                flat[f"{key}/{tag}"] = fetch_array(w)
+                _store(f"{key}/{tag}", w)
         for key, tags in self.aux.items():
             for tag, w in tags.items():
-                flat[f"aux:{key}/{tag}"] = fetch_array(w)
+                _store(f"aux:{key}/{tag}", w)
         if self.save_ustate:
             for key, tags in self.ustates.items():
                 for tag, slots in tags.items():
                     for slot, w in slots.items():
-                        flat[f"ust:{key}/{tag}@{slot}"] = fetch_array(w)
+                        _store(f"ust:{key}/{tag}@{slot}", w)
         np.savez(buf, **flat)
         out = _io.BytesIO()
         out.write(MODEL_MAGIC)
@@ -1549,12 +1642,24 @@ class NetTrainer:
         kill mid-write can never leave a loadable-looking truncation."""
         blob = self.checkpoint_bytes()
         if manifest:
+            quant = None
+            if self.quant_scheme:
+                plan = self.quant_plan or {}
+                quant = {
+                    "scheme": self.quant_scheme,
+                    "scales_dtype": "float32",
+                    "int8_layers": sum(1 for v in plan.values()
+                                       if v == "int8"),
+                    "bf16_layers": sum(1 for v in plan.values()
+                                       if v == "bf16"),
+                }
             ckpt.write_checkpoint(
                 path, blob,
                 round_=self.round if round_ is None else round_,
                 net_fp=self.net_fp(),
                 save_ustate=self.save_ustate,
                 mesh=self.mesh_manifest(),
+                quant=quant,
             )
         else:
             ckpt.atomic_write_bytes(path, blob)
@@ -1590,6 +1695,16 @@ class NetTrainer:
             key: {tag: jnp.asarray(w) for tag, w in tags.items()}
             for key, tags in raw.items()
         }
+        q = header.get("quant")
+        if q:
+            # pre-exported quantized artifact (nnet/quant.py): the codes
+            # / scales / bf16 kernels loaded verbatim above ARE the
+            # serving params; record the scheme for dispatch + identity
+            self.quant_scheme = str(q.get("scheme", "int8"))
+            self.quant_plan = dict(q.get("layers") or {})
+        else:
+            self.quant_scheme = ""
+            self.quant_plan = None
         self.aux = self.net.init_aux(self.batch_size)
         for key, tags in raw_aux.items():
             if key in self.aux:
@@ -1611,6 +1726,9 @@ class NetTrainer:
                     self.ustates[key][tag] = {
                         sl: jnp.asarray(w) for sl, w in slots.items()
                     }
+        # a conf-level quant key on a PLAIN checkpoint: quantize now
+        # (ungated — doc/performance.md); a quantized artifact wins
+        self._maybe_quantize()
         # checkpoints hold GATHERED (full) arrays — re-shard onto the
         # CURRENT mesh, whatever mesh (or process count) wrote them
         self._place_state()

@@ -23,3 +23,4 @@ from .flash import flash_mha, flash_mha_lse  # noqa: F401
 from .maxpool import maxpool_bwd_s1, maxpool_fused  # noqa: F401
 from .lrn import lrn, lrn_xla  # noqa: F401
 from .pipeline import gpipe, pipeline_apply  # noqa: F401
+from . import quant  # noqa: F401

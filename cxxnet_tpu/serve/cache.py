@@ -15,9 +15,10 @@ through the trainer's pure predict function for that bucket, and the
 padded rows are trimmed off the result.  Mixed request sizes therefore
 hit at most ``log2(max size)`` compiled programs, all warm after the
 first pass.  Cache keys are
-``(net_fingerprint, kind, bucket, row_shape, dtype)`` — a hot model
-reload (new fingerprint) or a different feature node naturally occupies
-new slots.
+``(net_fingerprint, kind, node, bucket, row_shape, dtype, quant)`` — a
+hot model reload (new fingerprint), a different feature node, or a
+different weight-precision scheme (the f32 model vs its int8 export in
+a rolling comparison) naturally occupies new slots.
 """
 
 from __future__ import annotations
@@ -83,6 +84,13 @@ class ShapeBucketCache:
                 self._graph = self._trainer.graph
                 self._net_fp = None
 
+    def quant_scheme(self) -> str:
+        """The served weights' precision scheme (cache-key component):
+        ``"int8"`` / ``"bf16"`` for quantized artifacts, ``""`` f32."""
+        from ..ops import quant as opsq
+
+        return opsq.scheme_of(self._trainer)
+
     def _n_data(self) -> int:
         plan = self._trainer.mesh_plan
         return plan.n_data if plan is not None else 1
@@ -111,8 +119,12 @@ class ShapeBucketCache:
             )
         n = data.shape[0]
         bucket = self.bucket_for(n)
+        # the quant scheme rides in the key beside dtype: an f32 model
+        # and its int8 export share a net fingerprint, and during a
+        # rolling comparison both serve from one process — their
+        # compiled programs must occupy distinct slots
         key = (self.net_fp(), kind, node_id, bucket,
-               data.shape[1:], str(data.dtype))
+               data.shape[1:], str(data.dtype), self.quant_scheme())
         with self._lock:
             if key in self._keys:
                 self._keys[key] += 1

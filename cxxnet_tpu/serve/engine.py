@@ -92,6 +92,16 @@ class Engine:
         self._cfg = _parse_cfg(cfg)
         self.model_dir = model_dir
         self.silent = silent
+        # quantized serving (doc/performance.md "Quantized inference"):
+        # `quant = int8|bf16` prefers the gated `.quant.model` sibling
+        # of whatever checkpoint discovery picks; absent a sibling the
+        # trainer quantizes on load (ungated — the trainer emits the
+        # event).  Validation of the value happens in the trainer.
+        self.quant = ""
+        for _n, _v in self._cfg:
+            if _n == "quant":
+                self.quant = ("" if _v in ("", "0", "off", "none")
+                              else _v)
         # persistent XLA compile cache BEFORE the warmup compiles (and
         # before any hot-reload's fresh-trainer warm), so serve restarts
         # and reload warms reuse on-disk programs instead of re-jitting
@@ -117,6 +127,7 @@ class Engine:
                 raise ValueError("Engine(trainer=...): init/load it first")
             self._trainer = trainer
         elif model_in is not None:
+            model_in = self._prefer_quant(model_in)
             reason = ckpt.validate_checkpoint(
                 model_in, net_fp=self._conf_net_fp()
             )
@@ -141,19 +152,38 @@ class Engine:
                     raise ModelLoadError(
                         f"no loadable checkpoint in {model_dir!r}{detail}"
                     )
+                load_path = self._prefer_quant(found[1])
                 try:
-                    trainer_ = self._load_trainer(found[1])
+                    trainer_ = self._load_trainer(load_path)
                 except Exception as e:  # noqa: BLE001 - fall back past it
                     last_err = e
-                    if not silent:
-                        print(f"serve: checkpoint {found[1]} failed to "
-                              f"load ({type(e).__name__}: {e}); falling "
-                              "back to an older round", flush=True)
-                    before = found[0]
-                    continue
+                    if load_path != found[1]:
+                        # the quant SIBLING failed to load — the round's
+                        # base f32 checkpoint may still be fine; try it
+                        # before skipping the whole round
+                        if not silent:
+                            print(f"serve: quant artifact {load_path} "
+                                  f"failed to load ({type(e).__name__}: "
+                                  f"{e}); trying the f32 base",
+                                  flush=True)
+                        try:
+                            trainer_ = self._load_trainer(found[1])
+                            load_path = found[1]
+                        except Exception as e2:  # noqa: BLE001
+                            last_err = e2
+                            before = found[0]
+                            continue
+                    else:
+                        if not silent:
+                            print(f"serve: checkpoint {load_path} failed "
+                                  f"to load ({type(e).__name__}: {e}); "
+                                  "falling back to an older round",
+                                  flush=True)
+                        before = found[0]
+                        continue
                 self._round = found[0]
                 self._trainer = trainer_
-                self._set_model(found[1], found[0])
+                self._set_model(load_path, found[0])
                 break
         else:
             raise ValueError(
@@ -186,6 +216,7 @@ class Engine:
             watchdog_timeout_s=watchdog_timeout_s,
         )
         self._closed = False
+        self._export_weight_gauges()
         from ..tune.controller import set_effective
 
         set_effective("max_batch_size", self.batcher.max_batch_size)
@@ -196,6 +227,46 @@ class Engine:
 
     # ------------------------------------------------------------------
     # loading
+    def _prefer_quant(self, path: str) -> str:
+        """Under ``quant = <scheme>``: the checkpoint's ``.quant.model``
+        sibling when it exists, validates, and carries the requested
+        scheme; else the original path (the trainer then quantizes on
+        load — ungated)."""
+        if not self.quant:
+            return path
+        from ..nnet.quant import quant_artifact_path
+
+        qp = quant_artifact_path(path)
+        if qp == path or not os.path.exists(qp):
+            return path
+        if ckpt.validate_checkpoint(qp, net_fp=self._conf_net_fp()) is not None:
+            return path
+        man = ckpt.read_manifest(qp) or {}
+        scheme = (man.get("quant") or {}).get("scheme")
+        if scheme != self.quant:
+            return path
+        return qp
+
+    def _export_weight_gauges(self) -> None:
+        """Publish ``serve_weight_bytes`` / ``serve_weight_bytes_f32``
+        and the one-hot ``serve_quant_scheme{scheme}`` for the CURRENT
+        trainer — the observable proof the int8 export actually shrank
+        the served weights (~4x; the QUANT lane asserts >= 3.5x)."""
+        from ..ops import quant as opsq
+        from .metrics import serve_metrics
+
+        try:
+            actual, f32_equiv = opsq.weight_bytes(self._trainer.params)
+            scheme = opsq.scheme_of(self._trainer) or "f32"
+        except Exception:  # noqa: BLE001 - telemetry must never raise
+            return
+        m = serve_metrics()
+        m.weight_bytes.set(actual)
+        m.weight_bytes_f32.set(f32_equiv)
+        for s in ("f32", "int8", "bf16"):
+            m.quant_scheme.labels(scheme=s).set(1.0 if s == scheme
+                                                else 0.0)
+
     def _conf_net_fp(self) -> Optional[str]:
         """Fingerprint of the conf's netconfig for manifest validation
         (None when the conf carries none — validation then skips the
@@ -358,7 +429,10 @@ class Engine:
     def reload_if_newer(self) -> bool:
         """Swap to a newer valid checkpoint in ``model_dir`` (no-op and
         False when there is none, when the engine was built without a
-        watch directory, or when the newest round is already serving).
+        watch directory, or when the newest round is already serving
+        from its preferred artifact — under ``quant=`` a gated
+        ``.quant.model`` sibling appearing for the CURRENT round does
+        swap in; rounds never move backward).
 
         The new trainer is built and its compile cache warmed on every
         bucket shape currently in service BEFORE the swap, so the first
@@ -370,9 +444,15 @@ class Engine:
         found = ckpt.find_latest_valid(
             self.model_dir, net_fp=self._conf_net_fp(), silent=self.silent
         )
-        if found is None or found[0] <= self._round:
+        if found is None or found[0] < self._round:
             return False
         round_, path = found
+        path = self._prefer_quant(path)
+        if round_ == self._round and path == self._model_path:
+            return False
+        # same round, different path: a gated .quant.model sibling
+        # appeared for the round already serving (export after serve
+        # start) — swap onto it; rounds still never move backward
         tr = self._load_trainer(path)
         cache = ShapeBucketCache(tr, self._cache.max_batch_size)
         self._warm(cache)
@@ -382,6 +462,7 @@ class Engine:
             self._cache = cache
             self._row_shapes = self._allowed_row_shapes(tr)
             self._set_model(path, round_)
+        self._export_weight_gauges()
         obs_events.emit("serve.reload", ok=True, swapped=True,
                         round=round_, old_round=old_round, path=path)
         if not self.silent:
@@ -433,7 +514,7 @@ class Engine:
         the old cache served, by running zero batches through it."""
         with self._model_lock:
             keys = self._cache.keys_snapshot()
-        for _fp, kind, node_id, bucket, row_shape, dtype in keys:
+        for _fp, kind, node_id, bucket, row_shape, dtype, _q in keys:
             zeros = np.zeros((bucket,) + tuple(row_shape), dtype)
             try:
                 cache._run(kind, node_id, zeros)
@@ -555,6 +636,12 @@ class Engine:
             return self._model_crc
 
     @property
+    def quant_scheme(self) -> str:
+        """Precision scheme of the served weights ("" for plain f32)."""
+        with self._model_lock:
+            return self._cache.quant_scheme()
+
+    @property
     def trainer(self) -> NetTrainer:
         """The live trainer (swapped by hot reload; hold no references
         across requests)."""
@@ -578,6 +665,7 @@ class Engine:
                 "model": self._model_path,
                 "model_crc32": self._model_crc,
                 "net_fp": self._cache.net_fp(),
+                "quant": self._cache.quant_scheme() or "f32",
                 "reload_breaker": self.reload_breaker.state,
             }
             if firing:
@@ -586,13 +674,19 @@ class Engine:
 
     def snapshot_stats(self) -> Dict[str, object]:
         out = self.stats.snapshot()
+        from ..ops import quant as opsq
+
         with self._model_lock:
             out["compile_cache"] = self._cache.stats()
+            wb, wb32 = opsq.weight_bytes(self._trainer.params)
             out["model"] = {
                 "path": self._model_path,
                 "round": self._round,
                 "crc32": self._model_crc,
                 "net_fp": self._cache.net_fp(),
+                "quant": self._cache.quant_scheme() or "f32",
+                "weight_bytes": wb,
+                "weight_bytes_f32": wb32,
             }
         out["batcher"] = {
             "max_batch_size": self.batcher.max_batch_size,

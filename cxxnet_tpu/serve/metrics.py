@@ -93,6 +93,23 @@ class _ServeMetrics:
             "serve_queue_depth_errors_total",
             "Queue-depth gauge sampling failures.",
         )
+        # quantized-inference identity (doc/performance.md "Quantized
+        # inference"): weight bytes at rest as served vs their dense-f32
+        # cost — the ~4x int8 win as a scrapeable ratio — plus the
+        # active precision scheme as a one-hot labeled gauge
+        self.weight_bytes = reg.gauge(
+            "serve_weight_bytes",
+            "Model weight bytes at rest in the serving engine (as "
+            "stored: int8 codes + f32 scales for quantized models).")
+        self.weight_bytes_f32 = reg.gauge(
+            "serve_weight_bytes_f32",
+            "Dense-f32 cost of the same weight tensors (the "
+            "quantization win's denominator).")
+        self.quant_scheme = reg.gauge(
+            "serve_quant_scheme",
+            "Served weight precision (1 on the active scheme label).",
+            labelnames=("scheme",),
+        )
         # request-shape histogram (pow2 bucket of each request's row
         # count) — what the speculative bucket prewarm and the tuning
         # controller read to anticipate compiled-program demand

@@ -155,6 +155,7 @@ def write_manifest(
     save_ustate: int = 0,
     blob: Optional[bytes] = None,
     mesh: Optional[dict] = None,
+    quant: Optional[dict] = None,
 ) -> dict:
     """Write the sidecar manifest for an already-written checkpoint.
 
@@ -167,7 +168,10 @@ def write_manifest(
     ``checkpoint_bytes``), and load re-shards onto whatever mesh the
     loading process runs, so resume across device/process counts needs
     no translation step.  The field lets tooling answer "what wrote
-    this" without loading it."""
+    this" without loading it.  ``quant`` (``{"scheme", "scales_dtype",
+    "int8_layers", "bf16_layers", ...}``) marks a quantized inference
+    artifact (``nnet/quant.py``) — absent on ordinary f32 checkpoints,
+    so tooling can tell the two apart without parsing the payload."""
     if blob is not None:
         crc, size = crc32_of(blob), len(blob)
     else:
@@ -183,6 +187,8 @@ def write_manifest(
     }
     if mesh is not None:
         man["mesh"] = mesh
+    if quant is not None:
+        man["quant"] = quant
     atomic_write_bytes(
         manifest_path(model_path),
         (json.dumps(man, indent=1) + "\n").encode("utf-8"),
@@ -199,6 +205,7 @@ def write_checkpoint(
     retry: bool = False,
     silent: bool = True,
     mesh: Optional[dict] = None,
+    quant: Optional[dict] = None,
 ) -> None:
     """THE checkpoint write discipline — atomic payload write, then the
     sidecar manifest — shared by every writer (``NetTrainer.save_model``
@@ -211,7 +218,8 @@ def write_checkpoint(
 
     def _manifest():
         write_manifest(path, round_=round_, net_fp=net_fp,
-                       save_ustate=save_ustate, blob=blob, mesh=mesh)
+                       save_ustate=save_ustate, blob=blob, mesh=mesh,
+                       quant=quant)
 
     from ..obs import emit as obs_emit
     from ..obs import trace as obs_trace

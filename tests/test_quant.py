@@ -1,0 +1,436 @@
+"""Quantized inference (doc/performance.md "Quantized inference").
+
+Covers the scheme's math (``ops/quant.py``), the gated export
+(``nnet/quant.py`` / ``task=export_quant``), the quantized artifact
+round trip, the serve-plane integration (bucket-cache key isolation,
+weight-bytes identity), and the inference-build branch-embed promotion.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu import config as C
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.models import mnist_mlp_conf
+from cxxnet_tpu.nnet import quant as nquant
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.ops import quant as opsq
+
+
+# ----------------------------------------------------------------------
+# primitives
+def test_per_channel_scale_roundtrip():
+    """Codes * scales reconstructs each output channel to within half a
+    step of its own scale (per-channel, NOT per-tensor: a channel 100x
+    smaller than its neighbour keeps its own resolution)."""
+    rng = np.random.RandomState(0)
+    w = rng.randn(3, 3, 8, 16).astype(np.float32)
+    w[..., 3] *= 0.01  # a tiny channel a per-tensor scale would crush
+    q, s = opsq.quantize_weight(w, out_axis=3)
+    assert q.dtype == np.int8 and s.shape == (16,)
+    dq = np.asarray(opsq.dequantize_weight(q, s, out_axis=3))
+    for o in range(16):
+        np.testing.assert_allclose(
+            dq[..., o], w[..., o], atol=float(s[o]) * 0.5 + 1e-8)
+    # the tiny channel's scale is proportionally tiny
+    assert s[3] < 0.05 * s.max()
+
+
+def test_symmetric_range_clipping():
+    """Codes stay in [-127, 127] (never -128 — negation-exact), the
+    max-|w| element maps to exactly +-127, and all-zero channels get
+    scale 1 with all-zero codes."""
+    w = np.array([[4.0, -8.0, 0.5], [0.0, 0.0, 0.0]], np.float32).T
+    # columns are output channels (fullc layout (nout, nin) -> axis 0)
+    q, s = opsq.quantize_weight(w.T, out_axis=0)
+    assert q.min() >= -127 and q.max() <= 127
+    assert q[0].max() == 127 or q[0].min() == -127
+    np.testing.assert_array_equal(q[1], 0)
+    assert s[1] == 1.0
+    # a value far beyond the scale clips, not wraps
+    qq, ss = opsq.quantize_weight(
+        np.array([[1.0, 1000.0]], np.float32), out_axis=0)
+    assert qq.max() == 127
+
+
+def test_dequant_free_fold_matches_dequantized_math():
+    """The serving spelling — raw codes into the GEMM, rescale folded
+    after — equals dequantize-then-matmul exactly in f32 (the scale
+    commutes out of the contraction)."""
+    rng = np.random.RandomState(1)
+    w = rng.randn(6, 10).astype(np.float32)  # fullc (nout, nin)
+    b = rng.randn(6).astype(np.float32)
+    x = jnp.asarray(rng.randn(4, 10).astype(np.float32))
+    q, s = opsq.quantize_weight(w, out_axis=0)
+    lp = {opsq.QKEY: jnp.asarray(q), opsq.SKEY: jnp.asarray(s),
+          "bias": jnp.asarray(b)}
+    folded = np.asarray(opsq.fc_apply_q(lp, x))
+    dq = np.asarray(opsq.dequantize_weight(q, s, out_axis=0))
+    ref = np.asarray(x) @ dq.T + b
+    np.testing.assert_allclose(folded, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_conv_apply_q_matches_dequantized_conv():
+    from jax import lax
+
+    rng = np.random.RandomState(2)
+    w = rng.randn(3, 3, 4, 8).astype(np.float32)
+    x = jnp.asarray(rng.randn(2, 9, 9, 4).astype(np.float32))
+    q, s = opsq.quantize_weight(w, out_axis=3)
+    lp = {opsq.QKEY: jnp.asarray(q), opsq.SKEY: jnp.asarray(s)}
+    got = np.asarray(opsq.conv_apply_q(lp, x, 1, 1, 1))
+    dq = opsq.dequantize_weight(q, s, out_axis=3)
+    ref = np.asarray(lax.conv_general_dilated(
+        x, dq, (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC")))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_weight_bytes_accounting():
+    params = {
+        "a": {opsq.QKEY: np.zeros((100, 10), np.int8),
+              opsq.SKEY: np.zeros(100, np.float32),
+              "bias": np.zeros(100, np.float32)},
+        "b": {"wmat": np.zeros((10, 10), np.float32)},
+    }
+    actual, f32 = opsq.weight_bytes(params)
+    assert actual == 1000 + 400 + 400 + 400
+    assert f32 == 4000 + 400 + 400  # scales don't exist in the f32 model
+
+
+# ----------------------------------------------------------------------
+# trainer-level plan / fallback / artifact
+CONV_CFG = [
+    ("dev", "cpu"),
+    ("batch_size", "8"),
+    ("input_shape", "4,10,10"),
+    ("eta", "0.1"),
+    ("netconfig", "start"),
+    ("layer[0->1]", "conv:c1"),
+    ("kernel_size", "3"), ("pad", "1"), ("nchannel", "8"),
+    ("random_type", "xavier"),
+    ("layer[1->2]", "relu"),
+    ("layer[2->3]", "flatten"),
+    ("layer[3->4]", "fullc:fc"),
+    ("nhidden", "6"), ("random_type", "xavier"),
+    ("layer[4->4]", "softmax"),
+    ("netconfig", "end"),
+]
+
+
+def _conv_trainer(extra=()):
+    tr = NetTrainer()
+    tr.set_params(CONV_CFG + [("seed", "3")] + list(extra))
+    tr.init_model()
+    return tr
+
+
+def _batch(n=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return DataBatch(data=rng.rand(n, 10, 10, 4).astype(np.float32),
+                     label=np.zeros((n, 1), np.float32))
+
+
+def test_plan_and_per_layer_bf16_fallback():
+    tr = _conv_trainer()
+    plan = nquant.build_plan(tr)
+    assert plan == {"l0_c1": "int8", "l3_fc": "int8"}
+    ref = tr.predict(_batch())
+    tq = _conv_trainer()
+    plan["l0_c1"] = "bf16"
+    nquant.apply_plan(tq, plan, source_params=tr.params)
+    # the fallback layer stores a bfloat16 kernel, the int8 one codes
+    assert tq.params["l0_c1"]["wmat"].dtype == jnp.bfloat16
+    assert opsq.QKEY in tq.params["l3_fc"]
+    assert (tq.predict(_batch()) == ref).mean() >= 0.9
+    a, f = opsq.weight_bytes(tq.params)
+    assert 1.0 < f / a < 4.0  # between all-f32 and all-int8
+
+
+def test_wino_conv_starts_at_bf16():
+    """A conv that opted into the Winograd path must not be silently
+    rerouted through the direct int8 conv — the plan starts it bf16."""
+    tr = _conv_trainer(extra=[("conv_wino", "2")])
+    plan = nquant.build_plan(tr)
+    assert plan["l0_c1"] == "bf16" and plan["l3_fc"] == "int8"
+
+
+def test_quantized_trainer_is_inference_only():
+    tr = _conv_trainer()
+    nquant.apply_plan(tr, nquant.build_plan(tr))
+    b = _batch()
+    with pytest.raises(ValueError, match="inference-only"):
+        tr.update(b)
+    with pytest.raises(ValueError, match="inference-only"):
+        tr.update_scan(np.stack([b.data]), np.stack([b.label]))
+
+
+def test_artifact_roundtrip_and_manifest(tmp_path):
+    tr = _conv_trainer()
+    nquant.apply_plan(tr, nquant.build_plan(tr))
+    p = str(tmp_path / "0007.quant.model")
+    tr.save_model(p, round_=7)
+    man = json.load(open(p + ".manifest.json"))
+    assert man["quant"]["scheme"] == "int8"
+    assert man["quant"]["int8_layers"] == 2
+    t2 = NetTrainer()
+    t2.set_params(CONV_CFG)
+    t2.load_model(p)
+    assert t2.quant_scheme == "int8"
+    assert t2.quant_plan == {"l0_c1": "int8", "l3_fc": "int8"}
+    assert t2.params["l0_c1"][opsq.QKEY].dtype == jnp.int8
+    np.testing.assert_array_equal(
+        np.asarray(t2.predict(_batch())), np.asarray(tr.predict(_batch())))
+
+
+def test_bf16_leaves_survive_npz(tmp_path):
+    """npz cannot hold ml_dtypes natively; the ~bf16 spelling must
+    round-trip the fallback kernels bit-exactly."""
+    tr = _conv_trainer()
+    plan = {"l0_c1": "bf16", "l3_fc": "bf16"}
+    nquant.apply_plan(tr, plan, scheme="bf16")
+    p = str(tmp_path / "b.quant.model")
+    tr.save_model(p)
+    t2 = NetTrainer()
+    t2.set_params(CONV_CFG)
+    t2.load_model(p)
+    for key in ("l0_c1", "l3_fc"):
+        a = tr.params[key]["wmat"]
+        b = t2.params[key]["wmat"]
+        assert b.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(a).view(np.uint16), np.asarray(b).view(np.uint16))
+
+
+# ----------------------------------------------------------------------
+# the gated export
+def _train_mlp(tmp_path, rounds=2):
+    cfg = C.parse_pairs(mnist_mlp_conf(batch_size=50, synthetic=True,
+                                       dev="cpu"))
+    tr = NetTrainer()
+    tr.set_params(cfg + [("seed", "11")])
+    tr.init_model()
+    from cxxnet_tpu.config import split_sections
+    from cxxnet_tpu.io.data import create_iterator
+
+    split = split_sections(cfg)
+    it = create_iterator(split.find("data")[0].entries)
+    ev = create_iterator(split.find("eval")[0].entries)
+    for itr in (it, ev):  # the CLI's global-entry application
+        for n, v in split.global_entries:
+            itr.set_param(n, v)
+        itr.init()
+    for _ in range(rounds):
+        it.before_first()
+        while it.next():
+            tr.update(it.value())
+    path = str(tmp_path / "0002.model")
+    tr.round = 2
+    tr.save_model(path, round_=2)
+    return cfg, path, ev
+
+
+def test_export_publishes_and_gate_records_agreement(tmp_path):
+    cfg, path, ev = _train_mlp(tmp_path)
+    v = nquant.export_quantized(cfg, path, eval_iter=ev,
+                                calib_batches=4)
+    assert v["ok"] and v["path"].endswith("0002.quant.model")
+    assert v["agreement"] >= 0.99
+    assert v["bytes_ratio"] > 3.5
+    man = json.load(open(v["path"] + ".manifest.json"))
+    assert man["quant"]["agreement"] == v["agreement"]
+    assert man["round"] == 2
+
+
+def test_export_reject_leaves_f32_serving(tmp_path):
+    """An unreachable gate demotes every layer to bf16, then rejects:
+    NOTHING is written, and an engine over the model dir still serves
+    the plain f32 artifact."""
+    from cxxnet_tpu import serve
+
+    cfg, path, ev = _train_mlp(tmp_path)
+    v = nquant.export_quantized(cfg, path, eval_iter=ev,
+                                min_agreement=1.01, calib_batches=2)
+    assert not v["ok"] and v["path"] is None
+    assert set(v["layers"].values()) == {"bf16"}  # full demotion tried
+    assert not os.path.exists(
+        nquant.quant_artifact_path(path))
+    eng = serve.Engine(cfg=cfg, model_dir=str(tmp_path),
+                       max_batch_size=16)
+    try:
+        assert eng.healthz()["quant"] == "f32"
+        st = eng.snapshot_stats()["model"]
+        assert st["weight_bytes"] == st["weight_bytes_f32"]
+    finally:
+        eng.close()
+
+
+def test_export_without_eval_requires_explicit_optout(tmp_path):
+    cfg, path, _ev = _train_mlp(tmp_path)
+    with pytest.raises(ValueError, match="agreement gate"):
+        nquant.export_quantized(cfg, path, eval_iter=None)
+    v = nquant.export_quantized(cfg, path, eval_iter=None,
+                                min_agreement=0.0)
+    assert v["ok"] and v["gated"] is False
+
+
+# ----------------------------------------------------------------------
+# serve plane
+def test_bucket_cache_key_isolation(tmp_path):
+    """f32 and int8 programs of the SAME net never collide: the quant
+    scheme is part of the cache key, so a rolling comparison keeps two
+    disjoint program sets warm."""
+    from cxxnet_tpu.serve.cache import ShapeBucketCache
+
+    tr = _conv_trainer()
+    tq = _conv_trainer()
+    nquant.apply_plan(tq, nquant.build_plan(tq), source_params=tr.params)
+    cf, cq = ShapeBucketCache(tr, 16), ShapeBucketCache(tq, 16)
+    x = _batch(4).data
+    cf.scores(x)
+    cq.scores(x)
+    kf, kq = cf.keys_snapshot()[0], cq.keys_snapshot()[0]
+    assert kf[0] == kq[0]  # same net fingerprint ...
+    assert kf[-1] == "" and kq[-1] == "int8"  # ... different programs
+    assert kf != kq
+
+
+def test_engine_prefers_quant_sibling_and_reports_bytes(tmp_path):
+    from cxxnet_tpu import serve
+
+    cfg, path, ev = _train_mlp(tmp_path)
+    v = nquant.export_quantized(cfg, path, eval_iter=ev,
+                                calib_batches=2)
+    assert v["ok"]
+    eng = serve.Engine(cfg=cfg + [("quant", "int8")],
+                       model_dir=str(tmp_path), max_batch_size=16)
+    try:
+        h = eng.healthz()
+        assert h["quant"] == "int8"
+        assert h["model"].endswith(".quant.model")
+        assert h["round"] == 2
+        st = eng.snapshot_stats()["model"]
+        assert st["weight_bytes_f32"] / st["weight_bytes"] > 3.5
+        # the engine serves real predictions through the int8 programs
+        out = eng.predict(np.random.RandomState(0)
+                          .rand(4, 784).astype(np.float32))
+        assert out.shape == (4,)
+        # registry gauges carry the same identity for /metricsz
+        from cxxnet_tpu.obs import registry as obs_registry
+
+        snap = obs_registry().snapshot()
+        assert (snap["serve_weight_bytes"]["serve_weight_bytes"]
+                == st["weight_bytes"])
+        qs = snap["serve_quant_scheme"]
+        assert qs['serve_quant_scheme{scheme="int8"}'] == 1.0
+        assert qs['serve_quant_scheme{scheme="f32"}'] == 0.0
+    finally:
+        eng.close()
+
+
+def test_engine_same_round_sibling_swap_on_reload(tmp_path):
+    """A gated export published AFTER serve start (the natural order)
+    must still land: the reload poll swaps onto a .quant.model sibling
+    of the round ALREADY serving, and rounds never move backward."""
+    from cxxnet_tpu import serve
+
+    cfg, path, ev = _train_mlp(tmp_path)
+    eng = serve.Engine(cfg=cfg + [("quant", "int8")],
+                       model_dir=str(tmp_path), max_batch_size=16)
+    try:
+        # no sibling yet: serving the base checkpoint (ungated quant)
+        assert eng.model_path.endswith("0002.model")
+        assert not eng.try_reload()  # nothing new: no-op
+        v = nquant.export_quantized(cfg, path, eval_iter=ev,
+                                    calib_batches=2)
+        assert v["ok"]
+        assert eng.try_reload()  # same round, preferred artifact
+        assert eng.round == 2
+        assert eng.model_path.endswith("0002.quant.model")
+        assert not eng.try_reload()  # now stable
+    finally:
+        eng.close()
+
+
+def test_engine_falls_back_to_f32_base_on_broken_sibling(tmp_path):
+    """A CRC-valid but unloadable .quant.model must not cost the whole
+    round: the engine serves that round's f32 base instead of silently
+    falling back to an older round."""
+    from cxxnet_tpu import serve
+    from cxxnet_tpu.utils import checkpoint as ckpt
+
+    cfg, path, _ev = _train_mlp(tmp_path)
+    qp = nquant.quant_artifact_path(path)
+    # self-consistent manifest over a garbage payload: validates, but
+    # load_model explodes on the magic check
+    ckpt.write_checkpoint(qp, b"not a model", round_=2,
+                          quant={"scheme": "int8"})
+    eng = serve.Engine(cfg=cfg + [("quant", "int8")],
+                       model_dir=str(tmp_path), max_batch_size=16)
+    try:
+        assert eng.round == 2
+        assert eng.model_path.endswith("0002.model")
+        assert eng.healthz()["quant"] == "int8"  # on-load quantization
+    finally:
+        eng.close()
+
+
+def test_engine_on_load_quantization_without_artifact(tmp_path):
+    """quant=int8 on a plain checkpoint: the trainer quantizes at load
+    (ungated) — the engine still reports the scheme and the ~4x."""
+    from cxxnet_tpu import serve
+
+    cfg, path, _ev = _train_mlp(tmp_path)
+    eng = serve.Engine(cfg=cfg + [("quant", "int8")],
+                       model_dir=str(tmp_path), max_batch_size=16)
+    try:
+        assert eng.healthz()["quant"] == "int8"
+        assert eng.healthz()["model"].endswith("0002.model")
+        st = eng.snapshot_stats()["model"]
+        assert st["weight_bytes_f32"] / st["weight_bytes"] > 3.5
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# branch-embed promotion (inference builds)
+def test_branch_embed_auto_on_for_accelerator_inference():
+    """Default (-1 auto): inference program builds fuse on accelerator
+    backends only — the block kernel's ~3.6x MACs pay on the MXU and
+    cost 7x on CPU (tools/wino_bf16_ab.py --bembed-only) — and the
+    train step never auto-fuses; an explicit 0/1 pins every build.
+    Fused-vs-unfused serve predictions agree (the fusion is exact up
+    to reassociation)."""
+    from tests.test_branch_embed import INCEPTION_CFG
+
+    def build(extra=()):
+        tr = NetTrainer()
+        tr.set_params([(k, v.format(n=0) if k == "dev" else v)
+                       for k, v in INCEPTION_CFG]
+                      + [("seed", "11"), ("dev", "cpu")] + list(extra))
+        tr.init_model()
+        return tr
+
+    auto = build()
+    assert auto.net.conv_branch_embed == -1
+    assert auto.net.use_branch_embed(train=False, backend="tpu") is True
+    assert auto.net.use_branch_embed(train=True, backend="tpu") is False
+    assert auto.net.use_branch_embed(train=False, backend="cpu") is False
+    off = build([("conv_branch_embed", "0")])
+    assert off.net.use_branch_embed(train=False, backend="tpu") is False
+    fused = build([("conv_branch_embed", "1")])
+    assert fused.net.use_branch_embed(train=True, backend="cpu") is True
+    # the serve-path parity: the PINNED-fused inference programs equal
+    # the unfused ones on the same weights (same seed -> same init)
+    rng = np.random.RandomState(3)
+    b = DataBatch(data=rng.randn(16, 12, 12, 8).astype(np.float32),
+                  label=np.zeros((16, 1), np.float32))
+    sf = fused.extract_feature(b, "top[-1]")
+    so = off.extract_feature(b, "top[-1]")
+    assert fused.net._branch_embed_plan()[1]  # the group really formed
+    np.testing.assert_allclose(sf, so, rtol=2e-4, atol=2e-5)
+    np.testing.assert_array_equal(fused.predict(b), off.predict(b))

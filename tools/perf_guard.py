@@ -119,9 +119,36 @@ def flatten_mesh_parity(doc: dict) -> Dict[str, float]:
     return out
 
 
+def flatten_quant_bench(doc: dict) -> Dict[str, float]:
+    """The QUANT lane's series (``tools/quant_smoke.py`` /
+    ``serve_bench --quant``): both legs' throughput and latency, the
+    quant/f32 speedup, the weight-bytes ratio, and — when the document
+    carries the export verdict — the gate's measured agreement.  A
+    change that quietly shrinks the bytes win or the agreement drifts
+    out of the band here even while the hard lane assertions pass."""
+    out: Dict[str, float] = {}
+    ab = doc.get("quant_ab", {})
+    for leg in ("f32", "quant"):
+        d = ab.get(leg, {})
+        for key in ("req_per_sec", "rows_per_sec"):
+            v = d.get(key)
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                out[f"{leg}.{key}"] = float(v)
+        _walk_numbers(f"{leg}.latency_ms", d.get("latency_ms", {}), out)
+    for key in ("speedup", "bytes_ratio"):
+        v = ab.get(key)
+        if isinstance(v, (int, float)) and math.isfinite(v):
+            out[key] = float(v)
+    v = (doc.get("export") or {}).get("agreement")
+    if isinstance(v, (int, float)) and math.isfinite(v):
+        out["agreement"] = float(v)
+    return out
+
+
 FLATTENERS = {"io_bench": flatten_io_bench,
               "serve_bench": flatten_serve_bench,
-              "mesh_parity": flatten_mesh_parity}
+              "mesh_parity": flatten_mesh_parity,
+              "quant_bench": flatten_quant_bench}
 
 
 # ----------------------------------------------------------------------

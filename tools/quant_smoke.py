@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Quantized-inference smoke: train, gated export, serve A/B (QUANT=1).
+
+The CPU-measurable acceptance of the int8 serving path
+(doc/performance.md "Quantized inference"), driven the way an operator
+would:
+
+1. **train** — a real ``task=train`` subprocess fits the MNIST MLP conf
+   for a few rounds and checkpoints it;
+2. **export** — a real ``task=export_quant`` subprocess quantizes it
+   behind the agreement gate; the verdict must be a publish with
+   top-1 agreement >= 0.99 and a >= 3.5x weight-bytes reduction;
+3. **serve A/B** — two in-process engines over the SAME checkpoint
+   (f32 vs the exported int8 artifact) run interleaved closed-loop
+   legs; the quantized leg must not regress beyond the noise band, and
+   the engine's NEW ``serve_weight_bytes`` / ``serve_weight_bytes_f32``
+   registry gauges must show the >= 3.5x ratio (the gauge IS the
+   assertion surface, not a recomputation).
+
+Emits one JSON verdict line on stdout (schema consumed by
+``tools/perf_guard.py --bench quant_bench``); exit 0 iff every
+assertion held.
+
+Usage: python tools/quant_smoke.py [--out DIR] [--requests N]
+       [--concurrency C] [--band B]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+MIN_BYTES_RATIO = 3.5
+MIN_AGREEMENT = 0.99
+
+
+def _run_cli(work: str, conf: str, *args: str) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "cxxnet_tpu", conf, *args],
+        cwd=work, env=env, capture_output=True, text=True,
+    )
+
+
+def _fail(verdict: dict, msg: str) -> None:
+    verdict["ok"] = False
+    verdict["fail"] = msg
+    print(json.dumps(verdict), flush=True)
+    raise SystemExit(1)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="",
+                    help="keep artifacts here (default: temp dir)")
+    ap.add_argument("--rounds", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=60,
+                    help="closed-loop requests per thread per leg")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--rows", type=int, default=4)
+    ap.add_argument("--band", type=float, default=0.2,
+                    help="throughput noise band: quant must reach "
+                         ">= (1-band) * f32")
+    args = ap.parse_args()
+
+    work = args.out or tempfile.mkdtemp(prefix="quant_smoke_")
+    os.makedirs(work, exist_ok=True)
+    from cxxnet_tpu.models import mnist_mlp_conf
+
+    conf_text = mnist_mlp_conf(batch_size=100, synthetic=True, dev="cpu")
+    conf_path = os.path.join(work, "mnist.conf")
+    with open(conf_path, "w", encoding="utf-8") as f:
+        f.write(conf_text)
+        f.write(f"model_dir = models\nnum_round = {args.rounds}\n"
+                f"max_round = {args.rounds}\nseed = 11\nsilent = 1\n")
+
+    verdict: dict = {"ok": True, "work": work}
+
+    # 1. train
+    r = _run_cli(work, "mnist.conf", "task=train")
+    if r.returncode != 0:
+        _fail(verdict, f"train failed: {r.stderr[-1500:]}")
+    model = os.path.join("models", f"{args.rounds:04d}.model")
+    if not os.path.exists(os.path.join(work, model)):
+        _fail(verdict, f"missing checkpoint {model}")
+
+    # 2. gated export
+    r = _run_cli(work, "mnist.conf", "task=export_quant",
+                 f"model_in={model}", "quant_report=quant_verdict.json")
+    if r.returncode != 0:
+        _fail(verdict, f"export_quant exit {r.returncode}: "
+                       f"{(r.stdout + r.stderr)[-1500:]}")
+    with open(os.path.join(work, "quant_verdict.json"),
+              encoding="utf-8") as f:
+        export = json.load(f)
+    verdict["export"] = export
+    if not export["ok"]:
+        _fail(verdict, "export rejected")
+    if export["agreement"] < MIN_AGREEMENT:
+        _fail(verdict, f"agreement {export['agreement']} < "
+                       f"{MIN_AGREEMENT}")
+    if export["bytes_ratio"] < MIN_BYTES_RATIO:
+        _fail(verdict, f"artifact bytes ratio {export['bytes_ratio']:.2f}"
+                       f" < {MIN_BYTES_RATIO}")
+
+    # 3. serve A/B over the trained checkpoint, in process
+    import numpy as np
+
+    from serve_bench import closed_loop
+
+    from cxxnet_tpu import config as cfgmod
+    from cxxnet_tpu import serve
+    from cxxnet_tpu.obs import registry as obs_registry
+
+    cfg = cfgmod.parse_pairs(conf_text)
+    model_abs = os.path.join(work, model)
+    eng_f = serve.Engine(cfg=cfg, model_in=model_abs, max_batch_size=64,
+                         queue_limit=1024)
+    eng_q = serve.Engine(cfg=cfg + [("quant", "int8")],
+                         model_in=model_abs, max_batch_size=64,
+                         queue_limit=1024)
+    try:
+        if eng_q.quant_scheme != "int8":
+            _fail(verdict, "quant engine did not pick up the scheme")
+        if not (eng_q.model_path or "").endswith(".quant.model"):
+            _fail(verdict, "quant engine did not prefer the exported "
+                           "artifact")
+        # the NEW gauges are the assertion surface for the 4x claim:
+        # the quant engine registered last, so the registry holds its
+        # weight-bytes identity
+        snap = obs_registry().snapshot()
+        gauge = snap["serve_weight_bytes"]["serve_weight_bytes"]
+        gauge_f32 = (snap["serve_weight_bytes_f32"]
+                     ["serve_weight_bytes_f32"])
+        verdict["gauge"] = {"serve_weight_bytes": gauge,
+                            "serve_weight_bytes_f32": gauge_f32,
+                            "ratio": gauge_f32 / gauge if gauge else 0.0}
+        if gauge_f32 / max(gauge, 1) < MIN_BYTES_RATIO:
+            _fail(verdict, f"gauge bytes ratio "
+                           f"{gauge_f32 / max(gauge, 1):.2f} < "
+                           f"{MIN_BYTES_RATIO}")
+        x = np.random.RandomState(0).rand(args.rows, 784).astype(
+            np.float32)
+        for _ in range(8):
+            eng_f.predict(x)
+            eng_q.predict(x)
+        f_runs, q_runs = [], []
+        for _ in range(2):  # interleaved best-of-2: drift hits both legs
+            q_runs.append(closed_loop(eng_q, x, args.concurrency,
+                                      args.requests))
+            f_runs.append(closed_loop(eng_f, x, args.concurrency,
+                                      args.requests))
+        f32 = max(f_runs, key=lambda r: r["req_per_sec"])
+        qnt = max(q_runs, key=lambda r: r["req_per_sec"])
+        verdict["quant_ab"] = {
+            "scheme": "int8",
+            "f32": f32,
+            "quant": qnt,
+            "speedup": qnt["req_per_sec"] / f32["req_per_sec"],
+            "bytes_ratio": verdict["gauge"]["ratio"],
+            "band": args.band,
+        }
+        if qnt["req_per_sec"] < (1.0 - args.band) * f32["req_per_sec"]:
+            _fail(verdict,
+                  f"quantized throughput regressed: "
+                  f"{qnt['req_per_sec']:.0f} < (1-{args.band}) * "
+                  f"{f32['req_per_sec']:.0f} req/s")
+    finally:
+        eng_f.close()
+        eng_q.close()
+    print(json.dumps(verdict), flush=True)
+    print(f"# quant_smoke: agreement {export['agreement']:.4f}, weight "
+          f"bytes {verdict['gauge']['ratio']:.2f}x smaller, serve "
+          f"{f32['req_per_sec']:.0f} -> {qnt['req_per_sec']:.0f} req/s "
+          f"(speedup {verdict['quant_ab']['speedup']:.2f})",
+          file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,15 @@
 #                                     # is ONE program (no per-replica
 #                                     # re-jits); verdict JSON appends
 #                                     # to a perf_guard history
+#        QUANT=1 tools/run_tier1.sh   # also run the quantized-inference
+#                                     # smoke: train + gated int8 export
+#                                     # of the MNIST MLP (top-1 agreement
+#                                     # >= 0.99 asserted), serve engine
+#                                     # weight bytes >= 3.5x smaller via
+#                                     # the serve_weight_bytes gauges,
+#                                     # f32-vs-int8 closed-loop serve A/B
+#                                     # (quant leg must not regress), and
+#                                     # a quant_bench perf_guard entry
 #        OBS=1 tools/run_tier1.sh     # also run the observability smoke:
 #                                     # short telemetry=1 train + serve
 #                                     # scrape of /metricsz + /alertz
@@ -104,6 +113,19 @@ if [ "${MESH:-0}" = "1" ]; then
       --input "$mesh_out/mesh_parity.json" \
       --history "$mesh_out/bench_history.jsonl" > /dev/null || rc=1
   echo "MESH lane verdict: $mesh_out/mesh_parity.json"
+fi
+if [ "${QUANT:-0}" = "1" ]; then
+  echo "=== opt-in quantized-inference smoke (QUANT=1) ==="
+  quant_out=/tmp/_quant_smoke
+  rm -rf "$quant_out"; mkdir -p "$quant_out"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python tools/quant_smoke.py --out "$quant_out" \
+      > "$quant_out/verdict.json" || rc=1
+  timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python tools/perf_guard.py --bench quant_bench \
+      --input "$quant_out/verdict.json" \
+      --history "$quant_out/bench_history.jsonl" > /dev/null || rc=1
+  echo "QUANT lane verdict: $quant_out/verdict.json"
 fi
 if [ "${OBS:-0}" = "1" ]; then
   echo "=== opt-in observability smoke (OBS=1) ==="

@@ -37,7 +37,12 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_engine(args):
+def build_engine(args, scheme: str = ""):
+    """Engine + request tensor over the builder conf.  ``scheme``
+    quantizes the trainer's kernels in place (per-channel int8 +
+    folded rescale, ``nnet/quant.py``) before the engine wraps it —
+    the quant leg of the A/B serves the SAME conf and seed at reduced
+    precision, through the identical construction path."""
     from cxxnet_tpu import config as cfgmod
     from cxxnet_tpu import serve
     from cxxnet_tpu.models import MODEL_BUILDERS
@@ -49,6 +54,10 @@ def build_engine(args):
     tr = NetTrainer()
     tr.set_params(cfgmod.parse_pairs(conf))
     tr.init_model()
+    if scheme:
+        from cxxnet_tpu.nnet import quant as nquant
+
+        nquant.apply_plan(tr, nquant.build_plan(tr, scheme), scheme)
     eng = serve.Engine(
         trainer=tr,
         max_batch_size=args.max_batch,
@@ -150,6 +159,49 @@ def open_loop(eng, x, rate, duration):
             "p95": lat[min(n - 1, int(n * 0.95))] * 1e3,
             "p99": lat[min(n - 1, int(n * 0.99))] * 1e3,
         }
+    return out
+
+
+def run_quant_ab(args) -> dict:
+    """f32-vs-quantized serving A/B (the QUANT lane's measurement and
+    the TPU-queue entry): interleaved closed-loop legs — best-of-2 per
+    side, back to back, so machine-load drift hits both equally (the
+    autotune discipline) — plus the weight-bytes identity both engines
+    report."""
+    from cxxnet_tpu.ops import quant as opsq
+
+    eng_f, x = build_engine(args)
+    eng_q, _ = build_engine(args, scheme=args.quant)
+    for _ in range(8):
+        eng_f.predict(x)
+        eng_q.predict(x)
+    half = max(8, args.requests // 2)
+    f_runs, q_runs = [], []
+    for _ in range(2):
+        q_runs.append(closed_loop(eng_q, x, args.concurrency, half))
+        f_runs.append(closed_loop(eng_f, x, args.concurrency, half))
+    f32 = max(f_runs, key=lambda r: r["req_per_sec"])
+    qnt = max(q_runs, key=lambda r: r["req_per_sec"])
+    wb_f, _ = opsq.weight_bytes(eng_f.trainer.params)
+    wb_q, wb_q32 = opsq.weight_bytes(eng_q.trainer.params)
+    out = {
+        "model": args.model,
+        "dev": args.dev,
+        "rows_per_request": args.rows,
+        "max_batch_size": args.max_batch,
+        "quant_ab": {
+            "scheme": args.quant,
+            "f32": f32,
+            "quant": qnt,
+            "speedup": (qnt["req_per_sec"] / f32["req_per_sec"]
+                        if f32["req_per_sec"] > 0 else 0.0),
+            "weight_bytes_f32": wb_f,
+            "weight_bytes_quant": wb_q,
+            "bytes_ratio": (wb_q32 / wb_q) if wb_q else 0.0,
+        },
+    }
+    eng_f.close()
+    eng_q.close()
     return out
 
 
@@ -293,6 +345,9 @@ def main(argv=None):
     ap.add_argument("--open-duration", type=float, default=3.0)
     ap.add_argument("--json", dest="json_path", default="",
                     help="also write the JSON report here")
+    ap.add_argument("--quant", default="",
+                    help="run the f32-vs-quantized A/B at this scheme "
+                         "(int8|bf16) instead of the plain bench")
     ap.add_argument("--autotune", action="store_true",
                     help="bad-knobs recovery via the tune controller "
                          "(TUNE=1 lane); exits 1 below --recovery")
@@ -302,6 +357,24 @@ def main(argv=None):
     ap.add_argument("--recovery", type=float, default=0.9,
                     help="autotune pass bar vs the hand-tuned rate")
     args = ap.parse_args(argv)
+
+    if args.quant:
+        result = run_quant_ab(args)
+        ab = result["quant_ab"]
+        print(json.dumps(result, indent=1))
+        if args.json_path:
+            with open(args.json_path, "w", encoding="utf-8") as f:
+                json.dump(result, f, indent=1)
+        # the bench[...] spelling is what the TPU queue's relay-log grep
+        # keeps (tools/tpu_queue.sh) — one self-contained verdict line
+        print(f"bench[quant_ab:{args.model}] f32 "
+              f"{ab['f32']['req_per_sec']:.1f} req/s vs {ab['scheme']} "
+              f"{ab['quant']['req_per_sec']:.1f} req/s speedup "
+              f"{ab['speedup']:.3f} bytes_ratio {ab['bytes_ratio']:.2f} "
+              f"p99 {ab['f32']['latency_ms']['p99']:.2f} -> "
+              f"{ab['quant']['latency_ms']['p99']:.2f} ms",
+              flush=True)
+        return 0
 
     if args.autotune:
         result = run_autotune(args)

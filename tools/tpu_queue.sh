@@ -96,6 +96,14 @@ sweep() {
   else
     echo "=== SKIP (deadline): tpu_train_e2e ==="
   fi
+  # quantized serving A/B (ROADMAP item 3 / PR 10): int8-weight predict
+  # programs vs f32 on the serve engine — the on-chip confirmation of
+  # the CPU-measured weight-bytes win (doc/performance.md "Quantized
+  # inference"); bembed is default-on for these inference builds
+  run 900 python tools/serve_bench.py --model googlenet --dev tpu \
+    --quant int8 --max-batch 128 --rows 8 --requests 100
+  run 600 python tools/serve_bench.py --model mnist_mlp --dev tpu \
+    --quant int8 --requests 200
   # TPU-backend HLO fusion audit (compile-only; doc/performance.md)
   run 900 python tools/hlo_inspect.py googlenet 128
   run 900 python tools/hlo_inspect.py googlenet 128 conv_branch_embed=1

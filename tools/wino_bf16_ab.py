@@ -1,4 +1,4 @@
-"""bf16 Winograd convergence A/B (CPU, relay-independent).
+"""bf16 Winograd + branch-embed A/B (CPU, relay-independent).
 
 The F(4x4,3x3) tile's transform constants reach |8|, amplifying bf16
 rounding ~15x vs the direct conv (``cxxnet_tpu/layers/conv.py`` — the
@@ -17,7 +17,18 @@ Two model-scale probes, all under ``compute_dtype = bfloat16``:
   discipline): steps until eval error hits 0 — a deep-net gradient-path
   sanity check with 3x3 branches on the Winograd path.
 
-Usage:  python tools/wino_bf16_ab.py [--digits-only|--googlenet-only]
+A third probe (``--bembed-only``) records the CPU half of the
+branch-embedding promotion verdict (PR 10 flipped
+``conv_branch_embed`` to auto: ON for inference program builds): on
+the GoogLeNet builder conf it measures fused-vs-unfused EXACTNESS of
+the inference forward (max |score delta| + top-1 flips over random
+batches) and the CPU predict throughput delta.  PROMOTE requires
+zero top-1 flips and throughput inside a 10% band; the on-chip
+step-time A/B for the train side stays queued in ``tpu_queue.sh``
+(``googlenet_bisect.py bembed``).
+
+Usage:  python tools/wino_bf16_ab.py
+        [--digits-only|--googlenet-only|--bembed-only]
 Writes: example/MNIST/wino_bf16_ab.log (the committed artifact).
 """
 
@@ -156,6 +167,66 @@ def run_googlenet(out) -> None:
     out("")
 
 
+def run_bembed(out) -> None:
+    """CPU promote/reject evidence for inference-build branch-embed:
+    exactness (top-1 flips must be 0) + predict-throughput band."""
+    import numpy as np
+
+    from cxxnet_tpu import config as C
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.models import googlenet_conf
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    def build(bembed: str):
+        tr = NetTrainer()
+        tr.set_params(C.parse_pairs(googlenet_conf(
+            batch_size=8, num_class=10, synthetic=False, dev="cpu",
+            input_size=64)))
+        tr.set_param("conv_branch_embed", bembed)
+        tr.set_param("seed", "7")
+        tr.init_model()
+        return tr
+
+    t_off, t_on = build("0"), build("1")
+    rng = np.random.RandomState(0)
+    flips = 0
+    max_dd = 0.0
+    rates = {}
+    for name, tr in (("unfused", t_off), ("fused", t_on)):
+        b = DataBatch(data=rng.rand(8, 64, 64, 3).astype(np.float32),
+                      label=np.zeros((8, 1), np.float32))
+        tr.predict(b)  # warm the compile
+    for k in range(6):
+        x = rng.rand(8, 64, 64, 3).astype(np.float32)
+        b = DataBatch(data=x, label=np.zeros((8, 1), np.float32))
+        s_off = t_off.extract_feature(b, "top[-1]")
+        s_on = t_on.extract_feature(b, "top[-1]")
+        max_dd = max(max_dd, float(np.abs(s_off - s_on).max()))
+        flips += int((s_off.argmax(1) != s_on.argmax(1)).sum())
+    for name, tr in (("unfused", t_off), ("fused", t_on)):
+        b = DataBatch(data=rng.rand(8, 64, 64, 3).astype(np.float32),
+                      label=np.zeros((8, 1), np.float32))
+        t0 = time.time()
+        n = 0
+        while time.time() - t0 < 5.0:
+            tr.predict(b)
+            n += 8
+        rates[name] = n / (time.time() - t0)
+    ratio = rates["fused"] / rates["unfused"]
+    verdict = ("PROMOTE" if flips == 0 and ratio >= 0.9 else "REJECT")
+    out("branch-embed inference A/B (GoogLeNet 64px b8, CPU)")
+    out(f"  top-1 flips over 48 rows: {flips}; max |score delta| "
+        f"{max_dd:.2e}")
+    out(f"  predict rows/s unfused {rates['unfused']:.1f} -> fused "
+        f"{rates['fused']:.1f} (ratio {ratio:.3f})")
+    out(f"  CPU-backend verdict: {verdict} (exactness + 10% band) — "
+        "the conv_branch_embed=-1 auto default follows it: fused "
+        "inference builds on accelerator backends only, never on "
+        "CPU; the on-chip confirmation stays queued "
+        "(googlenet_bisect.py bembed / serve_bench --quant)")
+    out("")
+
+
 def main() -> None:
     lines = []
 
@@ -163,11 +234,14 @@ def main() -> None:
         print(s, flush=True)
         lines.append(s)
 
+    only = [a for a in sys.argv[1:] if a.endswith("-only")]
     out(f"# wino_bf16_ab @ {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}")
-    if "--googlenet-only" not in sys.argv:
+    if not only or "--digits-only" in only:
         run_digits(out)
-    if "--digits-only" not in sys.argv:
+    if not only or "--googlenet-only" in only:
         run_googlenet(out)
+    if not only or "--bembed-only" in only:
+        run_bembed(out)
     # append: split --digits-only / --googlenet-only invocations build
     # one log; the timestamp header delimits runs
     with open(LOG_PATH, "a") as f:
