@@ -100,6 +100,17 @@ class LearnTask:
         self.quant_calib_batches = 0  # 0 = the whole eval set
         self.quant_out = ""  # artifact path override
         self.quant_report = ""  # also write the verdict JSON here
+        # elastic pod (doc/parallel.md "Elastic pod"): parsed once in
+        # run() from the elastic_* / collective_timeout_s keys
+        self.elastic_opts = None
+        self.elastic_member = None
+        self._elastic_joined = False
+        self._elastic_left = False
+        self._elastic_drop_done = False
+        self._elastic_rebuilds = 0
+        self._elastic_consec_recoveries = 0
+        self._elastic_attempted_gen = 0
+        self._elastic_last_rebuild_s = 0.0
         self.cfg: List[tuple] = []
 
     # ------------------------------------------------------------------
@@ -237,7 +248,9 @@ class LearnTask:
         # the distributed-PS replacement (SURVEY §2.8): bigger mesh, same
         # SPMD program, collectives over ICI/DCN
         from .parallel import maybe_init_distributed
+        from .parallel.elastic import ElasticOptions
 
+        self.elastic_opts = ElasticOptions.from_cfg(self.cfg)
         maybe_init_distributed(self.cfg)
         # arm the chaos harness (no-op without fault_inject keys); the
         # instrumented sites live in io/, utils/checkpoint.py and serve/
@@ -258,7 +271,15 @@ class LearnTask:
                              "extract", "generate", "summary", "serve",
                              "serve_train", "export_quant"):
             raise ValueError(f"unknown task {self.task!r}")
-        self.init()
+        if self.elastic_opts.join:
+            # a rejoining process has no mesh yet: admission, backend
+            # init, model load and iterators all happen inside
+            # task_train (_elastic_join_setup) once the coordinator
+            # grows the pod
+            if self.task != "train":
+                raise ValueError("elastic_join=1 only supports task=train")
+        else:
+            self.init()
         if not self.silent:
             print("initializing end, start working")
         if self.task == "export_quant":
@@ -660,13 +681,371 @@ class LearnTask:
             flush=True,
         )
 
+    # ------------------------------------------------------------------
+    # elastic pod (doc/parallel.md "Elastic pod"): survive replica loss
+    # and resize the mesh mid-run, inside ONE CLI invocation
+    def _elastic_setup(self) -> None:
+        """Arm the peer-liveness layer: rank 0 hosts the membership
+        coordinator; every rank heartbeats it.  No-op unless
+        ``elastic = 1`` on a real multi-process job."""
+        opts = self.elastic_opts
+        if not opts.elastic:
+            return
+        from .parallel import elastic as par_elastic
+        from .parallel.distributed import distributed_spec, process_info
+
+        spec = distributed_spec(self.cfg)
+        if spec is None or process_info()[1] == 1:
+            return  # single process: nothing to monitor
+        coord, num, pid = spec
+        addr = opts.resolve_coordinator(coord)
+        self.elastic_member = par_elastic.ElasticMember(
+            addr, pid, opts, host_coordinator=(pid == 0), num=num,
+            jax_host=coord.rsplit(":", 1)[0],
+        ).start()
+        if not self.silent:
+            print(f"elastic: liveness monitor armed (coordinator "
+                  f"{self.elastic_member.addr}, heartbeat "
+                  f"{opts.heartbeat_s:g}s, replica timeout "
+                  f"{opts.timeout_s:g}s, collective deadline "
+                  f"{opts.collective_timeout_s:g}s)", flush=True)
+
+    def _elastic_join_setup(self) -> None:
+        """``elastic_join = 1``: this process has NO mesh yet.  Announce
+        to the coordinator, wait for a grow generation to assign a rank
+        (``elastic_rejoin_s`` bounds the wait), join the re-init
+        rendezvous, load the consensus checkpoint and shard the
+        iterators — then fall straight into the round loop beside the
+        survivors."""
+        opts = self.elastic_opts
+        from .parallel import elastic as par_elastic
+        from .parallel.distributed import init_distributed
+
+        if not opts.coordinator:
+            raise ValueError(
+                "elastic_join=1 needs elastic_coordinator=host:port "
+                "(the running job's membership coordinator)")
+        m = par_elastic.ElasticMember(opts.coordinator, -1, opts)
+        print(f"elastic: waiting to join the mesh via {opts.coordinator}"
+              f" (up to {opts.rejoin_s:g}s"
+              + (f", at round {opts.join_at}" if opts.join_at else "")
+              + ")", flush=True)
+        plan = m.join()
+        if plan.rank is None:
+            raise RuntimeError("elastic join: admitted without a rank")
+        print(f"elastic: admitted as rank {plan.rank}/{plan.num} "
+              f"(generation {plan.generation})", flush=True)
+        self._set_cfg_entries({
+            "dist_coordinator": plan.jax_coordinator,
+            "dist_num_proc": str(plan.num),
+            "dist_proc_id": str(plan.rank),
+        })
+        # heartbeat BEFORE the blocking rendezvous: the coordinator
+        # registered this member at plan time, and a slow survivor
+        # teardown must not get the joiner evicted mid-admission
+        m.rank = plan.rank
+        m.generation = plan.generation
+        m.start()
+        init_distributed(plan.jax_coordinator, plan.num, plan.rank,
+                         resilient=True)
+        m.ack_generation(plan, rank=plan.rank)
+        self.elastic_member = m
+        self.continue_training = 1
+        if not self._sync_latest_model():
+            raise FileNotFoundError(
+                "elastic join: no checkpoint in model_dir to sync from")
+        self._create_iterators()
+        self._elastic_joined = True
+
+    def _elastic_guard(self, fn, what: str):
+        """Collective deadline: a dead peer surfaces as
+        ``ReplicaLossError`` within ``collective_timeout_s`` instead of
+        hanging this rank inside a collective forever."""
+        if self.elastic_member is None:
+            return fn()
+        from .parallel import elastic as par_elastic
+
+        return par_elastic.guarded_call(
+            fn, self.elastic_member,
+            timeout_s=self.elastic_opts.collective_timeout_s, what=what)
+
+    def _elastic_recover(self, exc: BaseException) -> bool:
+        """Classify a round/checkpoint failure: replica loss → rebuild
+        onto the survivors and return True (the caller re-enters the
+        loop); anything else → False (the error propagates)."""
+        if self.elastic_member is None:
+            return False
+        from .parallel import elastic as par_elastic
+
+        loss = par_elastic.classify_failure(
+            exc, self.elastic_member,
+            confirm_s=self.elastic_opts.timeout_s + 2.0)
+        if loss is None:
+            return False
+        min_gen = 0
+        while True:
+            if loss.fatal:
+                print(f"elastic: unrecoverable replica loss: {loss}",
+                      flush=True)
+                return False
+            # a persistent NON-replica error misclassified as loss
+            # would otherwise rebuild forever (the caller refunds the
+            # round budget) — bound consecutive recoveries; a
+            # completed round resets the counter
+            self._elastic_consec_recoveries += 1
+            if self._elastic_consec_recoveries > 5:
+                print("elastic: giving up after "
+                      f"{self._elastic_consec_recoveries - 1} "
+                      "consecutive rebuilds without completing a "
+                      "round", flush=True)
+                return False
+            print(f"elastic: replica loss detected ({loss})", flush=True)
+            try:
+                self._elastic_rebuild("replica_lost",
+                                      min_generation=min_gen)
+                return True
+            except par_elastic.ReplicaLossError as again:
+                # a SECOND replica died during the rebuild's own
+                # collectives: wait for the next generation and retry
+                # (survivors above quorum must not give up)
+                loss = again
+            except Exception as again:  # noqa: BLE001 - classify
+                loss = par_elastic.classify_failure(
+                    again, self.elastic_member,
+                    confirm_s=self.elastic_opts.timeout_s + 2.0)
+                if loss is None:
+                    raise
+            min_gen = self._elastic_attempted_gen + 1
+
+    def _elastic_boundary(self) -> bool:
+        """Planned mesh transitions at the round boundary (the
+        consensus checkpoint for this boundary is already durable).
+        Returns True when the mesh changed (rebuilt, or this rank
+        left)."""
+        m = self.elastic_member
+        opts = self.elastic_opts
+        m.poll_now()  # synchronous beat: every rank reads the same state
+        plan = m.pending_plan()
+        if plan is not None and plan.at_round is None:
+            # a replica died while this rank sat at the boundary (its
+            # own collectives all completed) — rebuild without waiting
+            # to trip over the corpse inside the next round
+            print(f"elastic: replica loss at round boundary "
+                  f"(generation {plan.generation})", flush=True)
+            self._elastic_rebuild("replica_lost", plan=plan)
+            return True
+        r = self.start_counter
+        if (opts.drop_at and r >= opts.drop_at
+                and not self._elastic_drop_done):
+            # >= + latch: a boundary whose RPC failed retries at the
+            # next round instead of silently skipping the drop forever
+            plan = m.plan_shrink(r)
+            self._elastic_drop_done = True
+            if plan.rank is None:
+                self._elastic_left = True
+                return True
+            self._elastic_rebuild(plan.reason, plan=plan)
+            return True
+        g = m.grow_round()
+        if g is not None and r >= g:
+            plan = m.plan_grow(r)
+            if plan is None:
+                return False  # every waiter abandoned the join
+            self._elastic_rebuild("grow", plan=plan)
+            return True
+        return False
+
+    def _await_plan(self, min_generation: int = 0):
+        """Block (briefly) until the coordinator's generation plan for a
+        detected loss arrives over the heartbeat channel.
+        ``min_generation`` skips a stale plan from a rebuild attempt
+        that itself died (a second loss mid-rebuild)."""
+        import time as _time
+
+        m = self.elastic_member
+        deadline = _time.monotonic() + self.elastic_opts.timeout_s * 2 + 5
+        while _time.monotonic() < deadline:
+            p = m.pending_plan()
+            if p is not None and p.generation >= min_generation:
+                return p
+            try:
+                m.poll_now()
+            except (OSError, ValueError, RuntimeError):
+                pass
+            _time.sleep(0.1)
+        return None
+
+    def _set_cfg_entries(self, updates: dict) -> None:
+        """Rewrite config entries in place (the rebuilt generation's
+        dist_* identity) so anything re-reading the cfg stream agrees
+        with the live mesh."""
+        out, seen = [], set()
+        for n, v in self.cfg:
+            if n in updates:
+                if n in seen:
+                    continue  # collapse duplicates to one entry
+                out.append((n, updates[n]))
+                seen.add(n)
+            else:
+                out.append((n, v))
+        for n, v in updates.items():
+            if n not in seen:
+                out.append((n, v))
+        self.cfg = out
+
+    def _elastic_rebuild(self, reason: str, plan=None,
+                         min_generation: int = 0) -> None:
+        """Checkpoint-consensus rebuild onto the new process set, inside
+        this CLI invocation: tear down the distributed backend, re-init
+        on the plan's fresh coordinator, re-load the agreed round via
+        the PR-1 consensus machinery (state re-places onto the CURRENT
+        mesh through the eager ``_place_state`` + PR-9 cross-mesh
+        reshard), and re-shard the iterators; ``start_counter`` rewinds
+        to the consensus round + 1, and the deterministic augmentation
+        stream (``RecordRNG`` + ``dist_shard = block``) makes the
+        resumed stream exact."""
+        import gc
+
+        from .obs import emit as obs_emit
+        from .parallel import elastic as par_elastic
+        from .parallel.distributed import (
+            init_distributed, shutdown_distributed,
+        )
+        from .utils import checkpoint as ckpt
+
+        m = self.elastic_member
+        t0 = time.time()
+        par_elastic.set_rebuilding(True)
+        try:
+            plan = plan or self._await_plan(min_generation)
+            if plan is None:
+                raise par_elastic.ReplicaLossError(
+                    "elastic: replica loss with no generation plan "
+                    "(membership coordinator unreachable?)", fatal=True)
+            if plan.abort:
+                raise par_elastic.ReplicaLossError(
+                    f"elastic: cannot continue: {plan.abort}", fatal=True)
+            self._elastic_attempted_gen = plan.generation
+            obs_emit("mesh.rebuild_start", reason=reason,
+                     generation=plan.generation, num=plan.num,
+                     rank=plan.rank, round=self.start_counter)
+            print(f"elastic: {reason} -> rebuilding the mesh as "
+                  f"{plan.num} process(es), this rank becomes "
+                  f"{plan.rank} (generation {plan.generation})",
+                  flush=True)
+            # a guarded worker may still be wedged in a dead collective:
+            # give it a grace to error out before the backend dies under it
+            t = par_elastic.guarded_call.last_thread
+            if t is not None and t.is_alive():
+                t.join(timeout=min(
+                    self.elastic_opts.collective_timeout_s, 10.0))
+                if t.is_alive():
+                    obs_emit("mesh.guard_thread_abandoned", what=reason)
+            # drop every reference into the old backend before it dies
+            for it in [self.itr_train, *self.itr_evals]:
+                if it is not None:
+                    try:
+                        it.close()
+                    except Exception:  # noqa: BLE001 - teardown
+                        pass
+            self.itr_train = None
+            self.itr_evals = []
+            self.eval_names = []
+            self.net_trainer = None
+            gc.collect()
+            # zero-RPC teardown: a shutdown barrier can never complete
+            # with a dead peer, and its failure broadcast would kill
+            # the surviving clients — abandon, don't negotiate
+            shutdown_distributed(graceful=False)
+            self._set_cfg_entries({
+                "dist_coordinator": plan.jax_coordinator,
+                "dist_num_proc": str(plan.num),
+                "dist_proc_id": str(plan.rank),
+            })
+            # the rebuild rendezvous: initialize blocks until every
+            # member of the new generation connects
+            init_distributed(plan.jax_coordinator, plan.num, plan.rank,
+                             resilient=True)
+            m.ack_generation(plan, rank=plan.rank)
+            # round consensus + reload on the NEW process set; the
+            # iterator re-shards for the new rank/process count
+            if not self._sync_latest_model():
+                raise ckpt.CheckpointError(
+                    "elastic: no valid checkpoint any survivor can "
+                    "load — cannot rebuild")
+            self._create_iterators()
+            dt = time.time() - t0
+            self._elastic_rebuilds += 1
+            self._elastic_last_rebuild_s = dt
+            try:
+                from .obs.registry import registry as obs_registry
+
+                obs_registry().counter(
+                    "mesh_rebuilds_total",
+                    "Elastic mesh rebuilds by trigger.",
+                    labelnames=("reason",),
+                ).labels(reason=reason).inc()
+                obs_registry().gauge(
+                    "mesh_rebuild_seconds",
+                    "Wall time of the last elastic mesh rebuild.",
+                ).set(dt)
+            except Exception:  # noqa: BLE001 - telemetry never aborts
+                pass
+            obs_emit("mesh.rebuild_done", reason=reason,
+                     generation=plan.generation, num=plan.num,
+                     rank=plan.rank, wall_s=round(dt, 3),
+                     resume_round=self.start_counter)
+            self._print_mesh_summary()
+            print(f"elastic: rebuilt in {dt:.2f}s; resuming at round "
+                  f"{self.start_counter} on {plan.num} process(es)",
+                  flush=True)
+        finally:
+            par_elastic.set_rebuilding(False)
+
+    def _elastic_quiet_teardown(self) -> None:
+        """End-of-task teardown for elastic runs: drop the resilient
+        coordination client BEFORE interpreter exit destructs the
+        in-process coordination service — a client that outlives its
+        service sees the socket close as a fatal error and aborts the
+        process.  Zero-RPC (graceful=False); rank 0 lingers briefly so
+        every peer's client is gone before its exit closes the live
+        service's socket."""
+        from .parallel.distributed import (
+            distributed_initialized, shutdown_distributed,
+        )
+
+        m, self.elastic_member = self.elastic_member, None
+        if m is None:
+            return
+        try:
+            m.close()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+        if distributed_initialized():
+            shutdown_distributed(graceful=False)
+        if m.coordinator is not None:  # this process is rank 0
+            time.sleep(2.0)
+
     def task_train(self) -> None:
         from .parallel.distributed import any_process_flag, process_info
         from .utils.checkpoint import DivergenceError, PreemptionHandler
 
         self._train_start = time.time()
-        if self.continue_training == 0 and self.name_model_in == "NULL":
-            self._save_model()
+        if self.elastic_opts is None:  # task_train without run()
+            from .parallel.elastic import ElasticOptions
+
+            self.elastic_opts = ElasticOptions.from_cfg(self.cfg)
+        if self.elastic_opts.join:
+            self._elastic_join_setup()
+        else:
+            self._elastic_setup()
+        if self._elastic_joined:
+            # a rejoined process enters the round loop directly: the
+            # survivors are already mid-loop, so any extra collective
+            # here (initial eval / checkpoint) would deadlock the mesh
+            pass
+        elif self.continue_training == 0 and self.name_model_in == "NULL":
+            self._elastic_guard(self._save_model, what="initial checkpoint")
         else:
             for it, nm in zip(self.itr_evals, self.eval_names):
                 sys.stderr.write(self.net_trainer.evaluate(it, nm))
@@ -689,7 +1068,6 @@ class LearnTask:
         self._global_step = 0
         self._divergence_retries = 0
         self._lr_scale = 1.0
-        nproc = process_info()[1]
         # SIGTERM/SIGINT → finish the current step, snapshot, exit clean.
         # Single-process runs stop at the next BATCH boundary; multi-
         # process runs stop at the next ROUND boundary (the per-batch
@@ -703,17 +1081,28 @@ class LearnTask:
             cc = self.max_round
             while self.start_counter <= self.num_round and cc > 0:
                 cc -= 1
+                if self.elastic_member is not None:
+                    self.elastic_member.report_round(self.start_counter)
                 try:
                     with obs_trace.span("train.round",
                                         round=self.start_counter):
-                        completed = self._train_one_round(timer, tracer)
+                        completed = self._elastic_guard(
+                            lambda: self._train_one_round(timer, tracer),
+                            what=f"train round {self.start_counter}",
+                        )
                 except DivergenceError as e:
                     if self._handle_divergence(e):
                         cc += 1  # the aborted attempt keeps its budget
                         continue
                     tracer.close()
                     raise
+                except Exception as e:  # noqa: BLE001 - replica loss?
+                    if self._elastic_recover(e):
+                        cc += 1  # the aborted round is re-run
+                        continue
+                    raise
                 self._divergence_retries = 0
+                self._elastic_consec_recoveries = 0  # a round completed
                 if not completed:  # preempted mid-round (single-process)
                     snapshotted = self._save_model(force=True)
                     preempted = True
@@ -721,18 +1110,57 @@ class LearnTask:
                 # boundary preemption check (collective in multi-process
                 # runs): force the snapshot past the save_model period
                 # gate so the preempted state is never lost
-                stop = (self._preempt.requested if nproc == 1
-                        else any_process_flag(self._preempt.requested))
-                snapshotted = self._save_model(force=stop)
+                try:
+                    stop = (
+                        self._preempt.requested
+                        if process_info()[1] == 1
+                        else self._elastic_guard(
+                            lambda: any_process_flag(
+                                self._preempt.requested),
+                            what="preemption sync"))
+                    snapshotted = self._elastic_guard(
+                        lambda: self._save_model(force=stop),
+                        what="checkpoint save")
+                except Exception as e:  # noqa: BLE001 - replica loss?
+                    if self._elastic_recover(e):
+                        continue  # the round completed; only re-sync
+                    raise
                 if stop:
                     preempted = True
                     break
+                # planned mesh transitions land at the round boundary,
+                # AFTER the consensus checkpoint is durable.  A
+                # transient coordinator RPC failure must not kill a
+                # survivor — skip this boundary and retry at the next
+                # (the drop/grow latches re-fire until handled)
+                if (self.elastic_member is not None
+                        and self.start_counter <= self.num_round):
+                    try:
+                        changed = self._elastic_boundary()
+                    except (OSError, ValueError, RuntimeError) as e:
+                        obs_emit("mesh.boundary_rpc_failed",
+                                 round=self.start_counter, error=str(e))
+                        changed = False
+                    if changed:
+                        if self._elastic_left:
+                            break  # this rank left (planned shrink)
+                        continue  # rebuilt onto the new mesh
         finally:
             if tuner is not None:
                 tuner.stop()
             self._preempt.uninstall()
+            if self.elastic_member is not None:
+                self._elastic_quiet_teardown()
         tracer.close()
         obs_trace.tracer().flush_window(self._global_step)
+        if self._elastic_left:
+            obs_emit("mesh.left", round=self.start_counter)
+            print(
+                f"elastic: this rank left the mesh at round "
+                f"{self.start_counter} (planned shrink); exiting clean",
+                flush=True,
+            )
+            return
         if preempted:
             last = self.start_counter - 1
             obs_emit("train.preempted", round=last,
@@ -1124,6 +1552,17 @@ class LearnTask:
             # round deltas are computable between records
             "device": obs_device.summary(),
         }
+        if self.elastic_member is not None or self._elastic_rebuilds:
+            from .parallel.distributed import process_info as _pinfo
+
+            record["elastic"] = {
+                "rebuilds": self._elastic_rebuilds,
+                "last_rebuild_s": round(self._elastic_last_rebuild_s, 3),
+                "processes": _pinfo()[1],
+                "generation": (self.elastic_member.generation
+                               if self.elastic_member is not None
+                               else None),
+            }
         try:
             d = os.path.dirname(self.telemetry_path)
             if d:
@@ -1485,7 +1924,36 @@ class LearnTask:
         print(f"finished prediction, write into {self.name_pred}")
 
 
+def _hard_exit_if_resilient(rc: int) -> None:
+    """Elastic runs built coordination clients whose error-poll threads
+    cannot be stopped from Python; interpreter-exit destructor order
+    (leaked generation services vs zombie pollers) would abort the
+    process AFTER all real work succeeded.  Flush and hard-exit
+    instead — every artifact this process writes (checkpoints,
+    manifests, telemetry, events) is flushed/fsynced at write time."""
+    from .parallel.distributed import resilient_client_used
+
+    if resilient_client_used():
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(rc)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
-    return LearnTask().run(argv)
+    try:
+        rc = LearnTask().run(argv)
+    except SystemExit:
+        raise
+    except BaseException:
+        import traceback
+
+        from .parallel.distributed import resilient_client_used
+
+        if resilient_client_used():
+            traceback.print_exc()
+            _hard_exit_if_resilient(1)
+        raise
+    _hard_exit_if_resilient(rc)
+    return rc

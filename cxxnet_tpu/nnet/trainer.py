@@ -70,6 +70,7 @@ class NetTrainer:
         self.model_parallel = 1
         self.update_on_server = 0
         self.zero = 0
+        self.det_reduce = 0
         self.save_ustate = 0
         self.divergence_policy = ""  # "" off | "abort" | "rollback"
         self.inject_nan_step = -1  # fault-injection hook (tests only)
@@ -111,6 +112,16 @@ class NetTrainer:
             # reference: SGD runs on the PS (nnet_ps_server.cpp); here the
             # optimizer state is ZeRO-1-sharded over the data axis instead
             self.update_on_server = int(val)
+        elif name == "det_reduce":
+            # pin the cross-replica gradient-reduction ORDER (elastic
+            # pods, doc/parallel.md): the fused step's reduction is
+            # re-expressed with shard_map — per-shard partial gradients
+            # all-gathered and folded in fixed shard order — so the
+            # summed bits depend only on the data-axis size, never on
+            # the collectives implementation or process layout
+            if int(val) not in (0, 1):
+                raise ValueError(f"det_reduce={val}: must be 0 or 1")
+            self.det_reduce = int(val)
         elif name == "compile_cache_dir":
             # persistent XLA compilation cache: restarts/reloads reuse
             # compiled programs instead of re-jitting (utils/compile_cache)
@@ -255,6 +266,7 @@ class NetTrainer:
         self._rng_key, sub = jax.random.split(self._rng_key)
         self.params = self.net.init_params(sub, self.batch_size)
         self.aux = self.net.init_aux(self.batch_size)
+        self._validate_det_reduce()
         self._build_updaters()
         self.epoch_counter = 0
         self.sample_counter = 0
@@ -430,6 +442,105 @@ class NetTrainer:
             return None
         return lambda shape: plan.state_sharding(shape)
 
+    def _det_active(self) -> bool:
+        """Is the pinned-order (shard_map) reduction in effect?  On a
+        1-device mesh there is no cross-replica reduction to pin, so
+        the key is a documented no-op there."""
+        return bool(self.det_reduce and self.mesh_plan is not None
+                    and self.mesh_plan.n_devices > 1)
+
+    def _validate_det_reduce(self) -> None:
+        """``det_reduce = 1`` constraints, checked at model build time.
+
+        The shard_map step runs the forward per data shard, so it
+        supports exactly the shapes whose math is row-separable: pure
+        data parallelism (no model axis), replicated state (no ZeRO
+        annotations inside the manual region), no extra-data nodes, no
+        cross-batch aux state (BN running stats would silently become
+        per-shard statistics), and the fused single-update path."""
+        if not self._det_active():
+            return
+        problems = []
+        if self.mesh_plan.n_model != 1:
+            problems.append(f"model_parallel={self.mesh_plan.n_model} "
+                            "(needs pure data parallelism)")
+        if self.zero or self.update_on_server:
+            problems.append(f"zero={self.zero} (needs replicated state)")
+        if self.update_period != 1:
+            problems.append(f"update_period={self.update_period} "
+                            "(needs the fused single-update step)")
+        if self._n_extras():
+            problems.append("extra data nodes")
+        if self.aux:
+            problems.append("aux (batch-norm style) layer state — "
+                            "per-shard batch statistics would diverge")
+        stochastic = sorted({
+            spec.type_name for spec in self.graph.layers
+            if spec.type_name in ("dropout", "insanity",
+                                  "insanity_max_pooling")
+        })
+        if stochastic:
+            # the shard_map region replicates the rng across shards, so
+            # every shard would draw the SAME noise pattern for its
+            # rows — silently different stochasticity than the global
+            # draw of the default step, varying with mesh size
+            problems.append(
+                f"stochastic layers {stochastic} (per-shard rng would "
+                "correlate noise masks across data shards)")
+        if problems:
+            raise ValueError(
+                "det_reduce=1 is incompatible with: "
+                + "; ".join(problems)
+                + " (doc/parallel.md 'Determinism contract')")
+
+    def _det_grad_fn(self):
+        """The shard_map re-expression of the step's cross-replica
+        gradient reduction (SNIPPETS.md [3] is the pattern): each data
+        shard computes the gradient of ITS rows' summed loss, the
+        partials are all-gathered over the ``data`` axis, and the
+        global gradient is an explicitly ORDERED fold over shard index
+        — ``((g0 + g1) + g2) + ...`` unrolled at trace time — so the
+        reduction order (and therefore every result bit) is pinned by
+        the data-axis size alone, independent of the collectives
+        implementation, process layout, or partitioner mood.  The loss
+        layers already sum (not average) over rows, so the fold IS the
+        global gradient with no renormalization."""
+        net = self.net
+        plan = self.mesh_plan
+        n = plan.n_data
+        out_idx = net.out_node_index()
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def per_shard(params, data, labels, mask, rng, epoch):
+            def sum_loss(p):
+                nodes, loss, _ = net.forward(
+                    p, data, labels=labels, extras=(), train=True,
+                    rng=rng, step=epoch, aux={}, return_aux=True,
+                    sample_mask=mask,
+                )
+                return loss, nodes[out_idx].astype(jnp.float32)
+
+            (loss, out), g = jax.value_and_grad(
+                sum_loss, has_aux=True)(params)
+
+            def fold(x):
+                parts = jax.lax.all_gather(x, "data")
+                acc = parts[0]
+                for i in range(1, n):
+                    acc = acc + parts[i]
+                return acc
+
+            grads = jax.tree_util.tree_map(fold, g)
+            return grads, fold(loss), out
+
+        return shard_map(
+            per_shard, mesh=plan.mesh,
+            in_specs=(P(), P("data"), P("data"), P("data"), P(), P()),
+            out_specs=(P(), P(), P("data")),
+            check_rep=False,
+        )
+
     def _loss_and_out(self, params, aux, data, labels, mask, rng, epoch,
                       extras):
         """(loss, (out_node, new_aux)) with train=True — fused/fwd_train."""
@@ -485,15 +596,21 @@ class NetTrainer:
             loss_and_out = self._loss_and_out
             apply_updates = self._apply_updates
             gspec = self._grad_spec()
+            det_grad = self._det_grad_fn() if self._det_active() else None
 
             def step(params, ustates, aux, data, labels, mask, rng, epoch,
                      extras):
-                (loss, (out, new_aux)), grads = jax.value_and_grad(
-                    lambda p: loss_and_out(
-                        p, aux, data, labels, mask, rng, epoch, extras
-                    ),
-                    has_aux=True,
-                )(params)
+                if det_grad is not None:
+                    grads, loss, out = det_grad(params, data, labels,
+                                                mask, rng, epoch)
+                    new_aux = aux
+                else:
+                    (loss, (out, new_aux)), grads = jax.value_and_grad(
+                        lambda p: loss_and_out(
+                            p, aux, data, labels, mask, rng, epoch, extras
+                        ),
+                        has_aux=True,
+                    )(params)
                 new_p, new_s = apply_updates(updaters, params, ustates,
                                              grads, epoch, gspec=gspec)
                 return new_p, new_s, new_aux, loss, out
@@ -533,14 +650,21 @@ class NetTrainer:
             loss_and_out = self._loss_and_out
             apply_updates = self._apply_updates
             gspec = self._grad_spec()
+            det_grad = self._det_grad_fn() if self._det_active() else None
 
             def one_step(params, ustates, aux, data, labels, rng, epoch):
-                (loss, (out, new_aux)), grads = jax.value_and_grad(
-                    lambda p: loss_and_out(
-                        p, aux, data, labels, None, rng, epoch, ()
-                    ),
-                    has_aux=True,
-                )(params)
+                if det_grad is not None:
+                    mask = jnp.ones((data.shape[0],), jnp.float32)
+                    grads, loss, out = det_grad(params, data, labels,
+                                                mask, rng, epoch)
+                    new_aux = aux
+                else:
+                    (loss, (out, new_aux)), grads = jax.value_and_grad(
+                        lambda p: loss_and_out(
+                            p, aux, data, labels, None, rng, epoch, ()
+                        ),
+                        has_aux=True,
+                    )(params)
                 new_p, new_s = apply_updates(
                     updaters, params, ustates, grads, epoch, gspec=gspec
                 )
@@ -876,7 +1000,17 @@ class NetTrainer:
         self.round = round_
 
     def sync(self) -> None:
-        """Block until all dispatched device work is done (step timing)."""
+        """Block until all dispatched device work is done (step timing).
+
+        Instrumented as the ``mesh.replica`` fault site: a ``hang``
+        here models a peer wedged inside a collective (the elastic
+        deadline must surface :class:`ReplicaLossError` in bounded
+        time), an ``ioerror`` models the abrupt connection-reset a
+        SIGKILLed peer produces — reproducible in-process, no real
+        process needs to die (doc/robustness.md)."""
+        from ..utils.faults import fault_point
+
+        fault_point("mesh.replica")
         if self.params is not None:
             jax.block_until_ready(self.params)
 
@@ -1710,6 +1844,7 @@ class NetTrainer:
             if key in self.aux:
                 self.aux[key] = {t: jnp.asarray(w) for t, w in tags.items()}
         self.net.infer_shapes(self.batch_size)
+        self._validate_det_reduce()
         self._build_updaters()
         # exact resume (save_ustate=1 checkpoints): restore momentum /
         # adam moments where shapes match the rebuilt updaters

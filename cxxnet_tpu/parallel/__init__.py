@@ -14,8 +14,11 @@ which subsumes the reference's per-layer WFBP priorities
 """
 
 from .distributed import (  # noqa: F401
+    distributed_initialized,
     distributed_spec,
+    init_distributed,
     maybe_init_distributed,
     process_info,
+    shutdown_distributed,
 )
 from .mesh import MeshPlan, make_mesh, parse_device  # noqa: F401

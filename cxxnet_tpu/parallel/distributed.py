@@ -37,6 +37,16 @@ import jax
 ConfigEntry = Tuple[str, str]
 
 _initialized = False
+_resilient_used = False
+
+
+def resilient_client_used() -> bool:
+    """Did this process ever build the resilient (elastic) coordination
+    client?  Its error-poll thread cannot be stopped from Python, so
+    interpreter-exit destructor order can trip it into a LOG(FATAL)
+    abort — the CLI hard-exits (``os._exit``) after a clean flush
+    instead of running destructors when this is set."""
+    return _resilient_used
 
 
 def distributed_spec(
@@ -67,7 +77,11 @@ def maybe_init_distributed(cfg: Sequence[ConfigEntry]) -> bool:
     """Join the jax.distributed job if the config asks for one.
 
     Idempotent; returns True when running multi-process.  Must be called
-    before any other JAX API touches the backend.
+    before any other JAX API touches the backend.  ``elastic = 1`` confs
+    join through the RESILIENT client (non-fatal heartbeat callbacks,
+    no shutdown-on-destruction) so a peer death is an error this
+    process handles instead of a ``LOG(FATAL)`` that kills it — the
+    precondition for the elastic rebuild (doc/parallel.md).
     """
     global _initialized
     spec = distributed_spec(cfg)
@@ -76,12 +90,173 @@ def maybe_init_distributed(cfg: Sequence[ConfigEntry]) -> bool:
     if _initialized:
         return True
     coord, num, pid = spec
-    _enable_cpu_collectives()
-    jax.distributed.initialize(
-        coordinator_address=coord, num_processes=num, process_id=pid
-    )
-    _initialized = True
+    from .elastic import ElasticOptions
+
+    # last-entry-wins, same as every other config key — a CLI override
+    # elastic=0 must yield the stock client, not a liveness-blind one
+    # with no elastic layer armed on top
+    opts = ElasticOptions.from_cfg(cfg)
+    init_distributed(coord, num, pid,
+                     resilient=opts.elastic or opts.join)
     return True
+
+
+def init_distributed(coordinator: str, num: int, pid: int,
+                     resilient: bool = False,
+                     init_timeout: int = 120) -> None:
+    """Join (or re-join) a jax.distributed job with explicit arguments.
+
+    ``resilient=True`` builds the coordination-service client by hand
+    (same wire protocol) with the changes that make replica loss
+    survivable.  The stock client LOG(FATAL)s — terminates this
+    process — when the service broadcasts a peer's death, and the
+    Python-level ``missed_heartbeat_callback`` escape hatch is unusable
+    in this jaxlib (nanobind cannot convert the ``absl::Status``
+    argument; invoking it throws ``std::bad_cast`` on whatever thread
+    polls).  So the resilient client makes the coordination service
+    LIVENESS-BLIND instead: heartbeats so slow that no eviction — and
+    therefore no fatal broadcast — ever fires within a training run.
+    Failure detection belongs entirely to the elastic layer
+    (``parallel/elastic.py``: sub-second application heartbeats + the
+    collective deadline) and the gloo data plane (a SIGKILLed peer
+    resets its TCP pairs, erroring collectives in milliseconds).
+    ``shutdown_on_destruction=False`` plus short client/service
+    shutdown timeouts make teardown abandonable: the handles are
+    dropped (and their poll threads die) before any late barrier
+    failure can be broadcast back.  Re-init after
+    :func:`shutdown_distributed` is the elastic-rebuild rendezvous:
+    connect blocks until all ``num`` processes arrive."""
+    global _initialized
+    _enable_cpu_collectives()
+    if not resilient:
+        jax.distributed.initialize(
+            coordinator_address=coordinator, num_processes=num,
+            process_id=pid,
+        )
+        _initialized = True
+        return
+    from jax._src import distributed as jdist
+    from jax._src.lib import xla_extension as xe
+
+    from ..obs import emit as obs_emit
+
+    gs = jdist.global_state
+    if gs.client is not None or gs.service is not None:
+        raise RuntimeError(
+            "init_distributed: a distributed client is already live; "
+            "call shutdown_distributed() first")
+    if pid == 0:
+        port = coordinator.rsplit(":", 1)[1]
+        gs.service = xe.get_distributed_runtime_service(
+            f"[::]:{port}", num, heartbeat_interval=600,
+            max_missing_heartbeats=6, shutdown_timeout=8)
+    gs.client = xe.get_distributed_runtime_client(
+        coordinator, pid, init_timeout=init_timeout, shutdown_timeout=5,
+        heartbeat_interval=600, max_missing_heartbeats=6,
+        shutdown_on_destruction=False, use_compression=True)
+    obs_emit("mesh.dist_init", coordinator=coordinator, num=num,
+             rank=pid, resilient=True)
+    gs.client.connect()
+    gs.process_id = pid
+    gs.num_processes = num
+    gs.coordinator_address = coordinator
+    _initialized = True
+    global _resilient_used
+    _resilient_used = True
+
+
+#: coordination services deliberately kept alive after an elastic
+#: teardown: stopping (or destructing) one closes its gRPC socket, and
+#: every peer whose old client is still polling it would see the
+#: closure as a fatal error and LOG(FATAL).  One tiny idle server per
+#: mesh generation is the price of not letting teardown order kill
+#: survivors.
+_leaked_services: list = []
+
+
+def shutdown_distributed(timeout_s: float = 10.0,
+                         graceful: bool = True) -> bool:
+    """Tear down the jax.distributed runtime so it is safe to
+    re-initialize IN THIS PROCESS (the elastic rebuild, and the
+    re-init regression test).
+
+    ``graceful=True`` (every peer known alive — the regression test,
+    planned same-membership teardowns): client disconnect and service
+    stop each run on a deadline thread; a step that cannot complete is
+    ABANDONED after ``timeout_s``.
+
+    ``graceful=False`` (the elastic rebuild): NO coordination-service
+    RPC is issued at all.  A shutdown RPC would start the service-side
+    shutdown barrier, the dead peer can never join it, and the barrier
+    failure would be broadcast to the surviving peers' still-live
+    clients — which treat any poll error as fatal and terminate.  So
+    the client handle is simply dropped (its destructor cancels the
+    poll thread without RPC — ``shutdown_on_destruction=False``) and
+    the service object is intentionally LEAKED (see
+    ``_leaked_services``).
+
+    Live backends are dropped afterwards — compiled programs and
+    device buffers of the old mesh die with them — and the next
+    backend use builds a fresh client against the new distributed
+    state.  Returns True when every step completed cleanly."""
+    import threading as _threading
+
+    from jax._src import distributed as jdist
+
+    from ..obs import emit as obs_emit
+
+    global _initialized
+    gs = jdist.global_state
+    client, service = gs.client, gs.service
+    gs.client = None
+    gs.service = None
+    gs.preemption_sync_manager = None
+    gs.process_id, gs.num_processes = 0, 1
+    gs.coordinator_address = None
+    clean = True
+    if not graceful:
+        if service is not None:
+            _leaked_services.append(service)
+        if client is not None or service is not None:
+            obs_emit("mesh.dist_teardown", graceful=False,
+                     leaked_services=len(_leaked_services))
+        del client  # destructor cancels the poll thread, no RPC
+    else:
+        for name, obj in (("client", client), ("service", service)):
+            if obj is None:
+                continue
+            box: dict = {}
+
+            def _run(o=obj, n=name) -> None:
+                try:
+                    o.shutdown()
+                    box[n] = True
+                except Exception as e:  # noqa: BLE001 - not fatal
+                    box[n] = e
+
+            t = _threading.Thread(target=_run, daemon=True,
+                                  name=f"cxxnet-dist-shutdown-{name}")
+            t.start()
+            t.join(timeout=timeout_s)
+            if t.is_alive() or box.get(name) is not True:
+                clean = False
+                obs_emit("mesh.dist_shutdown_abandoned", what=name,
+                         error=(None if t.is_alive()
+                                else str(box.get(name))),
+                         timed_out=t.is_alive())
+    try:
+        jax.clear_caches()
+    except Exception:  # noqa: BLE001 - older jax spellings
+        pass
+    from jax._src import api as _api
+
+    _api.clear_backends()
+    _initialized = False
+    return clean
+
+
+def distributed_initialized() -> bool:
+    return _initialized
 
 
 def _enable_cpu_collectives() -> None:

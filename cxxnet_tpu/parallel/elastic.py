@@ -1,0 +1,852 @@
+"""Elastic pod: survive replica loss and resize the mesh mid-run.
+
+The cxxnet lineage kept training through worker churn via its parameter
+server (PAPER.md); the TensorFlow systems paper (arXiv 1605.08695 §4.2)
+treats worker failure-and-recovery as a first-class design axis.  This
+module is the membership/liveness half of that story for the SPMD mesh
+trainer; the rebuild half (checkpoint-and-reload onto the surviving
+process set) lives in ``cli.py::_elastic_rebuild`` on top of
+``distributed.shutdown_distributed`` and the PR-1 round-consensus
+machinery.
+
+Pieces:
+
+* :class:`ElasticCoordinator` — a tiny stdlib TCP JSON-lines service
+  hosted INSIDE the rank-0 process (one request per connection).  It
+  tracks member heartbeats, classifies "replica slow" (missed a couple
+  of beats → ``mesh.replica_slow`` event) distinctly from "replica
+  gone" (silent past ``elastic_timeout_s`` → ``mesh.replica_lost`` and
+  a new membership *generation*), admits waiting processes for mesh
+  growth, and allocates the fresh ``jax.distributed`` coordinator port
+  every generation re-initializes onto.
+* :class:`ElasticMember` — the per-process client: a heartbeat thread,
+  a ``lost_event`` the collective deadline polls, and the blocking
+  plan/ack calls the rebuild rendezvous uses.
+* :class:`ReplicaLossError` — the typed error a dead peer surfaces as,
+  instead of an indefinite hang inside a collective.
+* :func:`guarded_call` — the collective deadline: runs a blocking op on
+  a worker thread and raises :class:`ReplicaLossError` in bounded time
+  (``collective_timeout_s``) once the monitor confirms (or, past the
+  deadline, suspects) a lost peer.  A merely *slow* peer only emits a
+  ``mesh.collective_slow`` event — the wait continues.
+* :func:`rebuild_in_progress` — process-wide flag a serve-colocated
+  front-end reads to degrade ``/healthz`` while the trainer rebuilds.
+
+Known limitation (documented in doc/parallel.md): rank 0 hosts both
+coordinators, so losing rank 0 ends the job — place rank 0 on durable
+capacity.  Survivor re-ranking keeps relative order, so rank 0 stays
+rank 0 across every generation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..obs import emit as obs_emit
+from ..obs.registry import registry as obs_registry
+
+ConfigEntry = Tuple[str, str]
+
+__all__ = [
+    "ReplicaLossError",
+    "ElasticOptions",
+    "ElasticCoordinator",
+    "ElasticMember",
+    "GenerationPlan",
+    "guarded_call",
+    "classify_failure",
+    "rebuild_in_progress",
+    "set_rebuilding",
+]
+
+
+class ReplicaLossError(RuntimeError):
+    """A mesh peer is gone (confirmed by the liveness monitor, or
+    presumed after the collective deadline with missed heartbeats).
+
+    ``fatal=True`` means the job cannot continue (survivors below
+    ``elastic_min_replicas``, or the coordinator itself is unreachable)
+    — the driver re-raises instead of rebuilding."""
+
+    def __init__(self, message: str, lost: Sequence[int] = (),
+                 generation: int = 0, presumed: bool = False,
+                 fatal: bool = False) -> None:
+        super().__init__(message)
+        self.lost = list(lost)
+        self.generation = int(generation)
+        self.presumed = bool(presumed)
+        self.fatal = bool(fatal)
+
+
+# ----------------------------------------------------------------------
+# /healthz degrade flag (read by serve/engine.py)
+_REBUILDING = threading.Event()
+
+
+def rebuild_in_progress() -> bool:
+    """True while any trainer in this process is mid mesh-rebuild."""
+    return _REBUILDING.is_set()
+
+
+def set_rebuilding(active: bool) -> None:
+    if active:
+        _REBUILDING.set()
+    else:
+        _REBUILDING.clear()
+
+
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class ElasticOptions:
+    """The ``elastic_*`` config surface (doc/conf.md)."""
+
+    elastic: bool = False
+    min_replicas: int = 1
+    rejoin_s: float = 120.0       # joiner admission-wait budget
+    heartbeat_s: float = 0.5
+    timeout_s: float = 5.0        # silent this long => replica LOST
+    collective_timeout_s: float = 30.0
+    coordinator: str = ""         # host:port; default dist port + 1
+    drop_at: int = 0              # planned shrink boundary (0 = off)
+    join: bool = False            # this process is a waiting joiner
+    join_at: int = 0              # pin the grow boundary (0 = next)
+
+    @classmethod
+    def from_cfg(cls, cfg: Sequence[ConfigEntry]) -> "ElasticOptions":
+        o = cls()
+        for name, val in cfg:
+            if name == "elastic":
+                o.elastic = bool(int(val))
+            elif name == "elastic_min_replicas":
+                o.min_replicas = int(val)
+            elif name == "elastic_rejoin_s":
+                o.rejoin_s = float(val)
+            elif name == "elastic_heartbeat_s":
+                o.heartbeat_s = float(val)
+            elif name == "elastic_timeout_s":
+                o.timeout_s = float(val)
+            elif name == "collective_timeout_s":
+                o.collective_timeout_s = float(val)
+            elif name == "elastic_coordinator":
+                o.coordinator = val
+            elif name == "elastic_drop_at":
+                o.drop_at = int(val)
+            elif name == "elastic_join":
+                o.join = bool(int(val))
+            elif name == "elastic_join_at":
+                o.join_at = int(val)
+        if o.min_replicas < 1:
+            raise ValueError("elastic_min_replicas must be >= 1")
+        return o
+
+    def resolve_coordinator(self, dist_coordinator: str) -> str:
+        """Elastic coordinator address: explicit key, else the jax
+        coordinator's host at port+1 (same machine as rank 0)."""
+        if self.coordinator:
+            return self.coordinator
+        host, port = dist_coordinator.rsplit(":", 1)
+        return f"{host}:{int(port) + 1}"
+
+
+@dataclasses.dataclass
+class GenerationPlan:
+    """One membership transition, as seen by one member."""
+
+    generation: int
+    reason: str                  # replica_lost | planned_shrink | grow
+    num: int
+    rank: Optional[int]          # None: this member is dropped/leaving
+    jax_coordinator: str
+    at_round: Optional[int]      # None: effective immediately (loss)
+    lost_ranks: List[int] = dataclasses.field(default_factory=list)
+    abort: str = ""
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "GenerationPlan":
+        return cls(
+            generation=int(d["gen"]), reason=str(d["reason"]),
+            num=int(d["num"]), rank=d.get("rank"),
+            jax_coordinator=str(d.get("jax_coordinator", "")),
+            at_round=d.get("at_round"),
+            lost_ranks=list(d.get("lost_ranks", ())),
+            abort=str(d.get("abort", "")),
+        )
+
+
+def free_port() -> int:
+    """OS-assigned free TCP port (bind-0-close; the usual TOCTOU race
+    applies — callers bind promptly).  The one shared copy: the
+    coordinator's per-generation jax ports and the lane tools all use
+    this."""
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _replica_gauge(state: str, value: float) -> None:
+    try:
+        obs_registry().gauge(
+            "mesh_replicas",
+            "Elastic-mesh replica counts by state.",
+            labelnames=("state",),
+        ).labels(state=state).set(float(value))
+    except Exception:  # noqa: BLE001 - telemetry must never raise
+        pass
+
+
+# ----------------------------------------------------------------------
+class _MemberInfo:
+    __slots__ = ("mid", "rank", "last_beat", "round", "gen", "suspect")
+
+    def __init__(self, mid: str, rank: int) -> None:
+        self.mid = mid
+        self.rank = rank
+        self.last_beat = time.monotonic()
+        self.round = -1
+        self.gen = 1
+        self.suspect = False
+
+
+class ElasticCoordinator:
+    """The membership brain, hosted inside the rank-0 process.
+
+    Protocol: one TCP connection per request, one JSON line each way.
+    Ops: ``hello`` (register), ``beat`` (liveness + generation poll),
+    ``join`` (waiter poll), ``plan_shrink`` / ``plan_grow`` (boundary
+    rendezvous; idempotent per ``(kind, round)``), ``ack`` (member
+    finished rebuilding onto a generation), ``status`` (diagnostics).
+    """
+
+    def __init__(self, bind: str, jax_host: str, num: int,
+                 opts: ElasticOptions) -> None:
+        self.opts = opts
+        self.jax_host = jax_host
+        self._lock = threading.Lock()
+        self._members: Dict[str, _MemberInfo] = {}
+        # mid -> {"join_at": int (0 = next), "last": monotonic} — the
+        # join poll doubles as waiter liveness: a joiner that died or
+        # gave up while waiting must NOT be admitted (the grow
+        # rendezvous would block on a process that never arrives)
+        self._waiters: Dict[str, dict] = {}
+        self._gen = 1
+        self._expected = num
+        self._plans: Dict[int, dict] = {}    # gen -> wire plan + members
+        self._plan_keys: Dict[tuple, int] = {}  # (kind, round) -> gen
+        self._grow_at: Optional[int] = None
+        self._abort = ""
+        self._lost_total = 0
+        self._rejoined_total = 0
+        self._stop = threading.Event()
+        host, port = bind.rsplit(":", 1)
+
+        coord = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self) -> None:  # noqa: D401
+                try:
+                    line = self.rfile.readline(1 << 16)
+                    req = json.loads(line.decode("utf-8"))
+                    resp = coord._dispatch(req)
+                except Exception as e:  # noqa: BLE001 - reply, don't die
+                    resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                try:
+                    self.wfile.write(
+                        (json.dumps(resp, separators=(",", ":")) + "\n")
+                        .encode("utf-8"))
+                except OSError:
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host or "", int(port)), Handler)
+        self.address = (
+            f"{host or 'localhost'}:{self._server.server_address[1]}")
+        self._threads = [
+            threading.Thread(target=self._server.serve_forever,
+                             name="cxxnet-elastic-coord", daemon=True),
+            threading.Thread(target=self._monitor,
+                             name="cxxnet-elastic-monitor", daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------------
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+    def _monitor(self) -> None:
+        """Classify slow vs lost.  Slow (missed ~2 beats) emits one
+        ``mesh.replica_slow`` event per episode; lost (silent past
+        ``elastic_timeout_s``) triggers a shrink generation."""
+        hb = self.opts.heartbeat_s
+        while not self._stop.wait(hb):
+            now = time.monotonic()
+            lost: List[_MemberInfo] = []
+            with self._lock:
+                self._prune_waiters_locked(now)
+                for m in list(self._members.values()):
+                    silent = now - m.last_beat
+                    if silent > self.opts.timeout_s:
+                        lost.append(m)
+                    elif silent > 2.5 * hb:
+                        if not m.suspect:
+                            m.suspect = True
+                            obs_emit("mesh.replica_slow", rank=m.rank,
+                                     member=m.mid, silent_s=round(silent, 3))
+                    else:
+                        m.suspect = False
+            for m in lost:
+                self._on_lost(m)
+
+    def _prune_waiters_locked(self, now: float) -> None:
+        """Drop waiters whose join polls stopped; unschedule the grow
+        when none remain."""
+        stale = [mid for mid, w in self._waiters.items()
+                 if now - w["last"] > self.opts.timeout_s]
+        for mid in stale:
+            del self._waiters[mid]
+            obs_emit("mesh.rejoin_abandoned", member=mid)
+        if stale and not self._waiters:
+            self._grow_at = None
+
+    def _on_lost(self, m: _MemberInfo) -> None:
+        with self._lock:
+            if m.mid not in self._members:
+                return  # raced with another trigger
+            del self._members[m.mid]
+            self._lost_total += 1
+            obs_emit("mesh.replica_lost", rank=m.rank, member=m.mid,
+                     generation=self._gen)
+            self._bump_generation_locked(
+                reason="replica_lost", at_round=None, lost_ranks=[m.rank])
+        _replica_gauge("lost", self._lost_total)
+
+    # ------------------------------------------------------------------
+    def _bump_generation_locked(self, reason: str,
+                                at_round: Optional[int],
+                                lost_ranks: Sequence[int] = (),
+                                drop_ranks: Sequence[int] = (),
+                                admit_waiters: bool = False) -> dict:
+        """Compute the next membership generation (caller holds lock).
+
+        Survivors keep relative rank order (rank 0 stays 0); dropped
+        ranks leave with ``rank=None``; admitted waiters append at the
+        tail.  Every plan carries a FRESH jax coordinator port — an
+        abandoned coordination service may still hold the old one."""
+        survivors = sorted(self._members.values(), key=lambda m: m.rank)
+        dropped = [m for m in survivors if m.rank in set(drop_ranks)]
+        survivors = [m for m in survivors if m.rank not in set(drop_ranks)]
+        admitted: List[str] = []
+        if admit_waiters:
+            self._prune_waiters_locked(time.monotonic())
+            admitted = sorted(self._waiters)
+            self._waiters.clear()
+        num = len(survivors) + len(admitted)
+        self._gen += 1
+        gen = self._gen
+        abort = ""
+        if num < self.opts.min_replicas:
+            abort = (f"{num} survivor(s) below elastic_min_replicas="
+                     f"{self.opts.min_replicas}")
+            self._abort = abort
+        assignments: Dict[str, Optional[int]] = {}
+        for i, m in enumerate(survivors):
+            assignments[m.mid] = i
+            # m.gen stays at the member's last ACKED generation — the
+            # beat channel delivers this plan precisely while m.gen
+            # lags the coordinator's
+            m.rank = i
+        for j, mid in enumerate(admitted):
+            rank = len(survivors) + j
+            assignments[mid] = rank
+            info = _MemberInfo(mid, rank)
+            info.gen = gen
+            self._members[mid] = info
+            self._rejoined_total += 1
+        for m in dropped:
+            assignments[m.mid] = None
+            del self._members[m.mid]
+        plan = {
+            "gen": gen, "reason": reason, "num": num,
+            "jax_coordinator": f"{self.jax_host}:{free_port()}",
+            "at_round": at_round,
+            "lost_ranks": list(lost_ranks),
+            "abort": abort,
+            "assignments": assignments,
+        }
+        self._plans[gen] = plan
+        old_grow = self._grow_at
+        self._grow_at = None
+        if self._waiters:
+            # a shrink must not orphan pending joiners: reschedule the
+            # grow boundary past the transition we just planned
+            rounds = [m.round for m in self._members.values()]
+            base = (max(rounds) if rounds else 0) + 2
+            self._grow_at = max(
+                base, old_grow or 0,
+                max((w["join_at"] for w in self._waiters.values()),
+                    default=0))
+        obs_emit("mesh.shrink" if reason != "grow" else "mesh.grow",
+                 generation=gen, reason=reason, num=num,
+                 at_round=at_round, lost_ranks=list(lost_ranks))
+        _replica_gauge("alive", len(self._members))
+        _replica_gauge("rejoined", self._rejoined_total)
+        return plan
+
+    def _plan_for(self, plan: dict, mid: str) -> dict:
+        out = {k: v for k, v in plan.items() if k != "assignments"}
+        out["rank"] = plan["assignments"].get(mid)
+        return out
+
+    # ------------------------------------------------------------------
+    def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        mid = str(req.get("member", ""))
+        if op == "hello":
+            with self._lock:
+                info = _MemberInfo(mid, int(req["rank"]))
+                info.gen = self._gen
+                self._members[mid] = info
+                alive = len(self._members)
+            _replica_gauge("alive", alive)
+            return {"ok": True, "gen": self._gen}
+        if op == "beat":
+            with self._lock:
+                m = self._members.get(mid)
+                if m is None:
+                    # a member the monitor already declared lost is back:
+                    # too late — it must rejoin as a waiter
+                    return {"ok": True, "gen": self._gen, "evicted": True,
+                            "abort": self._abort}
+                m.last_beat = time.monotonic()
+                m.round = int(req.get("round", m.round))
+                change = None
+                if m.gen < self._gen:
+                    change = self._plan_for(self._plans[self._gen], mid)
+                return {"ok": True, "gen": self._gen,
+                        "grow_at": self._grow_at,
+                        "suspects": [x.rank for x in self._members.values()
+                                     if x.suspect],
+                        "change": change, "abort": self._abort}
+        if op == "join":
+            join_at = int(req.get("join_at", 0) or 0)
+            with self._lock:
+                # already admitted by a fired grow plan?  The poll also
+                # counts as liveness — the joiner's beat thread only
+                # starts once it learns its rank, and the rendezvous it
+                # then enters can outlast elastic_timeout_s
+                mem = self._members.get(mid)
+                if mem is not None:
+                    mem.last_beat = time.monotonic()
+                for gen in sorted(self._plans, reverse=True):
+                    plan = self._plans[gen]
+                    if plan["assignments"].get(mid) is not None:
+                        return {"ok": True,
+                                "admitted": self._plan_for(plan, mid)}
+                first = mid not in self._waiters
+                self._waiters[mid] = {"join_at": join_at,
+                                      "last": time.monotonic()}
+                if self._grow_at is None:
+                    rounds = [m.round for m in self._members.values()]
+                    nxt = (max(rounds) if rounds else 0) + 2
+                    self._grow_at = max(join_at, nxt)
+                if first:  # one announcement, not one per poll
+                    obs_emit("mesh.rejoin_waiting", member=mid,
+                             grow_at=self._grow_at)
+                return {"ok": True, "admitted": None,
+                        "grow_at": self._grow_at}
+        if op in ("plan_shrink", "plan_grow"):
+            round_ = int(req["round"])
+            kind = "shrink" if op == "plan_shrink" else "grow"
+            with self._lock:
+                # a member that learned of a pending transition one
+                # boundary late must receive the EXISTING plan — a
+                # second generation for the same transition would split
+                # the rendezvous
+                mem = self._members.get(mid)
+                latest = self._plans.get(self._gen)
+                if (latest is not None and mem is not None
+                        and mem.gen < self._gen
+                        and (latest["reason"] == "grow") == (kind == "grow")):
+                    return {"ok": True, "plan": self._plan_for(latest, mid)}
+                key = (kind, round_)
+                if key not in self._plan_keys:
+                    if kind == "shrink":
+                        drop = max(m.rank for m in self._members.values())
+                        plan = self._bump_generation_locked(
+                            reason="planned_shrink", at_round=round_,
+                            drop_ranks=[drop])
+                    else:
+                        self._prune_waiters_locked(time.monotonic())
+                        if not self._waiters:
+                            # every joiner died/gave up while waiting:
+                            # growing to the same membership would be a
+                            # pointless full rebuild — report no change
+                            return {"ok": True, "plan": None}
+                        plan = self._bump_generation_locked(
+                            reason="grow", at_round=round_,
+                            admit_waiters=True)
+                    self._plan_keys[key] = plan["gen"]
+                plan = self._plans[self._plan_keys[key]]
+                return {"ok": True, "plan": self._plan_for(plan, mid)}
+        if op == "ack":
+            with self._lock:
+                m = self._members.get(mid)
+                if m is not None:
+                    m.gen = int(req["gen"])
+                    m.last_beat = time.monotonic()
+            return {"ok": True}
+        if op == "status":
+            with self._lock:
+                return {
+                    "ok": True, "gen": self._gen,
+                    "members": {m.mid: {"rank": m.rank, "round": m.round,
+                                        "gen": m.gen, "suspect": m.suspect}
+                                for m in self._members.values()},
+                    "waiters": sorted(self._waiters),
+                    "grow_at": self._grow_at,
+                    "lost_total": self._lost_total,
+                    "rejoined_total": self._rejoined_total,
+                    "abort": self._abort,
+                }
+        raise ValueError(f"unknown op {op!r}")
+
+
+# ----------------------------------------------------------------------
+class ElasticMember:
+    """Per-process elastic client: heartbeats + the rebuild rendezvous.
+
+    ``lost_event`` is set the moment a beat reply announces a
+    loss-triggered generation (or the coordinator became unreachable
+    past ``elastic_timeout_s``) — the collective deadline in
+    :func:`guarded_call` polls it."""
+
+    def __init__(self, coordinator_addr: str, rank: int,
+                 opts: ElasticOptions,
+                 host_coordinator: bool = False,
+                 num: int = 0, jax_host: str = "localhost") -> None:
+        self.opts = opts
+        self.addr = coordinator_addr
+        self.rank = rank
+        self.mid = f"{socket.gethostname()}:{os.getpid()}:{uuid.uuid4().hex[:6]}"
+        self.coordinator: Optional[ElasticCoordinator] = None
+        if host_coordinator:
+            self.coordinator = ElasticCoordinator(
+                coordinator_addr, jax_host, num, opts)
+            self.addr = self.coordinator.address
+        self.generation = 1
+        self.lost_event = threading.Event()
+        self.abort_reason = ""
+        self._pending: Optional[GenerationPlan] = None
+        self._grow_at: Optional[int] = None
+        self._suspects: List[int] = []
+        self._round = -1
+        self._coord_silent_since: Optional[float] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._beat_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def _rpc(self, req: dict, timeout: Optional[float] = None) -> dict:
+        timeout = timeout or max(self.opts.timeout_s, 2.0)
+        req = {**req, "member": self.mid}
+        host, port = self.addr.rsplit(":", 1)
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout) as s:
+            s.sendall((json.dumps(req, separators=(",", ":")) + "\n")
+                      .encode("utf-8"))
+            f = s.makefile("rb")
+            line = f.readline(1 << 16)
+        resp = json.loads(line.decode("utf-8"))
+        if not resp.get("ok"):
+            raise RuntimeError(
+                f"elastic coordinator rejected {req.get('op')}: "
+                f"{resp.get('error')}")
+        return resp
+
+    # ------------------------------------------------------------------
+    def start(self) -> "ElasticMember":
+        # rank 0 binds the coordinator around the same time the peers
+        # say hello — retry connection refusals for a few seconds
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                self._rpc({"op": "hello", "rank": self.rank})
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        self._beat_thread = threading.Thread(
+            target=self._beat_loop, name="cxxnet-elastic-beat", daemon=True)
+        self._beat_thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._beat_thread is not None:
+            self._beat_thread.join(timeout=2.0)
+        if self.coordinator is not None:
+            self.coordinator.close()
+
+    # ------------------------------------------------------------------
+    def report_round(self, round_: int) -> None:
+        self._round = int(round_)
+
+    def poll_now(self) -> None:
+        """One synchronous beat: round boundaries call this so every
+        rank reads the SAME coordinator state at the same boundary
+        instead of racing the heartbeat thread's cadence."""
+        resp = self._rpc({"op": "beat", "round": self._round})
+        self._process_beat(resp)
+
+    def _process_beat(self, resp: dict) -> None:
+        with self._lock:
+            if resp.get("evicted"):
+                # the coordinator declared THIS rank lost while it was
+                # stalled — the surviving mesh has re-formed without
+                # it.  Fail fast (fatal) rather than wait inside a
+                # collective no peer will ever join; capacity re-enters
+                # through the elastic_join waiter path.
+                if not self.abort_reason:
+                    self.abort_reason = (
+                        "this rank was evicted from the mesh (declared "
+                        "lost while stalled); restart with "
+                        "elastic_join=1 to rejoin")
+                self.lost_event.set()
+                return
+            self._suspects = list(resp.get("suspects", ()))
+            self._grow_at = resp.get("grow_at")
+            if resp.get("abort"):
+                self.abort_reason = str(resp["abort"])
+                self.lost_event.set()
+            change = resp.get("change")
+            if change is not None:
+                plan = GenerationPlan.from_wire(change)
+                if plan.generation > self.generation:
+                    self._pending = plan
+                    if plan.at_round is None:  # loss: act now
+                        self.lost_event.set()
+
+    def _beat_loop(self) -> None:
+        while not self._stop.wait(self.opts.heartbeat_s):
+            try:
+                resp = self._rpc({"op": "beat", "round": self._round},
+                                 timeout=max(self.opts.heartbeat_s * 4, 1.0))
+            except (OSError, ValueError, RuntimeError) as e:
+                # RuntimeError covers an ok=false coordinator reply —
+                # the heartbeat daemon must survive any single bad
+                # exchange, or this healthy rank gets evicted
+                # coordinator unreachable: rank 0 (its host) may be gone
+                now = time.monotonic()
+                if self._coord_silent_since is None:
+                    self._coord_silent_since = now
+                elif now - self._coord_silent_since > self.opts.timeout_s:
+                    with self._lock:
+                        if not self.abort_reason:
+                            self.abort_reason = (
+                                f"elastic coordinator {self.addr} "
+                                f"unreachable ({type(e).__name__}: {e}) — "
+                                "rank 0 presumed lost")
+                    self.lost_event.set()
+                continue
+            self._coord_silent_since = None
+            self._process_beat(resp)
+
+    # ------------------------------------------------------------------
+    def suspects(self) -> List[int]:
+        with self._lock:
+            return list(self._suspects)
+
+    def pending_plan(self) -> Optional[GenerationPlan]:
+        with self._lock:
+            return self._pending
+
+    def grow_round(self) -> Optional[int]:
+        with self._lock:
+            return self._grow_at
+
+    def plan_shrink(self, round_: int) -> GenerationPlan:
+        resp = self._rpc({"op": "plan_shrink", "round": int(round_)})
+        return GenerationPlan.from_wire(resp["plan"])
+
+    def plan_grow(self, round_: int) -> Optional[GenerationPlan]:
+        """None when every waiter abandoned the join before the
+        boundary fired — the mesh stays as it is."""
+        resp = self._rpc({"op": "plan_grow", "round": int(round_)})
+        if resp.get("plan") is None:
+            return None
+        return GenerationPlan.from_wire(resp["plan"])
+
+    def ack_generation(self, plan: GenerationPlan,
+                       rank: Optional[int] = None) -> None:
+        """Adopt a generation after the rebuild rendezvous succeeded."""
+        with self._lock:
+            self.generation = plan.generation
+            if rank is not None:
+                self.rank = rank
+            self._pending = None
+            self.lost_event.clear()
+        try:
+            self._rpc({"op": "ack", "gen": plan.generation})
+        except (OSError, ValueError):
+            pass  # the next beat re-syncs
+
+    def join(self, timeout_s: Optional[float] = None) -> GenerationPlan:
+        """Waiter admission: poll until a grow generation assigns this
+        member a rank (``elastic_rejoin_s`` bounds the wait)."""
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else self.opts.rejoin_s)
+        join_at = self.opts.join_at
+        while True:
+            try:
+                resp = self._rpc({"op": "join", "join_at": join_at})
+            except OSError:
+                # the coordinator (rank 0) may not be up yet — a waiter
+                # launched alongside (or before) the job keeps polling
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(self.opts.heartbeat_s)
+                continue
+            admitted = resp.get("admitted")
+            if admitted is not None:
+                plan = GenerationPlan.from_wire(admitted)
+                self.generation = plan.generation
+                self.rank = plan.rank if plan.rank is not None else -1
+                return plan
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"elastic join: not admitted within "
+                    f"{self.opts.rejoin_s:g}s (grow_at="
+                    f"{resp.get('grow_at')})")
+            time.sleep(self.opts.heartbeat_s)
+
+
+# ----------------------------------------------------------------------
+def guarded_call(fn, member: Optional[ElasticMember],
+                 timeout_s: float = 30.0, what: str = "collective"):
+    """Run a blocking (collective-bearing) op under the deadline.
+
+    A confirmed peer loss (``member.lost_event``) raises
+    :class:`ReplicaLossError` immediately; past ``timeout_s`` a peer
+    the monitor merely *suspects* (missed beats, not yet evicted) is
+    presumed lost; a slow-but-alive mesh only logs
+    ``mesh.collective_slow`` and keeps waiting.  The abandoned worker
+    thread is daemonized — with a truly dead peer gloo errors it out
+    shortly (TCP reset), and the rebuild path joins it with a grace
+    before tearing the backend down."""
+    if member is None:
+        return fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def run() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 - relayed to caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, name=f"cxxnet-guarded-{what}",
+                         daemon=True)
+    t.start()
+    guarded_call.last_thread = t  # rebuild joins it with a grace
+    t0 = time.monotonic()
+    warned = False
+    while not done.wait(0.05):
+        if member.lost_event.is_set():
+            # give the op a moment to surface its own (richer) error
+            done.wait(0.5)
+            if not done.is_set():
+                plan = member.pending_plan()
+                raise ReplicaLossError(
+                    f"replica lost during {what}"
+                    + (f" ({member.abort_reason})" if member.abort_reason
+                       else ""),
+                    lost=plan.lost_ranks if plan else (),
+                    generation=plan.generation if plan else 0,
+                    fatal=bool(member.abort_reason),
+                )
+        elapsed = time.monotonic() - t0
+        if elapsed > timeout_s:
+            suspects = member.suspects()
+            if suspects:
+                raise ReplicaLossError(
+                    f"{what} exceeded collective_timeout_s="
+                    f"{timeout_s:g}s with unresponsive replica(s) "
+                    f"{suspects} — presumed lost", lost=suspects,
+                    presumed=True,
+                )
+            if not warned:
+                warned = True
+                obs_emit("mesh.collective_slow", what=what,
+                         elapsed_s=round(elapsed, 3),
+                         timeout_s=timeout_s)
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+guarded_call.last_thread = None
+
+
+def classify_failure(exc: BaseException,
+                     member: Optional[ElasticMember],
+                     confirm_s: float = 5.0) -> Optional[ReplicaLossError]:
+    """Translate a collective failure into :class:`ReplicaLossError`.
+
+    A SIGKILLed peer usually surfaces as a gloo/coordination-service
+    runtime error (TCP reset) before the liveness monitor evicts it —
+    wait up to ``confirm_s`` for the monitor to agree, then classify.
+    Returns None for errors that are NOT a replica loss (they re-raise
+    at the call site)."""
+    if isinstance(exc, ReplicaLossError):
+        return exc
+    if member is None:
+        return None
+    text = f"{type(exc).__name__}: {exc}"
+    # deliberately NARROW: only the collective transport (gloo), the
+    # coordination service, and the mesh.replica injection site read as
+    # replica loss.  Generic connection errors (a down data source, an
+    # HTTP dependency) must surface as themselves, not trigger an
+    # endless rebuild loop.  Transport-level resets count only when the
+    # error came out of the XLA runtime.
+    markers = ("Gloo", "gloo", "coordination service",
+               "CoordinationService",
+               "mesh.replica")  # the fault-injection site (utils/faults)
+    if "XlaRuntimeError" in text and any(
+            m in text for m in ("Connection reset", "Connection closed",
+                                "Socket closed", "DEADLINE_EXCEEDED",
+                                "UNAVAILABLE")):
+        markers = markers + ("XlaRuntimeError",)
+    if not any(m in text for m in markers):
+        return None
+    confirmed = member.lost_event.wait(timeout=confirm_s)
+    plan = member.pending_plan()
+    return ReplicaLossError(
+        f"collective failed ({text[:300]}); replica loss "
+        + ("confirmed" if confirmed else "presumed"),
+        lost=plan.lost_ranks if plan else (),
+        generation=plan.generation if plan else 0,
+        presumed=not confirmed,
+        fatal=bool(member.abort_reason),
+    )

@@ -653,12 +653,18 @@ class Engine:
         # breaker: the model still serves, but a load balancer keying on
         # /healthz sees (and can act on) the named condition
         from ..obs import alerts as obs_alerts
+        from ..parallel import elastic as par_elastic
 
         firing = obs_alerts.evaluator().firing()
+        # a serve-colocated trainer mid mesh-rebuild degrades health the
+        # same way an open reload breaker does: the model still serves,
+        # but a load balancer sees the named transient condition
+        rebuilding = par_elastic.rebuild_in_progress()
         with self._model_lock:
             status = ("closed" if self._closed
                       else "degraded" if (self.reload_degraded()
-                                          or firing) else "ok")
+                                          or firing or rebuilding)
+                      else "ok")
             out = {
                 "status": status,
                 "round": self._round,
@@ -668,6 +674,8 @@ class Engine:
                 "quant": self._cache.quant_scheme() or "f32",
                 "reload_breaker": self.reload_breaker.state,
             }
+            if rebuilding:
+                out["mesh"] = "rebuilding"
             if firing:
                 out["alerts"] = firing
             return out

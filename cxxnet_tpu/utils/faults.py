@@ -88,6 +88,12 @@ SITES: Dict[str, Tuple[str, ...]] = {
     # DEGRADE — the record is dropped and counted, the serving request
     # still succeeds (doc/continuous_training.md)
     "loop.append": ("ioerror", "latency"),
+    # replica loss (nnet/trainer.py::sync, the elastic pod's collective
+    # fence): hang = a peer wedged in a collective (the deadline must
+    # surface ReplicaLossError in bounded time), ioerror = the abrupt
+    # connection reset a SIGKILLed peer produces (classified into
+    # ReplicaLossError by the elastic driver) — doc/parallel.md
+    "mesh.replica": ("hang", "ioerror"),
 }
 
 KINDS = ("ioerror", "corrupt", "latency", "hang")

@@ -1,0 +1,473 @@
+"""Elastic pod: liveness coordination, the collective deadline, the
+pinned-order (shard_map) reduction, and backend re-init.
+
+The end-to-end kill-one-process acceptance (4-process CPU mesh, one
+rank SIGKILLed, bitwise parity vs a planned-resize run) lives in the
+``ELASTIC=1`` lane (``tools/elastic_kill.py``); these tests pin the
+pieces in-process: the coordinator/member state machine, the typed
+``ReplicaLossError`` surfacing within ``collective_timeout_s``, the
+``det_reduce`` determinism contract, teardown/re-init, and the
+observability surface (doc/parallel.md "Elastic pod").
+"""
+
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.parallel import elastic as E
+from cxxnet_tpu.utils import faults
+
+# ----------------------------------------------------------------------
+# options parsing
+
+
+def test_options_from_cfg_defaults_and_keys():
+    o = E.ElasticOptions.from_cfg([])
+    assert not o.elastic and o.min_replicas == 1
+    assert o.collective_timeout_s == 30.0
+    o = E.ElasticOptions.from_cfg([
+        ("elastic", "1"), ("elastic_min_replicas", "2"),
+        ("elastic_rejoin_s", "9"), ("elastic_heartbeat_s", "0.1"),
+        ("elastic_timeout_s", "0.7"), ("collective_timeout_s", "3"),
+        ("elastic_coordinator", "h:1234"), ("elastic_drop_at", "4"),
+        ("elastic_join", "1"), ("elastic_join_at", "6"),
+    ])
+    assert o.elastic and o.join and o.min_replicas == 2
+    assert (o.rejoin_s, o.heartbeat_s, o.timeout_s) == (9.0, 0.1, 0.7)
+    assert o.collective_timeout_s == 3.0
+    assert (o.coordinator, o.drop_at, o.join_at) == ("h:1234", 4, 6)
+    with pytest.raises(ValueError, match="elastic_min_replicas"):
+        E.ElasticOptions.from_cfg([("elastic_min_replicas", "0")])
+
+
+def test_resolve_coordinator_defaults_to_dist_port_plus_one():
+    o = E.ElasticOptions()
+    assert o.resolve_coordinator("node0:9000") == "node0:9001"
+    o.coordinator = "other:7"
+    assert o.resolve_coordinator("node0:9000") == "other:7"
+
+
+# ----------------------------------------------------------------------
+# coordinator / member state machine (real TCP, no jax involvement)
+def _cluster(n=3, min_replicas=1, timeout_s=0.6):
+    opts = E.ElasticOptions(elastic=True, heartbeat_s=0.1,
+                            timeout_s=timeout_s,
+                            min_replicas=min_replicas)
+    m0 = E.ElasticMember("localhost:0", 0, opts, host_coordinator=True,
+                         num=n, jax_host="localhost")
+    members = [m0.start()]
+    for r in range(1, n):
+        members.append(E.ElasticMember(m0.addr, r, opts).start())
+    return opts, members
+
+
+def _close_all(members):
+    for m in members:
+        m.close()
+
+
+def test_loss_detected_and_survivors_replanned():
+    """A member that stops heartbeating is classified LOST within
+    elastic_timeout_s; survivors receive a re-ranked generation plan
+    (relative order kept, rank 0 stays 0) with a fresh jax port."""
+    opts, ms = _cluster(3)
+    try:
+        time.sleep(0.3)
+        ms[2]._stop.set()
+        ms[2]._beat_thread.join()
+        t0 = time.monotonic()
+        assert ms[0].lost_event.wait(5), "loss not detected"
+        assert time.monotonic() - t0 < 3.0
+        assert ms[1].lost_event.wait(2)
+        time.sleep(0.3)
+        p0, p1 = ms[0].pending_plan(), ms[1].pending_plan()
+        assert p0.reason == "replica_lost" and p0.lost_ranks == [2]
+        assert (p0.num, p0.rank) == (2, 0)
+        assert (p1.num, p1.rank) == (2, 1)
+        assert p0.jax_coordinator == p1.jax_coordinator
+        assert p0.generation == p1.generation == 2
+        # adopting the plan clears the loss latch
+        ms[0].ack_generation(p0)
+        assert not ms[0].lost_event.is_set()
+        # the gauges recorded the transition
+        from cxxnet_tpu.obs.registry import registry
+
+        snap = registry().snapshot()
+        assert "mesh_replicas" in snap
+        assert snap["mesh_replicas"]['mesh_replicas{state="lost"}'] >= 1.0
+    finally:
+        _close_all(ms)
+
+
+def test_planned_shrink_drops_highest_rank_idempotently():
+    opts, ms = _cluster(3)
+    try:
+        plans = [m.plan_shrink(5) for m in ms]  # all ranks, same round
+        gens = {p.generation for p in plans}
+        assert gens == {2}, "one transition, one generation"
+        assert plans[2].rank is None, "highest rank leaves"
+        assert (plans[0].rank, plans[1].rank) == (0, 1)
+        assert plans[0].num == 2 and plans[0].at_round == 5
+        assert plans[0].reason == "planned_shrink"
+    finally:
+        _close_all(ms)
+
+
+def test_grow_admits_waiter_and_survives_round_skew():
+    """A joiner is admitted at the scheduled boundary; a member whose
+    boundary call arrives one round late still receives the SAME plan
+    (no split rendezvous)."""
+    opts, ms = _cluster(2)
+    try:
+        waiter = E.ElasticMember(ms[0].addr, -1, opts)
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.update(plan=waiter.join(timeout_s=10)),
+            daemon=True)
+        t.start()
+        time.sleep(0.3)
+        ms[0].poll_now()
+        g = ms[0].grow_round()
+        assert g is not None
+        pa = ms[0].plan_grow(g)
+        pb = ms[1].plan_grow(g + 1)  # skewed boundary: same plan
+        assert pa.generation == pb.generation
+        assert pa.num == 3 and pa.reason == "grow"
+        t.join(timeout=5)
+        assert box["plan"].rank == 2
+    finally:
+        _close_all(ms)
+
+
+def test_abort_below_min_replicas():
+    opts, ms = _cluster(2, min_replicas=2)
+    try:
+        ms[1]._stop.set()
+        ms[1]._beat_thread.join()
+        assert ms[0].lost_event.wait(5)
+        time.sleep(0.3)
+        ms[0].poll_now()
+        assert ms[0].abort_reason, "survivors below min must abort"
+        assert "elastic_min_replicas" in ms[0].abort_reason
+    finally:
+        _close_all(ms)
+
+
+def test_slow_vs_lost_classification():
+    """A briefly silent member is only SUSPECT (mesh.replica_slow) —
+    it recovers by beating again; silence past elastic_timeout_s is
+    LOST (membership removed)."""
+    opts, ms = _cluster(2, timeout_s=1.5)
+    try:
+        # suspend heartbeats for ~4 intervals: suspect, not lost
+        ms[1]._stop.set()
+        ms[1]._beat_thread.join()
+        time.sleep(0.5)
+        ms[0].poll_now()
+        assert ms[0].suspects() == [1]
+        assert not ms[0].lost_event.is_set()
+        # resume beating: suspicion clears
+        ms[1]._stop = threading.Event()
+        ms[1]._beat_thread = threading.Thread(
+            target=ms[1]._beat_loop, daemon=True)
+        ms[1]._beat_thread.start()
+        time.sleep(0.4)
+        ms[0].poll_now()
+        assert ms[0].suspects() == []
+        assert not ms[0].lost_event.is_set()
+    finally:
+        _close_all(ms)
+
+
+# ----------------------------------------------------------------------
+# collective deadline + classification
+class _Stub:
+    def __init__(self, lost=False, suspects=()):
+        self.lost_event = threading.Event()
+        if lost:
+            self.lost_event.set()
+        self.abort_reason = ""
+        self._s = list(suspects)
+
+    def suspects(self):
+        return list(self._s)
+
+    def pending_plan(self):
+        return None
+
+
+def test_replica_loss_surfaces_within_collective_timeout():
+    """Acceptance: a dead peer inside a collective surfaces as the
+    typed ReplicaLossError within collective_timeout_s — via the
+    mesh.replica fault site, no real process death needed."""
+    faults.install("mesh.replica:hang:1:1")
+    tr = NetTrainer()  # sync() is the instrumented fence
+    member = _Stub(suspects=[3])
+    t0 = time.monotonic()
+    with pytest.raises(E.ReplicaLossError) as ei:
+        E.guarded_call(tr.sync, member, timeout_s=0.5, what="step fence")
+    elapsed = time.monotonic() - t0
+    faults.reset()  # release the hung worker
+    assert elapsed < 5.0, f"deadline did not bound the hang ({elapsed})"
+    assert ei.value.presumed and ei.value.lost == [3]
+
+
+def test_confirmed_loss_preempts_deadline():
+    member = _Stub(lost=True)
+    faults.install("mesh.replica:hang:1:1")
+    t0 = time.monotonic()
+    with pytest.raises(E.ReplicaLossError) as ei:
+        E.guarded_call(lambda: faults.fault_point("mesh.replica"),
+                       member, timeout_s=30.0, what="collective")
+    faults.reset()
+    assert time.monotonic() - t0 < 5.0, "confirmed loss must not wait"
+    assert not ei.value.presumed
+
+
+def test_slow_mesh_keeps_waiting():
+    """Past the deadline with NO suspect, the guard logs and keeps
+    waiting — a slow replica is not a dead one."""
+    member = _Stub()
+
+    def slow():
+        time.sleep(0.6)
+        return 41 + 1
+
+    assert E.guarded_call(slow, member, timeout_s=0.2,
+                          what="slow") == 42
+
+
+def test_guarded_call_passthrough_without_member():
+    assert E.guarded_call(lambda: 7, None) == 7
+
+
+def test_classify_failure_translates_collective_errors():
+    member = _Stub(lost=True)
+    loss = E.classify_failure(
+        ValueError("Gloo all-reduce failed: Connection reset by peer"),
+        member, confirm_s=0.1)
+    assert isinstance(loss, E.ReplicaLossError) and not loss.presumed
+    # an unrelated error is NOT a replica loss
+    assert E.classify_failure(ValueError("shape mismatch"),
+                              member) is None
+    # without a member there is nothing to classify against
+    assert E.classify_failure(ValueError("Gloo says hi"), None) is None
+    # a ReplicaLossError passes through unchanged
+    orig = E.ReplicaLossError("x", lost=[1])
+    assert E.classify_failure(orig, member) is orig
+
+
+# ----------------------------------------------------------------------
+# det_reduce: the shard_map determinism contract
+MLP_CFG = [
+    ("dev", "tpu:0-3"),
+    ("batch_size", "16"),
+    ("input_shape", "1,1,16"),
+    ("seed", "7"),
+    ("eta", "0.1"),
+    ("momentum", "0.9"),
+    ("netconfig", "start"),
+    ("layer[0->1]", "fullc:fc1"),
+    ("nhidden", "32"),
+    ("layer[1->2]", "sigmoid"),
+    ("layer[2->3]", "fullc:fc2"),
+    ("nhidden", "8"),
+    ("layer[3->3]", "softmax"),
+    ("netconfig", "end"),
+]
+
+
+def _build(extra=()):
+    tr = NetTrainer()
+    tr.set_params(list(MLP_CFG) + list(extra))
+    tr.init_model()
+    return tr
+
+
+def _steps(tr, n=4, seed=3):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        tr.update(DataBatch(
+            data=rng.randn(16, 16).astype(np.float32),
+            label=rng.randint(0, 8, (16, 1)).astype(np.float32),
+        ))
+
+
+def test_det_reduce_matches_gspmd_and_is_reproducible():
+    """Pinned-order reduction is placement+order, not different math:
+    allclose to the GSPMD step, and bitwise equal across runs."""
+    a, b, c = _build(), _build([("det_reduce", "1")]), \
+        _build([("det_reduce", "1")])
+    for tr in (a, b, c):
+        _steps(tr)
+    for key in a.params:
+        for tag in a.params[key]:
+            np.testing.assert_allclose(
+                np.asarray(a.params[key][tag]),
+                np.asarray(b.params[key][tag]),
+                rtol=2e-4, atol=2e-5,
+                err_msg=f"{key}/{tag}: det_reduce changed the math")
+            np.testing.assert_array_equal(
+                np.asarray(b.params[key][tag]),
+                np.asarray(c.params[key][tag]),
+                err_msg=f"{key}/{tag}: det_reduce not deterministic")
+
+
+def test_det_reduce_hlo_has_no_allreduce():
+    """The compiled step's cross-replica combine is the all-gather +
+    ordered fold — no all-reduce whose internal order a backend could
+    choose per mesh shape."""
+    import jax
+    import jax.numpy as jnp
+
+    tr = _build([("det_reduce", "1")])
+    fn = tr._fused_step_fn()
+    txt = fn.lower(
+        tr.params, tr.ustates, tr.aux,
+        jnp.zeros((16, 16), jnp.float32), jnp.zeros((16, 1), jnp.float32),
+        jnp.ones((16,), jnp.float32), jax.random.PRNGKey(0),
+        jnp.asarray(0, jnp.int32), (),
+    ).compile().as_text()
+    assert "all-gather" in txt
+    assert "all-reduce" not in txt
+
+
+def test_det_reduce_rejects_unsupported_shapes():
+    for extra, marker in (
+        ([("model_parallel", "2")], "model_parallel"),
+        ([("zero", "1")], "zero"),
+        ([("update_period", "2")], "update_period"),
+    ):
+        with pytest.raises(ValueError, match="det_reduce"):
+            _build([("det_reduce", "1")] + extra)
+
+
+def test_det_reduce_rejects_stochastic_layers():
+    """Dropout under the shard_map region would draw the SAME mask on
+    every shard (replicated rng) — rejected, not silently changed."""
+    cfg = [
+        ("dev", "tpu:0-3"), ("batch_size", "16"),
+        ("input_shape", "1,1,16"), ("seed", "7"), ("eta", "0.1"),
+        ("det_reduce", "1"),
+        ("netconfig", "start"),
+        ("layer[0->1]", "fullc:fc1"), ("nhidden", "32"),
+        ("layer[1->2]", "dropout"), ("threshold", "0.5"),
+        ("layer[2->3]", "fullc:fc2"), ("nhidden", "8"),
+        ("layer[3->3]", "softmax"),
+        ("netconfig", "end"),
+    ]
+    tr = NetTrainer()
+    tr.set_params(cfg)
+    with pytest.raises(ValueError, match="stochastic"):
+        tr.init_model()
+
+
+def test_det_reduce_single_device_is_noop():
+    """On a 1-device mesh there is no cross-replica reduction to pin —
+    the key is accepted and training runs the plain path."""
+    tr = NetTrainer()
+    tr.set_params([("dev", "cpu") if k == "dev" else (k, v)
+                   for k, v in MLP_CFG] + [("det_reduce", "1")])
+    tr.init_model()
+    _steps(tr, n=2)
+    assert tr.epoch_counter == 2
+
+
+# ----------------------------------------------------------------------
+# shutdown/re-init regression (satellite: maybe_init_distributed was
+# one-shot init-only).  Runs in a SUBPROCESS: the resilient client's
+# poll thread cannot be stopped from Python, so an in-pytest client
+# would risk the interpreter-exit destructor abort the CLI guards
+# against with its own hard-exit.
+_REINIT_SCRIPT = r"""
+import os, socket, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+import numpy as np
+from cxxnet_tpu.parallel import distributed as D
+
+def free_port():
+    s = socket.socket(); s.bind(("localhost", 0))
+    p = s.getsockname()[1]; s.close(); return p
+
+def collective(tag):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), ("data",))
+    f = jax.jit(lambda x: x.sum(), out_shardings=NamedSharding(mesh, P()))
+    x = jax.device_put(np.ones((4,), np.float32),
+                       NamedSharding(mesh, P("data")))
+    v = float(jax.block_until_ready(f(x)))
+    assert v == 4.0, (tag, v)
+    print(f"{tag}: ok nproc={jax.process_count()}", flush=True)
+
+# cycle 1: the stock (config-driven) path
+assert D.maybe_init_distributed(
+    [("dist_coordinator", f"localhost:{free_port()}"),
+     ("dist_num_proc", "1"), ("dist_proc_id", "0")])
+assert D.distributed_initialized()
+collective("gen1")
+assert D.shutdown_distributed()  # clean: every step completes
+assert not D.distributed_initialized()
+# cycle 2: resilient re-init in the SAME process
+D.init_distributed(f"localhost:{free_port()}", 1, 0, resilient=True)
+assert D.distributed_initialized()
+collective("gen2")
+D.shutdown_distributed(graceful=False)
+# cycle 3: and again — teardown is safe to call twice per process
+D.init_distributed(f"localhost:{free_port()}", 1, 0, resilient=True)
+collective("gen3")
+print("REINIT-OK", flush=True)
+sys.stdout.flush()
+os._exit(0)  # skip destructor-order teardown (cli.py does the same)
+"""
+
+
+@pytest.mark.slow
+def test_shutdown_and_reinit_twice_in_one_process(tmp_path):
+    script = tmp_path / "reinit.py"
+    script.write_text(_REINIT_SCRIPT)
+    import os as _os
+
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True,
+        timeout=240, env={**_os.environ, "PYTHONPATH": repo},
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "REINIT-OK" in out.stdout, out.stdout + out.stderr
+    for tag in ("gen1", "gen2", "gen3"):
+        assert f"{tag}: ok nproc=1" in out.stdout
+
+
+# ----------------------------------------------------------------------
+# observability surface
+def test_healthz_degrades_while_rebuilding():
+    from cxxnet_tpu import serve
+    from test_serve import make_trainer
+
+    eng = serve.Engine(trainer=make_trainer(), max_batch_size=8,
+                       batch_timeout_ms=0)
+    try:
+        assert eng.healthz()["status"] == "ok"
+        E.set_rebuilding(True)
+        h = eng.healthz()
+        assert h["status"] == "degraded"
+        assert h["mesh"] == "rebuilding"
+    finally:
+        E.set_rebuilding(False)
+        eng.close()
+    assert not E.rebuild_in_progress()
+
+
+def test_replica_loss_error_carries_typed_fields():
+    e = E.ReplicaLossError("gone", lost=[1, 3], generation=4,
+                           presumed=True, fatal=False)
+    assert e.lost == [1, 3] and e.generation == 4
+    assert e.presumed and not e.fatal

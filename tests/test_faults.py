@@ -683,6 +683,59 @@ def _scn_loop_append(kind, tmp_path):
         w.close()
 
 
+class _StubMember:
+    """The duck-typed slice of ElasticMember that guarded_call /
+    classify_failure consume — lets the mesh.replica chaos lanes run
+    without real peer processes."""
+
+    def __init__(self, lost=False, suspects=()):
+        self.lost_event = threading.Event()
+        if lost:
+            self.lost_event.set()
+        self.abort_reason = ""
+        self._suspects = list(suspects)
+
+    def suspects(self):
+        return list(self._suspects)
+
+    def pending_plan(self):
+        return None
+
+
+def _scn_mesh_replica(kind, tmp_path):
+    """Replica-loss faults must surface as the TYPED ReplicaLossError in
+    bounded time — never an indefinite hang inside a collective.
+    ``hang`` models a peer wedged in a collective: the deadline
+    (collective_timeout_s) fires while the liveness monitor suspects
+    the peer.  ``ioerror`` models the connection-reset a SIGKILLed peer
+    produces: the raised error is classified into ReplicaLossError."""
+    import time as _time
+
+    from cxxnet_tpu.parallel import elastic as par_elastic
+
+    if kind == "hang":
+        faults.install("mesh.replica:hang:1:1")
+        member = _StubMember(suspects=[2])
+        t0 = _time.monotonic()
+        with pytest.raises(par_elastic.ReplicaLossError) as ei:
+            par_elastic.guarded_call(
+                lambda: faults.fault_point("mesh.replica"),
+                member, timeout_s=0.5, what="chaos collective")
+        assert _time.monotonic() - t0 < 5.0  # bounded, not hang_s
+        assert ei.value.presumed and ei.value.lost == [2]
+        faults.reset()  # release the hung worker thread
+        return
+    faults.install("mesh.replica:ioerror:1:1")
+    member = _StubMember(lost=True)
+    with pytest.raises(OSError):
+        faults.fault_point("mesh.replica")
+    faults.fault_point("mesh.replica")  # limit spent: clean
+    err = OSError("injected I/O error at mesh.replica")
+    loss = par_elastic.classify_failure(err, member, confirm_s=0.1)
+    assert isinstance(loss, par_elastic.ReplicaLossError)
+    assert not loss.presumed  # member confirmed the loss
+
+
 MATRIX = [
     pytest.param(site, kind, id=f"{site}-{kind}",
                  marks=[pytest.mark.chaos])
@@ -716,5 +769,7 @@ def test_fault_matrix(site, kind, tmp_path):
         _scn_serve_batch(kind, tmp_path)
     elif site == "loop.append":
         _scn_loop_append(kind, tmp_path)
+    elif site == "mesh.replica":
+        _scn_mesh_replica(kind, tmp_path)
     else:  # a new site without a scenario must fail the matrix
         pytest.fail(f"no chaos scenario for registered site {site!r}")

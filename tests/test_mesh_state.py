@@ -197,6 +197,55 @@ def test_checkpoint_reshards_onto_current_mesh(tmp_path):
     assert b.epoch_counter == 2
 
 
+def _grow_src_trainer():
+    """A 4-way zero=1 trainer with one step of momentum in its state —
+    the SMALLER mesh a growing pod reshards FROM (save_ustate so the
+    updater state's bit-equality is provable through the round trip)."""
+    tr = NetTrainer()
+    tr.set_params(
+        [(k, "tpu:0-3" if k == "dev" else v) for k, v in MLP8_CFG]
+        + [("shard_weight_update", "1"), ("save_ustate", "1")]
+    )
+    tr.init_model()
+    _step(tr)
+    return tr
+
+
+@pytest.mark.parametrize("zero", [1, 3])
+def test_checkpoint_reshards_onto_larger_mesh(tmp_path, zero):
+    """Mesh GROWTH (the elastic rejoin path): a checkpoint written on
+    the 4-way mesh loads into an 8-way trainer — zero=1 keeps params
+    whole on the new mesh with updater state sharded 8 ways; zero=3
+    shards the params themselves — and every restored leaf is
+    bit-equal.  The shrink direction is covered by
+    test_checkpoint_reshards_onto_current_mesh above."""
+    a = _grow_src_trainer()
+    path = str(tmp_path / "grow.model")
+    a.save_model(path, round_=0)
+
+    b = NetTrainer()
+    b.set_params(list(MLP8_CFG)
+                 + [("zero", str(zero)), ("save_ustate", "1")])
+    b.load_model(path)
+    w = b.params["l0_fc1"]["wmat"]
+    assert len(w.sharding.device_set) == 8      # ...on the LARGER mesh
+    if zero == 1:
+        assert w.sharding.is_fully_replicated
+    else:
+        assert "data" in tuple(w.sharding.spec)  # FSDP: params sharded
+        assert w.addressable_shards[0].data.shape[0] == w.shape[0] // 8
+    m = b.ustates["l0_fc1"]["wmat"]["m"]
+    assert "data" in tuple(m.sharding.spec)
+    assert m.addressable_shards[0].data.shape[0] == m.shape[0] // 8
+    np.testing.assert_array_equal(
+        np.asarray(a.params["l0_fc1"]["wmat"]), np.asarray(w))
+    np.testing.assert_array_equal(
+        np.asarray(a.ustates["l0_fc1"]["wmat"]["m"]), np.asarray(m))
+    # and the grown trainer still trains with donated buffers intact
+    _step(b, seed=1)
+    assert b.epoch_counter == 2
+
+
 def test_zero3_one_program_gathers_and_aliases():
     """The one-program claim in the compiled HLO: the zero=3 fused step
     (a) all-gathers param shards just-in-time (gather-before-use — no

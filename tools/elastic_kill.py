@@ -1,0 +1,383 @@
+"""ELASTIC=1 lane: kill-one-process elastic recovery with bitwise parity.
+
+The elastic-pod acceptance (ROADMAP item 3, doc/parallel.md "Elastic
+pod"), proven end to end through the real CLI on a 4-process CPU mesh:
+
+* **Run A (churn)** — 4 ``jax.distributed`` processes (1 CPU device
+  each) train the MNIST-format MLP conf with ``elastic = 1``.  One
+  NON-ZERO rank is SIGKILLed mid-round; the survivors must detect the
+  loss, tear down, re-init as a 3-process mesh inside the same CLI
+  invocation, reload the consensus round, and keep training.  A fifth
+  process launched with ``elastic_join = 1`` waits out the churn and is
+  admitted at a pinned later boundary, growing the mesh back to 4.
+* **Run B (planned)** — the SAME shrink-at-k / grow-at-j schedule
+  executed deliberately (``elastic_drop_at`` = run A's observed resume
+  round; ``elastic_join_at`` unchanged), with no kill anywhere.
+* **Parity** — every checkpoint manifest CRC32 the two runs write must
+  be IDENTICAL.  ``det_reduce = 1`` pins the gradient-reduction order
+  via the shard_map re-expression, ``dist_shard = block`` +
+  ``RecordRNG`` pin the input stream, and ``save_ustate = 1`` carries
+  the updater state across every rebuild — so a run that lost a replica
+  is bit-equal to one that resized on purpose.
+* The verdict JSON (rebuild wall time, recovered samples/sec, CRC
+  equality) appends to a ``perf_guard`` history (``--bench elastic``)
+  so recovery cost is regression-tracked.
+
+Usage::
+
+    python tools/elastic_kill.py --out /tmp/_elastic       # the CI lane
+    python tools/perf_guard.py --bench elastic \\
+        --input /tmp/_elastic/elastic.json --history bench_history.jsonl
+
+Exit code: 0 when the schedule replayed and every CRC matches; 1
+otherwise (hard gate, not weather).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NUM_ROUND = 8
+GLOBAL_BATCH = 12          # divides 4-way AND 3-way data meshes
+N_IMAGES = 960             # 80 global batches/round; blocks tile 4 and 3
+N_HIDDEN = 256             # enough per-round work to kill mid-round
+KILL_AFTER_CKPT = 3        # SIGKILL once 0003.model is durable
+JOIN_AT = 7                # grow boundary (start_counter units)
+KILL_RANK = 3              # never rank 0 (it hosts both coordinators)
+
+
+def _free_port() -> int:
+    from cxxnet_tpu.parallel.elastic import free_port
+
+    return free_port()
+
+
+def make_data(out_dir: str) -> None:
+    import numpy as np
+
+    from cxxnet_tpu.io.mnist import write_idx_images, write_idx_labels
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (N_IMAGES, 4, 4)).astype(np.uint8)
+    labels = (imgs.reshape(N_IMAGES, -1).mean(1) > 127).astype(np.uint8)
+    write_idx_images(os.path.join(out_dir, "img.idx"), imgs)
+    write_idx_labels(os.path.join(out_dir, "lab.idx"), labels)
+
+
+def make_conf(out_dir: str) -> str:
+    """One conf for every process of both runs; per-run/per-rank keys
+    ride as CLI overrides.  ``model_dir`` is overridden to a SHARED
+    absolute path per run (the consensus reload and the joiner both
+    read rank 0's checkpoints)."""
+    conf = os.path.join(out_dir, "elastic.conf")
+    with open(conf, "w", encoding="utf-8") as f:
+        f.write(f"""
+data = train
+iter = mnist
+  path_img = "{out_dir}/img.idx"
+  path_label = "{out_dir}/lab.idx"
+  shuffle = 1
+  dist_shard = block
+iter = end
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = {N_HIDDEN}
+  init_sigma = 0.1
+layer[fc1->out] = fullc:fc2
+  nhidden = 2
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = {GLOBAL_BATCH}
+dev = cpu
+num_round = {NUM_ROUND}
+eval_train = 0
+eta = 0.1
+momentum = 0.9
+seed = 7
+save_ustate = 1
+det_reduce = 1
+metric = error
+silent = 1
+telemetry = 1
+elastic = 1
+elastic_min_replicas = 2
+elastic_heartbeat_s = 0.25
+elastic_timeout_s = 3
+collective_timeout_s = 30
+""")
+    return conf
+
+
+def launch_rank(conf: str, workdir: str, model_dir: str, rank: int,
+                nproc: int, jax_port: int, elastic_port: int,
+                extra=()):
+    d = os.path.join(workdir, f"p{rank}")
+    os.makedirs(d, exist_ok=True)
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    }
+    over = [f"model_dir={model_dir}",
+            f"elastic_coordinator=localhost:{elastic_port}"]
+    if rank >= 0:
+        over += [f"dist_coordinator=localhost:{jax_port}",
+                 f"dist_num_proc={nproc}", f"dist_proc_id={rank}"]
+    over += list(extra)
+    log = open(os.path.join(d, "out.log"), "wb")
+    p = subprocess.Popen(
+        [sys.executable, "-u", "-m", "cxxnet_tpu", conf] + over,
+        env=env, cwd=d, stdout=log, stderr=subprocess.STDOUT,
+    )
+    p._log_file = log  # type: ignore[attr-defined]
+    p._workdir = workdir  # type: ignore[attr-defined]
+    p._rank = rank     # type: ignore[attr-defined]
+    return p
+
+
+def rank_log(workdir: str, rank: int) -> str:
+    try:
+        with open(os.path.join(workdir, f"p{rank}", "out.log"), "r",
+                  encoding="utf-8", errors="replace") as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
+def wait_for_checkpoint(model_dir: str, round_: int, procs,
+                        timeout: float) -> bool:
+    """Block until ``<round>.model``'s manifest is durable (or every
+    process exited / the budget ran out)."""
+    from cxxnet_tpu.utils import checkpoint as ckpt
+
+    want = ckpt.manifest_path(
+        os.path.join(model_dir, f"{round_:04d}.model"))
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if os.path.exists(want):
+            return True
+        if all(p.poll() is not None for p in procs):
+            return False
+        time.sleep(0.05)
+    return False
+
+
+def drain(procs, timeout: float, problems, tag: str,
+          expect_fail_ranks=()):
+    deadline = time.time() + timeout
+    for p in procs:
+        left = max(1.0, deadline - time.time())
+        try:
+            p.wait(timeout=left)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+            problems.append(f"{tag}: rank {p._rank} process timed out")
+        finally:
+            p._log_file.close()
+    for p in procs:
+        if p._rank in expect_fail_ranks:
+            continue
+        if p.returncode != 0:
+            problems.append(
+                f"{tag}: rank {p._rank} exited rc={p.returncode}; "
+                "tail:\n" + rank_log(p._workdir, p._rank)[-2500:])
+
+
+def read_crcs(model_dir: str) -> dict:
+    from cxxnet_tpu.utils import checkpoint as ckpt
+
+    out = {}
+    for round_, path in ckpt.list_checkpoints(model_dir):
+        man = ckpt.read_manifest(path)
+        if man is not None:
+            out[round_] = man["crc32"]
+    return out
+
+
+def read_telemetry(workdir: str, rank: int = 0) -> list:
+    path = os.path.join(workdir, f"p{rank}", "telemetry.jsonl")
+    recs = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    recs.append(json.loads(line))
+    except (OSError, ValueError):
+        pass
+    return recs
+
+
+def run_churn(conf: str, workdir: str, model_dir: str,
+              timeout: float, problems) -> dict:
+    """Run A: 4 ranks + 1 waiting joiner; SIGKILL one rank mid-round."""
+    os.makedirs(model_dir, exist_ok=True)
+    jax_port, elastic_port = _free_port(), _free_port()
+    procs = [launch_rank(conf, workdir, model_dir, r, 4, jax_port,
+                         elastic_port) for r in range(4)]
+    joiner = launch_rank(
+        conf, workdir, model_dir, -1, 0, jax_port, elastic_port,
+        extra=["elastic_join=1", f"elastic_join_at={JOIN_AT}",
+               "elastic_rejoin_s=240", "dist_shard=block"])
+    killed_at = None
+    if wait_for_checkpoint(model_dir, KILL_AFTER_CKPT, procs,
+                           timeout=timeout / 2):
+        time.sleep(0.2)  # let the next round get airborne
+        procs[KILL_RANK].send_signal(signal.SIGKILL)
+        killed_at = time.time()
+        print(f"churn: SIGKILLed rank {KILL_RANK} after checkpoint "
+              f"{KILL_AFTER_CKPT:04d}.model", flush=True)
+    else:
+        problems.append(
+            f"churn: checkpoint {KILL_AFTER_CKPT:04d}.model never "
+            "appeared; cannot stage the kill")
+    drain(procs + [joiner], timeout, problems, "churn",
+          expect_fail_ranks={KILL_RANK})
+    if procs[KILL_RANK].returncode == 0:
+        problems.append("churn: the killed rank exited 0 — the kill "
+                        "landed after training finished (too late)")
+    log0 = rank_log(workdir, 0)
+    resume = [int(m) for m in re.findall(
+        r"replica_lost -> rebuilding.*?\n.*?resuming at round (\d+)",
+        log0, re.S)]
+    grows = [int(m) for m in re.findall(
+        r"grow -> rebuilding.*?\n.*?resuming at round (\d+)", log0, re.S)]
+    if not resume:
+        problems.append("churn: rank 0 never rebuilt after the kill; "
+                        "log tail:\n" + log0[-2500:])
+    if not grows:
+        problems.append("churn: the mesh never grew back (joiner log "
+                        "tail:\n" + rank_log(workdir, -1)[-1500:] + ")")
+    tele = read_telemetry(workdir)
+    rebuild_s = max((r.get("elastic", {}).get("last_rebuild_s", 0.0)
+                     for r in tele), default=0.0)
+    post = [r for r in tele if r.get("elastic", {}).get("rebuilds", 0)]
+    rate = (post[-1].get("step", {}).get("samples_per_sec", 0.0)
+            if post else 0.0)
+    return {
+        "resume_round": resume[0] if resume else None,
+        "grow_round": grows[0] if grows else None,
+        "rebuild_wall_s": rebuild_s,
+        "recovered_samples_per_sec": rate,
+        "kill_staged": killed_at is not None,
+    }
+
+
+def run_planned(conf: str, workdir: str, model_dir: str, drop_at: int,
+                join_at: int, timeout: float, problems) -> dict:
+    """Run B: the identical schedule, resized on purpose (no kill)."""
+    os.makedirs(model_dir, exist_ok=True)
+    jax_port, elastic_port = _free_port(), _free_port()
+    procs = [launch_rank(conf, workdir, model_dir, r, 4, jax_port,
+                         elastic_port, extra=[f"elastic_drop_at={drop_at}"])
+             for r in range(4)]
+    joiner = launch_rank(
+        conf, workdir, model_dir, -1, 0, jax_port, elastic_port,
+        extra=["elastic_join=1", f"elastic_join_at={join_at}",
+               "elastic_rejoin_s=240", "dist_shard=block"])
+    drain(procs + [joiner], timeout, problems, "planned")
+    log3 = rank_log(workdir, 3)
+    if "left the mesh" not in log3:
+        problems.append("planned: rank 3 never executed the planned "
+                        "departure; log tail:\n" + log3[-2000:])
+    tele = read_telemetry(workdir)
+    rebuild_s = max((r.get("elastic", {}).get("last_rebuild_s", 0.0)
+                     for r in tele), default=0.0)
+    return {"rebuild_wall_s": rebuild_s}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="/tmp/_elastic",
+                    help="scratch + verdict directory")
+    ap.add_argument("--timeout", type=float, default=420.0,
+                    help="per-run wall-clock budget (seconds)")
+    ap.add_argument("--json", dest="json_path", default="",
+                    help="verdict path (default <out>/elastic.json)")
+    args = ap.parse_args()
+
+    os.makedirs(args.out, exist_ok=True)
+    make_data(args.out)
+    conf = make_conf(args.out)
+    problems: list = []
+
+    t0 = time.time()
+    churn_dir = os.path.join(args.out, "churn")
+    churn = run_churn(conf, churn_dir,
+                      os.path.join(churn_dir, "models"),
+                      args.timeout, problems)
+    churn_s = time.time() - t0
+
+    planned = {"rebuild_wall_s": 0.0}
+    planned_s = 0.0
+    crc_equal = False
+    churn_crcs: dict = {}
+    planned_crcs: dict = {}
+    if churn["resume_round"] is not None and not problems:
+        t1 = time.time()
+        planned_dir = os.path.join(args.out, "planned")
+        planned = run_planned(
+            conf, planned_dir, os.path.join(planned_dir, "models"),
+            drop_at=churn["resume_round"],
+            join_at=churn["grow_round"] or JOIN_AT,
+            timeout=args.timeout, problems=problems)
+        planned_s = time.time() - t1
+        churn_crcs = read_crcs(os.path.join(churn_dir, "models"))
+        planned_crcs = read_crcs(os.path.join(planned_dir, "models"))
+        if len(churn_crcs) != NUM_ROUND + 1:
+            problems.append(
+                f"churn run wrote rounds {sorted(churn_crcs)}, expected "
+                f"{NUM_ROUND + 1} checkpoints")
+        crc_equal = bool(churn_crcs) and churn_crcs == planned_crcs
+        if not crc_equal:
+            problems.append(
+                "BITWISE PARITY FAILED: killed-and-recovered CRCs "
+                f"{ {k: hex(v) for k, v in sorted(churn_crcs.items())} } "
+                "!= planned-resize CRCs "
+                f"{ {k: hex(v) for k, v in sorted(planned_crcs.items())} }")
+
+    doc = {
+        "bench": "elastic",
+        "ts": time.time(),
+        "rounds": NUM_ROUND,
+        "global_batch": GLOBAL_BATCH,
+        "resume_round": churn["resume_round"],
+        "grow_round": churn["grow_round"],
+        "crc_equal": crc_equal,
+        "crcs": {str(k): f"{v:#010x}"
+                 for k, v in sorted(churn_crcs.items())},
+        "churn": {"wall_sec": round(churn_s, 3),
+                  "rebuild_wall_s": churn["rebuild_wall_s"],
+                  "recovered_samples_per_sec":
+                      round(churn["recovered_samples_per_sec"], 2)},
+        "planned": {"wall_sec": round(planned_s, 3),
+                    "rebuild_wall_s": planned["rebuild_wall_s"]},
+        "problems": problems,
+        "verdict": "ok" if not problems else "fail",
+    }
+    json_path = args.json_path or os.path.join(args.out, "elastic.json")
+    with open(json_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc, indent=1))
+    for p in problems:
+        print(f"FAIL {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,11 +49,9 @@ N_IMAGES = 128
 
 
 def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("localhost", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    from cxxnet_tpu.parallel.elastic import free_port
+
+    return free_port()
 
 
 def make_data(out_dir: str) -> None:

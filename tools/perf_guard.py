@@ -52,7 +52,7 @@ VERDICTS = ("baseline", "ok", "regression")
 #: substrings marking a metric as lower-is-better (latencies, and the
 #: mesh lane's compile counts — MORE compiles is the re-jit regression)
 _LOWER_MARKERS = ("latency", "_ms", "p50", "p95", "p99", "wall_sec",
-                  "compiles", "programs")
+                  "compiles", "programs", "rebuild_wall_s")
 
 
 def lower_is_better(name: str) -> bool:
@@ -145,10 +145,30 @@ def flatten_quant_bench(doc: dict) -> Dict[str, float]:
     return out
 
 
+def flatten_elastic(doc: dict) -> Dict[str, float]:
+    """The ELASTIC lane's series (``tools/elastic_kill.py``): recovery
+    cost as regression-tracked numbers — rebuild wall time (lower is
+    better: a change that slows detection, teardown, or the consensus
+    reload drifts it up), the recovered post-rebuild training rate, and
+    the parity bit itself (crc_equal as 0/1 — a run that stops being
+    bitwise equal collapses far outside any noise band)."""
+    out: Dict[str, float] = {}
+    out["crc_equal"] = 1.0 if doc.get("crc_equal") else 0.0
+    for side in ("churn", "planned"):
+        d = doc.get(side, {})
+        for key in ("wall_sec", "rebuild_wall_s",
+                    "recovered_samples_per_sec"):
+            v = d.get(key)
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                out[f"{side}.{key}"] = float(v)
+    return out
+
+
 FLATTENERS = {"io_bench": flatten_io_bench,
               "serve_bench": flatten_serve_bench,
               "mesh_parity": flatten_mesh_parity,
-              "quant_bench": flatten_quant_bench}
+              "quant_bench": flatten_quant_bench,
+              "elastic": flatten_elastic}
 
 
 # ----------------------------------------------------------------------

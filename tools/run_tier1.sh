@@ -48,6 +48,19 @@
 #                                     # f32-vs-int8 closed-loop serve A/B
 #                                     # (quant leg must not regress), and
 #                                     # a quant_bench perf_guard entry
+#        ELASTIC=1 tools/run_tier1.sh # also run the elastic-pod lane:
+#                                     # a 4-process CPU-mesh CLI train
+#                                     # has one NON-ZERO rank SIGKILLed
+#                                     # mid-round; the survivors must
+#                                     # rebuild as a 3-process mesh
+#                                     # inside the same invocation, a
+#                                     # waiting joiner grows it back to
+#                                     # 4, and every checkpoint CRC
+#                                     # must be BITWISE equal to a
+#                                     # planned-resize run of the same
+#                                     # shrink/grow schedule; rebuild
+#                                     # latency + recovered throughput
+#                                     # append to a perf_guard history
 #        OBS=1 tools/run_tier1.sh     # also run the observability smoke:
 #                                     # short telemetry=1 train + serve
 #                                     # scrape of /metricsz + /alertz
@@ -113,6 +126,20 @@ if [ "${MESH:-0}" = "1" ]; then
       --input "$mesh_out/mesh_parity.json" \
       --history "$mesh_out/bench_history.jsonl" > /dev/null || rc=1
   echo "MESH lane verdict: $mesh_out/mesh_parity.json"
+fi
+if [ "${ELASTIC:-0}" = "1" ]; then
+  echo "=== opt-in elastic-pod lane (ELASTIC=1) ==="
+  elastic_out=/tmp/_elastic_lane
+  rm -rf "$elastic_out"; mkdir -p "$elastic_out"
+  # outer budget > 2x the tool's per-run --timeout (420 s each) plus
+  # data/conf setup slack
+  timeout -k 10 880 env JAX_PLATFORMS=cpu \
+    python tools/elastic_kill.py --out "$elastic_out" > /dev/null || rc=1
+  timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python tools/perf_guard.py --bench elastic \
+      --input "$elastic_out/elastic.json" \
+      --history "$elastic_out/bench_history.jsonl" > /dev/null || rc=1
+  echo "ELASTIC lane verdict: $elastic_out/elastic.json"
 fi
 if [ "${QUANT:-0}" = "1" ]; then
   echo "=== opt-in quantized-inference smoke (QUANT=1) ==="
