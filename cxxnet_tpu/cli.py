@@ -64,6 +64,11 @@ class LearnTask:
         self.gen_cache = 1
         self.serve_host = "127.0.0.1"
         self.serve_port = 9090
+        # serving fleet (doc/serving.md "Serving fleet"): replicas >= 2
+        # turns task=serve into a supervised multi-process fleet behind
+        # one routing front-end; fleet_* / canary_* keys are parsed by
+        # serve.fleet.FleetOptions from the raw cfg stream
+        self.replicas = 1
         self.serve_max_batch = 0  # 0: the trainer's batch_size
         self.batch_timeout_ms = 2.0
         self.queue_limit = 128
@@ -111,6 +116,8 @@ class LearnTask:
         self._elastic_consec_recoveries = 0
         self._elastic_attempted_gen = 0
         self._elastic_last_rebuild_s = 0.0
+        self.conf_path = ""
+        self.cli_overrides: List[str] = []
         self.cfg: List[tuple] = []
 
     # ------------------------------------------------------------------
@@ -175,6 +182,8 @@ class LearnTask:
             self.serve_host = val
         elif name == "serve_port":
             self.serve_port = int(val)
+        elif name == "replicas":
+            self.replicas = int(val)
         elif name == "max_batch_size":
             self.serve_max_batch = int(val)
         elif name == "batch_timeout_ms":
@@ -240,6 +249,11 @@ class LearnTask:
         if len(argv) < 1:
             print("Usage: <config> [name=val ...]")
             return 0
+        # the fleet supervisor re-launches this exact invocation per
+        # replica (conf + overrides, fleet keys pinned) — keep the raw
+        # argv around for serve.fleet.cli_spawn_fn
+        self.conf_path = argv[0]
+        self.cli_overrides = list(argv[1:])
         for name, val in cfgmod.parse_file(argv[0]):
             self.set_param(name, val)
         for name, val in cfgmod.parse_cli_overrides(argv[1:]):
@@ -1613,6 +1627,75 @@ class LearnTask:
         print(f"finished prediction, write into {self.name_pred} "
               f"({nrow} rows, {rate:.1f} rows/sec)")
 
+    def task_serve_fleet(self) -> None:
+        """``task=serve`` with ``replicas >= 2``: the serving fleet
+        (doc/serving.md "Serving fleet").
+
+        Launches ``replicas`` single-engine ``task=serve`` child
+        processes (each re-reading this conf with the fleet keys
+        pinned), supervises them (healthz probing, SLOW/GONE
+        classification, restart-with-backoff, eject-from-rotation of
+        wedged replicas), and runs the routing front-end on
+        ``serve_host:serve_port`` — priority-classed admission control
+        (batch sheds first), least-loaded dispatch with failover, and
+        deadline budgets split between route and execute.  With
+        ``serve_reload_period > 0`` new rounds in ``model_dir`` roll
+        out one replica at a time behind a fleet-level circuit
+        breaker; with ``canary = int8`` the fleet runs a rolling int8
+        canary that promotes or rolls back through the publish
+        pointer, with ``/alertz`` as the rollback trigger."""
+        import signal as _signal
+        import threading
+
+        from .serve.fleet import FleetOptions, ServingFleet, cli_spawn_fn
+
+        opts = FleetOptions.from_cfg(self.cfg)
+        model_dir = (self.name_model_dir
+                     if self.name_model_in == "NULL" else None)
+        log_dir = opts.log_dir or (
+            os.path.join(model_dir, "fleet_logs") if model_dir
+            else "fleet_logs")
+        spawn = cli_spawn_fn(self.conf_path, self.cli_overrides,
+                             host=self.serve_host, opts=opts,
+                             log_dir=log_dir)
+        fleet = ServingFleet(
+            opts, spawn_fn=spawn, host=self.serve_host,
+            port=self.serve_port, model_dir=model_dir,
+            default_deadline_ms=self.serve_deadline_ms,
+            reload_period_s=self.serve_reload_period,
+            silent=bool(self.silent),
+        )
+        httpd_box = {}
+
+        def _stop(signum, frame):
+            print(f"fleet: shutdown requested, draining (up to "
+                  f"{self.drain_timeout_s:g}s)", flush=True)
+            h = httpd_box.get("httpd")
+            if h is not None:
+                threading.Thread(target=h.shutdown, daemon=True).start()
+            else:
+                # still booting replicas: abort startup — the raise
+                # lands in the main thread inside fleet.start(), the
+                # finally below reaps the spawned children
+                raise SystemExit(0)
+
+        prev = {s: _signal.signal(s, _stop)
+                for s in (_signal.SIGTERM, _signal.SIGINT)}
+        try:
+            httpd = fleet.start()
+            httpd_box["httpd"] = httpd
+            h = fleet.healthz()
+            print(f"fleet: serving {h['rotation']}/{opts.replicas} "
+                  f"replica(s) (round {h['round']}) on "
+                  f"http://{self.serve_host}:{httpd.server_port}",
+                  flush=True)
+            httpd.serve_forever(poll_interval=0.2)
+        finally:
+            for s, p in prev.items():
+                _signal.signal(s, p)
+            fleet.close(self.drain_timeout_s)
+        print("fleet: shutdown complete", flush=True)
+
     def task_serve(self) -> None:
         """``task=serve``: run the online inference server (doc/serving.md).
 
@@ -1623,12 +1706,18 @@ class LearnTask:
         ephemeral port, printed on startup).  SIGTERM/SIGINT drain
         gracefully: the server stops accepting, in-flight requests get
         up to ``drain_timeout_s`` to finish, queued ones are failed
-        with 503, then the process exits 0."""
+        with 503, then the process exits 0.
+
+        ``replicas >= 2`` routes to :meth:`task_serve_fleet` instead —
+        N supervised engine subprocesses behind one front door."""
         import signal as _signal
         import threading
 
         from .serve import Engine
         from .serve.server import serve_forever
+
+        if self.replicas > 1:
+            return self.task_serve_fleet()
 
         model_in = (None if self.name_model_in == "NULL"
                     else self.name_model_in)
@@ -1708,6 +1797,11 @@ class LearnTask:
         from .serve import Engine
         from .serve.server import serve_forever
 
+        if self.replicas > 1:
+            raise ValueError(
+                "task=serve_train is single-replica (the fine-tune loop "
+                "rides beside one engine); run the fleet with task=serve "
+                "and a separate serve_train process if both are needed")
         if not self.itr_evals:
             raise ValueError(
                 "task=serve_train needs an eval section — the publish "

@@ -24,7 +24,14 @@ from .batcher import (  # noqa: F401
 )
 from .cache import ShapeBucketCache, bucket_size  # noqa: F401
 from .engine import Engine, ModelLoadError  # noqa: F401
+from .fleet import (  # noqa: F401
+    CanaryController,
+    FleetOptions,
+    ReplicaSupervisor,
+    ServingFleet,
+)
 from .metrics import ServingStats  # noqa: F401
+from .router import FleetRouter  # noqa: F401
 from .server import make_server, serve_forever  # noqa: F401
 
 __all__ = [
@@ -40,4 +47,9 @@ __all__ = [
     "bucket_size",
     "make_server",
     "serve_forever",
+    "FleetOptions",
+    "ReplicaSupervisor",
+    "CanaryController",
+    "ServingFleet",
+    "FleetRouter",
 ]

@@ -660,11 +660,19 @@ class Engine:
         # same way an open reload breaker does: the model still serves,
         # but a load balancer sees the named transient condition
         rebuilding = par_elastic.rebuild_in_progress()
+        # every degrade condition as a stable machine-readable token —
+        # what the fleet supervisor's probe parses (doc/serving.md);
+        # the legacy fields (mesh/alerts/reload_breaker) stay for
+        # compatibility
+        reasons: List[str] = []
+        if self.reload_degraded():
+            reasons.append("reload_breaker_open")
+        if rebuilding:
+            reasons.append("mesh_rebuilding")
+        reasons.extend(f"alert:{name}" for name in firing)
         with self._model_lock:
             status = ("closed" if self._closed
-                      else "degraded" if (self.reload_degraded()
-                                          or firing or rebuilding)
-                      else "ok")
+                      else "degraded" if reasons else "ok")
             out = {
                 "status": status,
                 "round": self._round,
@@ -673,6 +681,7 @@ class Engine:
                 "net_fp": self._cache.net_fp(),
                 "quant": self._cache.quant_scheme() or "f32",
                 "reload_breaker": self.reload_breaker.state,
+                "reasons": reasons,
             }
             if rebuilding:
                 out["mesh"] = "rebuilding"

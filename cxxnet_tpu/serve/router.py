@@ -1,0 +1,473 @@
+"""Fleet front-end: priority admission control + least-loaded dispatch.
+
+The single front door over a :class:`~cxxnet_tpu.serve.fleet.
+ServingFleet`.  Every request flows: **classify** (priority
+``interactive`` | ``batch``, from the JSON ``priority`` field or the
+``X-Priority`` header) → **admit** (the admission-control layer over
+the existing 429 machinery — see below) → **dispatch** (least-loaded
+healthy replica, with failover) → **relay** (the replica's status and
+body pass through unchanged).
+
+Admission control (arXiv 1605.08695's production lesson, layered on
+the per-engine queue bound): capacity is ``fleet_replica_inflight ×
+replicas-in-rotation`` — it SHRINKS when replicas die, so overload
+surfaces as explicit 429 shed instead of queueing collapse.  Batch
+traffic sheds first: above ``fleet_batch_shed_ratio`` of capacity,
+``batch`` requests get 429 while ``interactive`` requests are still
+admitted up to the full bound.
+
+Deadline budget: a request's ``deadline_ms`` covers route AND execute.
+The router tracks the absolute deadline from arrival; at each dispatch
+attempt it forwards only the REMAINING budget to the replica (whose
+engine 504s work it cannot finish in time) and 504s locally when the
+budget is gone before any replica could be reached — so routing time,
+failover time and execute time all draw from the one budget the client
+set.
+
+Failover: predict/extract are idempotent, so a dispatch that dies at
+the network layer (the replica was SIGKILLed mid-flight) retries on a
+DIFFERENT replica up to ``fleet_dispatch_retries`` times within the
+deadline — this is what makes kill-one-of-N invisible to non-shed
+requests.  ``/feedback`` appends are NOT retried (a retry could
+double-append); they relay a 502 and the client's own retry applies.
+
+Canary routing: while a canary is evaluating, a ``canary_slice``
+fraction of live ``/predict`` traffic is served BY the canary (its
+latency leg), and a ``canary_sample`` fraction of baseline responses
+is mirrored to it in the background for row-level agreement — the
+measurement the promote/rollback decision reads
+(``serve/fleet.py::CanaryController``).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from ..obs import events as obs_events
+from .fleet import Replica, fleet_metrics
+
+__all__ = ["FleetRouter", "FleetStats", "PRIORITIES"]
+
+PRIORITIES = ("interactive", "batch")
+MAX_BODY_BYTES = 64 << 20
+
+#: network-layer dispatch failures that trigger failover (a replica
+#: HTTP error response is NOT one of these — it relays)
+_DISPATCH_ERRORS = (urllib.error.URLError, ConnectionError, OSError,
+                    TimeoutError)
+
+
+class FleetStats:
+    """Thread-safe request accounting for the front-end (``/statsz``)
+    plus the drain condition shutdown waits on.  ``requests`` counts
+    ARRIVALS by priority (shed included — the same semantics as the
+    ``fleet_requests_total`` family; admitted = requests - shed)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self.inflight = 0
+        self.requests: Dict[str, int] = {p: 0 for p in PRIORITIES}
+        self.shed: Dict[str, int] = {p: 0 for p in PRIORITIES}
+        self.expired = 0
+        self.failovers = 0
+        self.unroutable = 0
+        self.relayed_5xx = 0
+
+    def try_enter(self, priority: str, capacity: int,
+                  shed_ratio: float) -> Optional[str]:
+        """Atomic admit-or-shed: the occupancy check and the slot
+        reservation happen under ONE lock, so concurrent arrivals can
+        never all pass a stale check and overshoot the capacity bound
+        (which would also invert batch-sheds-first ordering).  Returns
+        None when a slot was reserved, else the shed reason."""
+        with self._lock:
+            self.requests[priority] = self.requests.get(priority, 0) + 1
+            cur = self.inflight
+            if cur >= capacity:
+                self.shed[priority] = self.shed.get(priority, 0) + 1
+                return f"at capacity ({cur}/{capacity} in flight)"
+            if priority == "batch" and cur >= shed_ratio * capacity:
+                self.shed[priority] = self.shed.get(priority, 0) + 1
+                return (f"batch shed under pressure ({cur}/{capacity} "
+                        f"in flight, batch sheds above {shed_ratio:g} "
+                        f"of capacity)")
+            self.inflight += 1
+            return None
+
+    def leave(self) -> None:
+        with self._idle:
+            self.inflight -= 1
+            if self.inflight == 0:
+                self._idle.notify_all()
+
+    def wait_idle(self, timeout_s: float) -> bool:
+        deadline = time.monotonic() + timeout_s
+        with self._idle:
+            while self.inflight > 0:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    return False
+                self._idle.wait(timeout=remain)
+        return True
+
+    def count(self, field: str) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + 1)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "inflight": self.inflight,
+                "requests": dict(self.requests),
+                "shed": dict(self.shed),
+                "expired": self.expired,
+                "failovers": self.failovers,
+                "unroutable": self.unroutable,
+                "relayed_5xx": self.relayed_5xx,
+            }
+
+
+class FleetRouter:
+    """The dispatch brain; ``make_httpd`` binds the HTTP surface."""
+
+    def __init__(self, fleet, default_deadline_ms: float = 0.0) -> None:
+        self.fleet = fleet
+        self.opts = fleet.opts
+        self.sup = fleet.supervisor
+        self.default_deadline_ms = float(default_deadline_ms)
+        self.stats = FleetStats()
+        self._metrics = fleet_metrics()  # hot path: no singleton lock
+        self._lock = threading.Lock()       # replica inflight counters
+        self._rng = random.Random(0xF1EE7)  # slice/sample draws
+        self._rng_lock = threading.Lock()
+        # mirror lane: bounded + lossy — shadow comparisons must never
+        # apply backpressure to live traffic
+        self._mirror_q: "queue.Queue[tuple]" = queue.Queue(maxsize=256)
+        self._mirror_stop = threading.Event()
+        self._mirror_thread: Optional[threading.Thread] = None
+        if self.fleet.canary is not None:
+            self._mirror_thread = threading.Thread(
+                target=self._mirror_loop, name="cxxnet-fleet-mirror",
+                daemon=True)
+            self._mirror_thread.start()
+
+    # ------------------------------------------------------------------
+    # admission control
+    def capacity(self) -> int:
+        return self.opts.replica_inflight * max(
+            1, len(self.sup.rotation()))
+
+    def admit(self, priority: str) -> Optional[str]:
+        """Admit-or-shed (atomic — see :meth:`FleetStats.try_enter`);
+        an admitted caller owns a slot and must ``stats.leave()``.
+        Batch sheds first: the 429 surface under pressure, interactive
+        up to the full capacity bound."""
+        return self.stats.try_enter(priority, self.capacity(),
+                                    self.opts.batch_shed_ratio)
+
+    # ------------------------------------------------------------------
+    # replica selection
+    def _canary_live(self) -> bool:
+        c = self.fleet.canary
+        return c is not None and c.state == "evaluating"
+
+    def pick_replica(self, exclude=(),
+                     want_canary: bool = False) -> Optional[Replica]:
+        """Least-loaded healthy replica (ties break on index).  While a
+        canary is evaluating it only receives its slice
+        (``want_canary``); once promoted it serves at full weight."""
+        rotation = self.sup.rotation()
+        evaluating = self._canary_live()
+        if want_canary:
+            pool = [r for r in rotation if r.role == "canary"]
+        elif evaluating:
+            pool = [r for r in rotation if r.role != "canary"]
+        else:
+            pool = rotation
+        pool = [r for r in pool if r not in exclude]
+        if not pool:
+            return None
+        with self._lock:
+            return min(pool, key=lambda r: (r.inflight, r.idx))
+
+    def _draw(self, prob: float) -> bool:
+        if prob <= 0.0:
+            return False
+        with self._rng_lock:
+            return self._rng.random() < prob
+
+    # ------------------------------------------------------------------
+    # dispatch
+    def _post_replica(self, r: Replica, path: str, obj: dict,
+                      timeout_s: float) -> Tuple[int, dict]:
+        req = urllib.request.Request(
+            f"http://{r.address}{path}",
+            data=json.dumps(obj).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return resp.status, json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            # a replica ERROR RESPONSE (429/500/504...) relays as-is —
+            # only network-layer failures trigger failover
+            try:
+                body = json.loads(e.read().decode("utf-8"))
+            except Exception:  # noqa: BLE001 - non-JSON error body
+                body = {"error": str(e)}
+            return e.code, body
+
+    def route(self, path: str, obj: dict,
+              priority: str = "interactive") -> Tuple[int, dict]:
+        """Admission + dispatch + failover for one request; returns
+        ``(http_status, body)``.  The embeddable API the HTTP handler
+        (and the tests) call."""
+        m = self._metrics
+        m.requests.labels(priority=priority).inc()
+        reason = self.admit(priority)
+        if reason is not None:
+            m.shed.labels(priority=priority).inc()
+            return 429, {"error": f"load shed: {reason}",
+                         "priority": priority}
+        m.inflight.set(self.stats.inflight)
+        try:
+            return self._dispatch(path, obj)
+        finally:
+            self.stats.leave()
+            m.inflight.set(self.stats.inflight)
+
+    def _dispatch(self, path: str, obj: dict) -> Tuple[int, dict]:
+        t0 = time.monotonic()
+        m = self._metrics
+        deadline_ms = obj.get("deadline_ms")
+        if deadline_ms is None and self.default_deadline_ms > 0:
+            deadline_ms = self.default_deadline_ms
+        try:
+            deadline_val = (float(deadline_ms)
+                            if deadline_ms is not None else 0.0)
+        except (TypeError, ValueError):
+            # client-input error: 400, matching the single-engine server
+            return 400, {"error": f"bad deadline_ms: {deadline_ms!r}"}
+        deadline_t = (t0 + deadline_val / 1e3
+                      if deadline_val > 0 else None)
+        is_predict = path == "/predict"
+        want_canary = (is_predict and self._canary_live()
+                       and self._draw(self.opts.canary_slice))
+        tried: set = set()
+        failures = 0
+        while True:
+            remaining_ms = None
+            if deadline_t is not None:
+                remaining_ms = (deadline_t - time.monotonic()) * 1e3
+                if remaining_ms <= 0:
+                    self.stats.count("expired")
+                    return 504, {"error": "deadline expired before a "
+                                          "replica could answer"}
+            r = self.pick_replica(exclude=tried, want_canary=want_canary)
+            if r is None and want_canary:
+                want_canary = False  # canary unavailable: baseline serves
+                continue
+            if r is None:
+                self.stats.count("unroutable")
+                return 503, {"error": "no healthy replica available"}
+            fwd = dict(obj)
+            fwd.pop("priority", None)
+            if remaining_ms is not None:
+                # the execute share of the budget: whatever routing and
+                # failover have not already consumed
+                fwd["deadline_ms"] = remaining_ms
+            timeout_s = self.opts.dispatch_timeout_s
+            if remaining_ms is not None:
+                timeout_s = min(timeout_s, remaining_ms / 1e3 + 1.0)
+            with self._lock:
+                r.inflight += 1
+            t_send = time.monotonic()
+            try:
+                status, body = self._post_replica(r, path, fwd, timeout_s)
+            except _DISPATCH_ERRORS as e:
+                tried.add(r)
+                failures += 1
+                self.sup.note_dispatch_failure(r)
+                if path == "/feedback":
+                    # appends are not idempotent — never replayed
+                    return 502, {"error": f"replica dispatch failed "
+                                          f"({type(e).__name__}: {e}); "
+                                          "feedback is not retried"}
+                if failures > self.opts.dispatch_retries:
+                    return 502, {"error": f"dispatch failed on "
+                                          f"{failures} replica(s) "
+                                          f"({type(e).__name__}: {e})"}
+                # only an actual retry counts as a failover
+                self.stats.count("failovers")
+                m.failovers.inc()
+                continue
+            finally:
+                with self._lock:
+                    r.inflight -= 1
+            dt = time.monotonic() - t_send
+            with self._lock:
+                r.dispatched += 1
+            m.dispatch.labels(replica=str(r.idx)).inc()
+            if status >= 500:
+                self.stats.count("relayed_5xx")
+            if is_predict and status == 200:
+                self._canary_account(r, obj, body, dt)
+            return status, body
+
+    # ------------------------------------------------------------------
+    # canary measurement
+    def _canary_account(self, r: Replica, obj: dict, body: dict,
+                        dt_s: float) -> None:
+        c = self.fleet.canary
+        if c is None or c.state != "evaluating":
+            return
+        m = self._metrics
+        if r.role == "canary":
+            m.canary_requests.labels(leg="slice").inc()
+            c.record_latency("canary", dt_s)
+            return
+        c.record_latency("baseline", dt_s)
+        if self._draw(self.opts.canary_sample):
+            try:
+                self._mirror_q.put_nowait((obj.get("data"),
+                                           body.get("pred")))
+            except queue.Full:
+                pass  # lossy by design: shadow work never backpressures
+
+    def _mirror_loop(self) -> None:
+        while not self._mirror_stop.is_set():
+            try:
+                data, base_pred = self._mirror_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            c = self.fleet.canary
+            if c is None or c.state != "evaluating" or base_pred is None:
+                continue
+            canary = self.pick_replica(want_canary=True)
+            if canary is None:
+                continue
+            m = self._metrics
+            t0 = time.monotonic()
+            try:
+                status, body = self._post_replica(
+                    canary, "/predict", {"data": data},
+                    self.opts.dispatch_timeout_s)
+            except _DISPATCH_ERRORS:
+                self.sup.note_dispatch_failure(canary)
+                continue
+            m.canary_requests.labels(leg="mirror").inc()
+            if status != 200:
+                continue
+            c.record_latency("canary", time.monotonic() - t0)
+            can_pred = body.get("pred")
+            if not isinstance(can_pred, list):
+                continue
+            base = list(base_pred) if isinstance(base_pred, list) \
+                else [base_pred]
+            total = min(len(base), len(can_pred))
+            equal = sum(1 for a, b in zip(base, can_pred) if a == b)
+            c.record_compare(equal, total)
+
+    # ------------------------------------------------------------------
+    # HTTP surface
+    def make_httpd(self, host: str, port: int) -> ThreadingHTTPServer:
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # noqa: N802 - stdlib name
+                pass
+
+            def _reply(self, status: int, payload) -> None:
+                body = (payload if isinstance(payload, bytes)
+                        else json.dumps(payload).encode("utf-8"))
+                self.send_response(status)
+                ctype = ("text/plain; version=0.0.4; charset=utf-8"
+                         if isinstance(payload, bytes)
+                         else "application/json")
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 - stdlib name
+                if self.path == "/healthz":
+                    self._reply(200, router.fleet.healthz())
+                elif self.path == "/statsz":
+                    self._reply(200, router.fleet.statsz())
+                elif self.path == "/metricsz":
+                    from ..obs import registry as obs_registry
+
+                    self._reply(200, obs_registry()
+                                .render_prometheus().encode("utf-8"))
+                elif self.path == "/alertz":
+                    from ..obs import alerts as obs_alerts
+
+                    self._reply(200, obs_alerts.evaluator().status())
+                else:
+                    self._reply(404,
+                                {"error": f"unknown route {self.path}"})
+
+            def do_POST(self):  # noqa: N802 - stdlib name
+                if self.path not in ("/predict", "/extract", "/feedback"):
+                    self._reply(404,
+                                {"error": f"unknown route {self.path}"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    length = 0
+                if length <= 0 or length > MAX_BODY_BYTES:
+                    self._reply(400,
+                                {"error": "missing or oversized body"})
+                    return
+                try:
+                    obj = json.loads(self.rfile.read(length)
+                                     .decode("utf-8"))
+                except (ValueError, UnicodeDecodeError) as e:
+                    self._reply(400, {"error": f"bad JSON: {e}"})
+                    return
+                if not isinstance(obj, dict) or "data" not in obj:
+                    self._reply(400,
+                                {"error": 'body must be {"data": [...]}'})
+                    return
+                priority = str(obj.get("priority")
+                               or self.headers.get("X-Priority")
+                               or "interactive")
+                if priority not in PRIORITIES:
+                    self._reply(400, {
+                        "error": f"unknown priority {priority!r}; want "
+                                 f"one of {'/'.join(PRIORITIES)}"})
+                    return
+                try:
+                    status, body = router.route(self.path, obj, priority)
+                except Exception as e:  # noqa: BLE001 - served as a 500
+                    status, body = 500, {
+                        "error": f"{type(e).__name__}: {e}"}
+                self._reply(status, body)
+
+        httpd = ThreadingHTTPServer((host, port), Handler)
+        httpd.daemon_threads = True
+        obs_events.emit("fleet.router_up", host=host,
+                        port=httpd.server_port)
+        return httpd
+
+    def close(self, drain_timeout_s: float = 5.0) -> None:
+        if drain_timeout_s > 0 and not self.stats.wait_idle(
+                drain_timeout_s):
+            obs_events.emit("fleet.drain_timeout",
+                            inflight=self.stats.inflight)
+        self._mirror_stop.set()
+        if self._mirror_thread is not None:
+            self._mirror_thread.join(timeout=5.0)
+            self._mirror_thread = None

@@ -18,7 +18,14 @@ the batching opportunity.  Endpoints:
   ``/predict`` also logs its inputs with the model's own predictions
   as labels (self-training capture).
 * ``GET  /healthz``  — liveness + model identity (round, fingerprint);
-  degrades (and lists the names) while any alert rule is firing
+  degrades while any alert rule is firing, the reload breaker is open,
+  or a colocated trainer is mid mesh-rebuild — with every degrade
+  condition spelled out in a machine-readable ``reasons`` list (what
+  the fleet supervisor's probe parses; doc/serving.md)
+* ``POST /reloadz``  — admin: trigger one breaker-gated hot-reload
+  attempt (``Engine.try_reload``) and report
+  ``{ok, swapped, round, breaker}`` — the fleet's rolling-reload
+  rendezvous (``serve/fleet.py``); empty body allowed
 * ``GET  /statsz``   — serving metrics (see ``metrics.py``)
 * ``GET  /metricsz`` — Prometheus text exposition of the process-wide
   metrics registry (``cxxnet_tpu/obs/registry.py``): request outcomes,
@@ -62,12 +69,35 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..utils import faults
 from .batcher import ServeError
 from .engine import Engine
 
-__all__ = ["make_server", "serve_forever"]
+__all__ = ["make_server", "serve_forever", "replica_fault_probe"]
 
 MAX_BODY_BYTES = 64 << 20  # reject absurd request bodies outright
+
+
+def replica_fault_probe() -> None:
+    """The ``serve.replica`` chaos site (doc/robustness.md), fired on
+    every ``/healthz`` probe of this replica:
+
+    * ``hang`` — the probe response blocks: this replica is WEDGED.
+      The fleet supervisor's probe deadline classifies it SLOW →
+      ejected from rotation; a standalone server just looks unhealthy
+      to its load balancer.
+    * ``ioerror`` — the replica CRASHES (exit code 13), the abrupt
+      process loss a real fault produces; the fleet supervisor must
+      restart it with backoff.
+
+    No-op while the site is disarmed (the common case)."""
+    try:
+        faults.fault_point("serve.replica")
+    except faults.InjectedFault:
+        from ..obs import events as obs_events
+
+        obs_events.emit("serve.replica_crash", injected=True)
+        os._exit(13)
 
 
 class _InflightGauge:
@@ -158,6 +188,7 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 - stdlib name
         with self.inflight:
             if self.path == "/healthz":
+                replica_fault_probe()  # serve.replica chaos site
                 self._reply(200, self.engine.healthz())
             elif self.path == "/statsz":
                 self._reply(200, self.engine.snapshot_stats())
@@ -181,6 +212,32 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _do_post(self) -> None:
         rid = self._mint_rid()
+        if self.path == "/reloadz":
+            # admin route (no body needed): one breaker-gated reload
+            # attempt — the fleet's rolling-reload rendezvous.  Any
+            # body sent must still be drained, or its bytes desync the
+            # next request on a kept-alive HTTP/1.1 connection
+            try:
+                length = int(self.headers.get("Content-Length", 0) or 0)
+            except ValueError:
+                length = 0
+            if length > MAX_BODY_BYTES:
+                # cannot drain it: close the connection so the unread
+                # bytes can never desync a follow-up request
+                self.close_connection = True
+                self._reply(400, {"error": "oversized body", "rid": rid})
+                return
+            if length > 0:
+                self.rfile.read(length)
+            swapped = self.engine.try_reload()
+            self._reply(200, {
+                "ok": self.engine.stats.last_reload_ok is not False,
+                "swapped": bool(swapped),
+                "round": self.engine.round,
+                "breaker": self.engine.reload_breaker.state,
+                "rid": rid,
+            })
+            return
         if self.path not in ("/predict", "/extract", "/feedback"):
             self._reply(404, {"error": f"unknown route {self.path}",
                               "rid": rid})

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Lightweight replica stand-in for serving-fleet tests.
+
+A real fleet replica is a full ``task=serve`` CLI process — a JAX
+import, a checkpoint load, and compiled predict programs, i.e. tens of
+seconds of startup.  The supervision/routing/canary logic in
+``serve/fleet.py`` and ``serve/router.py`` does not care what is behind
+the replica's HTTP surface, so the fast tier-1 tests drive it against
+this stub: a **stdlib-only** script (no package import, no numpy, no
+JAX) that answers the same endpoints the fleet speaks to a real
+replica, starts in ~100 ms, and can be told to misbehave in the exact
+ways the supervisor must survive:
+
+* ``--delay-ms`` — every ``/predict`` takes this long (saturation and
+  deadline tests); a request whose forwarded ``deadline_ms`` budget is
+  smaller than the delay gets the honest 504.
+* ``--disagree`` — predictions are offset by this value (a degraded
+  canary for the rollback acceptance; 0 = agrees with every other stub
+  on the same input).
+* ``POST /wedge`` (or ``--wedge``) — every subsequent request blocks
+  forever: the wedged-replica shape the supervisor must eject within
+  the probe deadline.
+* ``--round-file`` — ``POST /reloadz`` re-reads the round from this
+  file (the rolling-reload rendezvous without a real checkpoint).
+
+Predictions are a pure function of the input row (sum of the row,
+scaled, mod 7, plus the disagree offset) so two healthy stubs always
+agree and a ``--disagree`` stub never does.
+
+Run directly (NOT ``-m``): ``python cxxnet_tpu/serve/stub.py --port N``.
+"""
+
+import argparse
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--round", type=int, default=1)
+    ap.add_argument("--round-file", default="",
+                    help="/reloadz re-reads the served round from here")
+    ap.add_argument("--model", default="stub.model",
+                    help="model path reported by /healthz")
+    ap.add_argument("--quant", default="f32",
+                    help="precision scheme reported by /healthz")
+    ap.add_argument("--delay-ms", type=float, default=0.0)
+    ap.add_argument("--disagree", type=int, default=0,
+                    help="prediction offset (0 = agree with other stubs)")
+    ap.add_argument("--wedge", action="store_true",
+                    help="start wedged (every request blocks forever)")
+    args = ap.parse_args()
+
+    lock = threading.Lock()
+    state = {
+        "round": args.round,
+        "wedged": bool(args.wedge),
+        "requests": 0,
+        "predicts": 0,
+        "reloads": 0,
+    }
+
+    def read_round_file():
+        if args.round_file:
+            try:
+                with open(args.round_file, "r", encoding="utf-8") as f:
+                    return int(f.read().strip())
+            except (OSError, ValueError):
+                pass
+        return None
+
+    init = read_round_file()
+    if init is not None:
+        state["round"] = init
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # noqa: N802 - stdlib name
+            pass
+
+        def _reply(self, status, obj):
+            body = json.dumps(obj).encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _enter(self):
+            with lock:
+                state["requests"] += 1
+                wedged = state["wedged"]
+            if wedged:
+                time.sleep(3600.0)
+
+        def do_GET(self):  # noqa: N802 - stdlib name
+            self._enter()
+            if self.path == "/healthz":
+                with lock:
+                    self._reply(200, {
+                        "status": "ok",
+                        "round": state["round"],
+                        "model": args.model,
+                        "model_crc32": 0,
+                        "net_fp": "stub",
+                        "quant": args.quant,
+                        "reload_breaker": "closed",
+                        "reasons": [],
+                    })
+            elif self.path == "/statsz":
+                with lock:
+                    self._reply(200, dict(state))
+            else:
+                self._reply(404, {"error": f"unknown route {self.path}"})
+
+        def do_POST(self):  # noqa: N802 - stdlib name
+            self._enter()
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                n = 0
+            try:
+                obj = json.loads(self.rfile.read(n) or b"{}")
+            except ValueError:
+                obj = {}
+            if self.path == "/wedge":
+                with lock:
+                    state["wedged"] = True
+                self._reply(200, {"ok": True})
+            elif self.path == "/reloadz":
+                new = read_round_file()
+                with lock:
+                    old = state["round"]
+                    if new is not None:
+                        state["round"] = new
+                    state["reloads"] += 1
+                    cur = state["round"]
+                self._reply(200, {"ok": True, "swapped": cur != old,
+                                  "round": cur, "breaker": "closed"})
+            elif self.path == "/predict":
+                deadline = obj.get("deadline_ms")
+                if args.delay_ms > 0:
+                    time.sleep(args.delay_ms / 1e3)
+                if (deadline is not None
+                        and args.delay_ms >= float(deadline)):
+                    self._reply(504, {"error": "deadline expired"})
+                    return
+                data = obj.get("data") or []
+                if data and not isinstance(data[0], list):
+                    data = [data]
+                pred = [
+                    (int(round(sum(float(v) for v in row) * 1e3)) % 7)
+                    + args.disagree
+                    for row in data
+                ]
+                with lock:
+                    state["predicts"] += 1
+                    rnd = state["round"]
+                self._reply(200, {"pred": pred, "rid": "stub",
+                                  "deadline_ms": deadline, "round": rnd})
+            else:
+                self._reply(404, {"error": f"unknown route {self.path}"})
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", args.port), Handler)
+    httpd.daemon_threads = True
+    print(f"STUB READY {httpd.server_port}", flush=True)
+    try:
+        httpd.serve_forever(poll_interval=0.5)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

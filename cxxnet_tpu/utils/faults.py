@@ -94,6 +94,13 @@ SITES: Dict[str, Tuple[str, ...]] = {
     # connection reset a SIGKILLed peer produces (classified into
     # ReplicaLossError by the elastic driver) — doc/parallel.md
     "mesh.replica": ("hang", "ioerror"),
+    # serving-fleet replica (serve/server.py::replica_fault_probe, the
+    # health plane of a task=serve replica process): hang = a wedged
+    # replica (probes stall; the fleet supervisor must eject it from
+    # rotation within the probe deadline), ioerror = a replica crash
+    # (the process exits; the supervisor must restart it with backoff)
+    # — doc/robustness.md, doc/serving.md "Serving fleet"
+    "serve.replica": ("hang", "ioerror"),
 }
 
 KINDS = ("ioerror", "corrupt", "latency", "hang")

@@ -736,6 +736,152 @@ def _scn_mesh_replica(kind, tmp_path):
     assert not loss.presumed  # member confirmed the loss
 
 
+#: minimal replica child for the serve.replica lanes: the REAL fault
+#: site (serve.server.replica_fault_probe on every /healthz) behind a
+#: stdlib HTTP surface, armed at runtime via POST /arm so the replica
+#: first becomes healthy and THEN misbehaves — the order the supervisor
+#: must survive.  A hang armed at the site wedges the whole process
+#: (data plane included), the real shape of a wedged replica.
+_REPLICA_SITE_CHILD = '''
+import json, sys, time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from cxxnet_tpu.serve.server import replica_fault_probe
+from cxxnet_tpu.utils import faults
+
+port = int(sys.argv[1])
+
+
+class H(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code, obj):
+        b = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(b)))
+        self.end_headers()
+        self.wfile.write(b)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            replica_fault_probe()  # the real serve.replica site
+            self._reply(200, {"status": "ok", "round": 1,
+                              "model": "site.model", "reasons": []})
+        else:
+            self._reply(404, {"error": self.path})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        obj = json.loads(self.rfile.read(n) or b"{}")
+        if self.path == "/arm":
+            faults.install(obj["spec"])
+            self._reply(200, {"ok": True})
+        elif self.path == "/predict":
+            if any(s.kind == "hang"
+                   for s in faults.injector().specs()):
+                time.sleep(3600.0)  # a wedged process serves nothing
+            self._reply(200, {"pred": [0], "round": 1})
+        else:
+            self._reply(404, {"error": self.path})
+
+
+httpd = ThreadingHTTPServer(("127.0.0.1", port), H)
+httpd.daemon_threads = True
+httpd.serve_forever(poll_interval=0.5)
+'''
+
+
+def _scn_serve_replica(kind, tmp_path):
+    """Serving-fleet replica faults resolve at the FLEET level: the
+    process keeps none of its guarantees, the supervisor restores them.
+    ``ioerror`` crashes the replica on its next health probe (the real
+    ``replica_fault_probe`` path: ``os._exit(13)``) — the supervisor
+    must detect the exit and restart it with backoff.  ``hang`` wedges
+    the replica (health plane AND data plane) — the supervisor must
+    eject it from rotation within the probe deadline and restart it.
+    Either way, requests keep succeeding throughout via the router's
+    failover onto the healthy replica — availability degrades never,
+    throughput only."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+    import time as _time
+    import urllib.request
+
+    from cxxnet_tpu.serve.fleet import FleetOptions, ServingFleet
+
+    child = tmp_path / "replica_site_child.py"
+    child.write_text(_REPLICA_SITE_CHILD, encoding="utf-8")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def spawn(r):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo
+        env["JAX_PLATFORMS"] = "cpu"
+        return subprocess.Popen(
+            [_sys.executable, str(child), str(r.port)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=env)
+
+    opts = FleetOptions(
+        replicas=2, probe_period_s=0.15, probe_timeout_s=0.4,
+        slow_probes=3, start_timeout_s=60.0, restart_backoff_s=0.2,
+        restart_backoff_max_s=0.5, replica_inflight=8,
+        dispatch_retries=2, dispatch_timeout_s=2.0)
+    fleet = ServingFleet(opts, spawn_fn=spawn)
+    try:
+        fleet.supervisor.start()
+        assert fleet.supervisor.wait_ready(timeout_s=60.0), \
+            [r.snapshot() for r in fleet.supervisor.replicas]
+        victim = fleet.supervisor.replicas[0]
+        req = urllib.request.Request(
+            f"http://{victim.address}/arm",
+            data=_json.dumps(
+                {"spec": f"serve.replica:{kind}:1"}).encode("utf-8"),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert _json.loads(resp.read())["ok"]
+        t_arm = _time.monotonic()
+
+        failures = []
+        t_down = None
+        recovered = False
+        deadline = _time.monotonic() + 25.0
+        while _time.monotonic() < deadline:
+            status, body = fleet.router.route(
+                "/predict", {"data": [[0.5] * 4]})
+            if status != 200:
+                failures.append((status, body))
+            if t_down is None and not victim.in_rotation():
+                t_down = _time.monotonic()
+            if (victim.restarts >= 1 and victim.state == "healthy"
+                    and t_down is not None):
+                recovered = True
+                break
+            _time.sleep(0.05)
+
+        assert recovered, (victim.snapshot(),
+                           fleet.supervisor.state_counts())
+        # detection within the probe deadline — bounded by the wedge
+        # threshold, not by hang_s (3600 s)
+        budget = (opts.slow_probes
+                  * (opts.probe_period_s + opts.probe_timeout_s))
+        assert t_down - t_arm < budget + 6.0
+        # restart reason matches the injected failure mode
+        assert victim.down_reason == (
+            "wedged" if kind == "hang" else "crash")
+        # availability: every request during the whole window succeeded
+        # (failover onto the healthy replica, never a client-visible 5xx)
+        assert not failures, failures[:5]
+        assert fleet.supervisor.restarts_total >= 1
+    finally:
+        fleet.close(drain_timeout_s=0.5)
+
+
 MATRIX = [
     pytest.param(site, kind, id=f"{site}-{kind}",
                  marks=[pytest.mark.chaos])
@@ -771,5 +917,7 @@ def test_fault_matrix(site, kind, tmp_path):
         _scn_loop_append(kind, tmp_path)
     elif site == "mesh.replica":
         _scn_mesh_replica(kind, tmp_path)
+    elif site == "serve.replica":
+        _scn_serve_replica(kind, tmp_path)
     else:  # a new site without a scenario must fail the matrix
         pytest.fail(f"no chaos scenario for registered site {site!r}")

@@ -424,6 +424,27 @@ def test_http_endpoints_inprocess():
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(port, "/extract", {"data": x.tolist()})
         assert e.value.code == 400
+        # POST /reloadz: admin reload attempt (no model_dir here → a
+        # clean noop), with the body drained so a kept-alive HTTP/1.1
+        # connection stays in sync for the next request
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request("POST", "/reloadz", body=b"{}",
+                         headers={"Content-Type": "application/json"})
+            r1 = conn.getresponse()
+            body = json.loads(r1.read())
+            assert r1.status == 200
+            assert body["ok"] is True and body["swapped"] is False
+            assert "breaker" in body and "round" in body
+            # SAME connection: framing must not have desynced
+            conn.request("GET", "/healthz")
+            r2 = conn.getresponse()
+            assert r2.status == 200
+            assert json.loads(r2.read())["status"] == "ok"
+        finally:
+            conn.close()
     finally:
         httpd.shutdown()
         httpd.server_close()
@@ -612,6 +633,9 @@ def test_reload_breaker_keeps_old_model_serving(tmp_path):
         assert eng.reload_breaker.state == "open"
         h = eng.healthz()
         assert h["status"] == "degraded" and h["round"] == 1
+        # machine-readable degrade cause: the fleet supervisor (and any
+        # external LB) parses the reasons token, not the status string
+        assert h["reasons"] == ["reload_breaker_open"]
         np.testing.assert_array_equal(eng.submit(x, kind="scores"), p1)
         st = eng.snapshot_stats()
         assert st["reload_failures"] == 2
@@ -628,6 +652,54 @@ def test_reload_breaker_keeps_old_model_serving(tmp_path):
         assert eng.healthz()["status"] == "ok"
         assert eng.snapshot_stats()["reload_swaps"] == 1
         assert not np.array_equal(eng.submit(x, kind="scores"), p1)
+    finally:
+        eng.close()
+
+
+def test_healthz_reasons_shape(tmp_path):
+    """Single-engine /healthz carries the machine-readable ``reasons``
+    list next to the legacy fields: empty when ok, one stable token per
+    degrade condition, and the shape ``tools/obs_dump.py --check
+    --healthz`` validates (the fleet supervisor's probe contract)."""
+    eng = serve.Engine(trainer=make_trainer(), max_batch_size=8,
+                       batch_timeout_ms=0)
+    try:
+        h = eng.healthz()
+        assert h["status"] == "ok" and h["reasons"] == []
+        # legacy fields stay for pre-fleet scrapers
+        assert h["reload_breaker"] == "closed"
+        assert "round" in h and "model" in h and "quant" in h
+
+        hz = tmp_path / "healthz.json"
+        hz.write_text(json.dumps(h))
+        from conftest import run_cli
+
+        r = run_cli([os.path.join(REPO, "tools", "obs_dump.py"),
+                     "--check", "--healthz", str(hz)],
+                    cwd=str(tmp_path), module=False)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+        # an armed alert degrades WITH a named token
+        from cxxnet_tpu.obs import alerts as obs_alerts
+        from cxxnet_tpu.obs.registry import registry as obs_registry
+
+        obs_registry().gauge(
+            "serve_test_reasons_gauge", "test").set(5.0)
+        ev = obs_alerts.evaluator()
+        ev.add_rule(obs_alerts.parse_rule(
+            "reasons_probe:serve_test_reasons_gauge:>:1"))
+        ev.evaluate_once()
+        try:
+            h = eng.healthz()
+            assert h["status"] == "degraded"
+            assert "alert:reasons_probe" in h["reasons"]
+            hz.write_text(json.dumps(h))
+            r = run_cli([os.path.join(REPO, "tools", "obs_dump.py"),
+                         "--check", "--healthz", str(hz)],
+                        cwd=str(tmp_path), module=False)
+            assert r.returncode == 0, r.stdout + r.stderr
+        finally:
+            obs_alerts.reset()
     finally:
         eng.close()
 

@@ -9,6 +9,12 @@
 # policy — skip / retry / drain / degrade — never a hang, a silent
 # drop, or an unhandled crash.
 #
+# The serve.replica lanes spawn supervised replica subprocesses (the
+# fleet supervisor must restart a crashed replica and eject a wedged
+# one within the probe deadline while requests keep succeeding via
+# router failover); the heavyweight real-checkpoint variant is the
+# FLEET=1 lane (tools/fleet_smoke.py).
+#
 # Usage: tools/chaos_run.sh            # full matrix + chaos-marked tests
 # Wired into tier-1 as an opt-in stage: CHAOS=1 tools/run_tier1.sh
 set -o pipefail

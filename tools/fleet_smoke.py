@@ -1,0 +1,260 @@
+"""Serving-fleet smoke: kill one of N real replicas under open-loop load.
+
+The FLEET=1 tier-1 lane (and the ISSUE-12 acceptance): train a tiny MLP
+checkpoint, launch a REAL ``task=serve replicas=N`` fleet (each replica
+a full CLI subprocess with its own engine), drive sustained open-loop
+burst traffic through the routing front-end, then SIGKILL one serving
+replica mid-run and assert:
+
+* **availability** — every non-shed request still succeeds: zero
+  errors, zero relayed 5xx (429 shed is admission control doing its
+  job, not a failure);
+* **supervision** — the fleet detects the loss and restarts the dead
+  replica back to healthy within ``--restart-budget`` seconds (the
+  supervisor-measured wall clock lands in the verdict, and in the
+  perf_guard ``fleet_bench`` history as a lower-is-better series);
+* **front door** — aggregate ``/healthz`` degrades while the replica
+  is down and returns to ``ok`` after the restart.
+
+Prints one JSON verdict on stdout; exit 0 on pass, 1 on fail.
+
+Usage::
+
+    python tools/fleet_smoke.py --out /tmp/_fleet_smoke [--replicas 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+CONF = """
+data = train
+iter = synthetic
+  nsample = 128
+  input_shape = 1,1,16
+  nclass = 4
+iter = end
+
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+1:a1] = relu:a1
+layer[a1->out] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,16
+batch_size = 32
+dev = cpu
+eta = 0.1
+num_round = 1
+save_model = 1
+eval_train = 1
+metric = error
+print_step = 0
+model_dir = MODELDIR
+"""
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    return env
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", required=True, help="work/artifact dir")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--base-rate", type=float, default=40.0)
+    ap.add_argument("--burst-rate", type=float, default=120.0)
+    ap.add_argument("--phase", type=float, default=1.0)
+    ap.add_argument("--load-before-kill", type=float, default=3.0,
+                    help="seconds of load before the SIGKILL")
+    ap.add_argument("--restart-budget", type=float, default=120.0,
+                    help="max seconds from kill to healthy again")
+    ap.add_argument("--start-timeout", type=float, default=300.0)
+    args = ap.parse_args(argv)
+
+    os.makedirs(args.out, exist_ok=True)
+    model_dir = os.path.join(args.out, "models")
+    conf_path = os.path.join(args.out, "fleet_smoke.conf")
+    with open(conf_path, "w", encoding="utf-8") as f:
+        f.write(CONF.replace("MODELDIR", model_dir))
+
+    # 1. train one round so the replicas have a checkpoint to serve
+    r = subprocess.run(
+        [sys.executable, "-m", "cxxnet_tpu", conf_path, "silent=1"],
+        capture_output=True, text=True, cwd=args.out, env=_env(),
+        timeout=300)
+    if r.returncode != 0:
+        print(json.dumps({"ok": False, "stage": "train",
+                          "error": r.stderr[-2000:]}))
+        return 1
+
+    # 2. launch the fleet on an ephemeral port
+    fleet_cmd = [
+        sys.executable, "-m", "cxxnet_tpu", conf_path,
+        "task=serve", f"replicas={args.replicas}", "serve_port=0",
+        "silent=1", "batch_timeout_ms=1",
+        "fleet_probe_period_s=0.25", "fleet_probe_timeout_s=2",
+        "fleet_restart_backoff_s=0.5",
+        f"fleet_log_dir={os.path.join(args.out, 'fleet_logs')}",
+    ]
+    proc = subprocess.Popen(fleet_cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            cwd=args.out, env=_env())
+    lines: list = []
+    threading.Thread(
+        target=lambda: [lines.append(l) for l in proc.stdout],
+        daemon=True).start()
+
+    verdict = {"ok": False, "replicas": args.replicas}
+    try:
+        # wait for the front door + full rotation
+        port = None
+        deadline = time.time() + args.start_timeout
+        while time.time() < deadline and port is None:
+            for line in list(lines):
+                if line.startswith("fleet: serving") and "http://" in line:
+                    port = int(line.rsplit(":", 1)[1])
+                    break
+            if proc.poll() is not None:
+                raise RuntimeError("fleet died:\n" + "".join(lines))
+            time.sleep(0.2)
+        if port is None:
+            raise RuntimeError("fleet never reported its port:\n"
+                               + "".join(lines)[-2000:])
+        h = None
+        while time.time() < deadline:
+            h = _get(port, "/healthz")
+            if h["replicas"]["healthy"] == args.replicas:
+                break
+            time.sleep(0.5)
+        else:
+            raise RuntimeError(f"not all replicas healthy: {h}")
+
+        # 3. sustained open-loop burst load through the front door
+        import numpy as np
+        import serve_bench
+
+        x = np.full((1, 16), 0.5, np.float32)
+        fire = serve_bench.make_url_fire(f"http://127.0.0.1:{port}", x)
+        burst_box = {}
+
+        def _load():
+            burst_box["burst"] = serve_bench.open_loop_burst(
+                fire, args.base_rate, args.burst_rate, args.phase,
+                duration_s=args.load_before_kill + args.restart_budget,
+                clients=32)
+
+        load_thread = threading.Thread(target=_load, daemon=True)
+        load_thread.start()
+        time.sleep(args.load_before_kill)
+
+        # 4. SIGKILL one serving replica mid-load
+        st = _get(port, "/statsz")
+        victim = next(rep for rep in st["replicas"]
+                      if rep["role"] == "serve"
+                      and rep["state"] == "healthy" and rep["pid"])
+        os.kill(victim["pid"], signal.SIGKILL)
+        t_kill = time.monotonic()
+        verdict["killed"] = {"idx": victim["idx"], "pid": victim["pid"]}
+
+        # 5. wait for detection + restart back to full rotation
+        degraded_seen = False
+        restart_wall = None
+        while time.monotonic() - t_kill < args.restart_budget:
+            h = _get(port, "/healthz")
+            if h["status"] != "ok":
+                degraded_seen = True
+            if degraded_seen and h["replicas"]["healthy"] == args.replicas:
+                st = _get(port, "/statsz")
+                restart_wall = st["last_restart_wall_s"]
+                break
+            time.sleep(0.25)
+        if restart_wall is None:
+            raise RuntimeError(
+                f"replica not restarted within {args.restart_budget:g}s "
+                f"(degraded_seen={degraded_seen})")
+        verdict["restart_wall_s"] = restart_wall
+        verdict["kill_to_healthy_s"] = time.monotonic() - t_kill
+        verdict["degraded_seen"] = degraded_seen
+
+        load_thread.join(timeout=args.restart_budget + 60)
+        burst = burst_box.get("burst") or {}
+        verdict["burst"] = burst
+        st = _get(port, "/statsz")
+        verdict["router"] = {k: st[k] for k in
+                             ("requests", "shed", "failovers",
+                              "relayed_5xx", "unroutable", "expired")}
+        verdict["restarts_total"] = st["restarts_total"]
+
+        # 6. the acceptance: zero non-shed failures, restart in budget
+        problems = []
+        if burst.get("errors", 1) != 0:
+            problems.append(f"burst errors {burst.get('errors')}")
+        if burst.get("expired", 0) != 0:
+            problems.append(f"burst expired {burst.get('expired')}")
+        if st["relayed_5xx"] != 0:
+            problems.append(f"relayed_5xx {st['relayed_5xx']}")
+        if st["unroutable"] != 0:
+            problems.append(f"unroutable {st['unroutable']}")
+        if restart_wall > args.restart_budget:
+            problems.append(f"restart_wall_s {restart_wall:.1f} > "
+                            f"budget {args.restart_budget:g}")
+        if st["restarts_total"] < 1:
+            problems.append("no restart recorded")
+        verdict["problems"] = problems
+        verdict["ok"] = not problems
+    except Exception as e:  # noqa: BLE001 - verdict carries the failure
+        verdict["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+        verdict["fleet_exit_code"] = proc.returncode
+
+    if verdict["ok"] and verdict.get("fleet_exit_code") != 0:
+        verdict["ok"] = False
+        verdict.setdefault("problems", []).append(
+            f"fleet exit code {verdict['fleet_exit_code']}")
+    line = json.dumps(verdict, indent=1)
+    print(line)
+    with open(os.path.join(args.out, "fleet_smoke.json"), "w",
+              encoding="utf-8") as f:
+        f.write(line + "\n")
+    if not verdict["ok"]:
+        tail = "".join(lines)[-3000:]
+        print(f"fleet_smoke FAILED; fleet output tail:\n{tail}",
+              file=sys.stderr)
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,10 @@ asserts.  Three artifact kinds:
   one JSON object per line with ``ts`` + ``kind``.
 * **alertz** — the ``GET /alertz`` JSON body (``--alertz`` file or
   URL): configured rules with live firing state.
+* **healthz** — a ``GET /healthz`` JSON body (``--healthz`` file or
+  URL), single engine or fleet aggregate: closed status vocabulary
+  plus the machine-readable ``reasons`` token list the fleet
+  supervisor's probe parses (doc/serving.md "Serving fleet").
 
 ``--require fam1,fam2`` additionally asserts that the exposition text
 carries those metric families — how the CI lane pins the device-plane
@@ -327,6 +331,54 @@ def validate_alertz(obj) -> List[str]:
     return problems
 
 
+_HEALTH_STATUSES = ("ok", "degraded", "down", "closed")
+
+
+def validate_healthz(obj) -> List[str]:
+    """Schema-check a ``GET /healthz`` body — single engine or fleet
+    aggregate.  The machine-readable contract the fleet supervisor's
+    probe (and any external load balancer) parses: a ``status`` from
+    the closed vocabulary plus a ``reasons`` list of stable string
+    tokens spelling out every degrade condition
+    (``serve/engine.py::healthz``, ``serve/fleet.py::healthz``)."""
+    problems: List[str] = []
+    if not isinstance(obj, dict):
+        return ["body is not an object"]
+    status = obj.get("status")
+    if status not in _HEALTH_STATUSES:
+        problems.append(f"bad status {status!r} (want one of "
+                        f"{'/'.join(_HEALTH_STATUSES)})")
+    reasons = obj.get("reasons")
+    if not isinstance(reasons, list) or any(
+            not isinstance(x, str) for x in reasons):
+        problems.append("reasons must be a list of strings")
+    else:
+        if status == "degraded" and not reasons:
+            problems.append(
+                "degraded with an empty reasons list (every degrade "
+                "condition must carry a machine-readable token)")
+        if status == "ok" and reasons:
+            problems.append(f"ok but reasons non-empty: {reasons}")
+    if not isinstance(obj.get("round"), int):
+        problems.append("round missing or not an integer")
+    if obj.get("fleet"):
+        reps = obj.get("replicas")
+        if not isinstance(reps, dict) or not isinstance(
+                reps.get("total"), int):
+            problems.append("fleet body needs a replicas object with "
+                            "an integer total")
+        elif any(not isinstance(v, int) for v in reps.values()):
+            problems.append("replicas state counts must be integers")
+        if not isinstance(obj.get("rotation"), int):
+            problems.append("fleet body needs an integer rotation")
+    else:
+        # the pre-fleet fields stay alongside reasons (compat contract)
+        for key in ("model", "reload_breaker"):
+            if key not in obj:
+                problems.append(f"missing legacy key {key!r}")
+    return problems
+
+
 def validate_events(path: str) -> List[str]:
     """Schema-check an event log; returns problems (empty == valid)."""
     problems: List[str] = []
@@ -509,6 +561,9 @@ def main() -> int:
     ap.add_argument("--events", default="", help="event-log JSONL path")
     ap.add_argument("--alertz", default="",
                     help="GET /alertz JSON body: file path or URL")
+    ap.add_argument("--healthz", default="",
+                    help="GET /healthz JSON body (engine or fleet): "
+                         "file path or URL")
     ap.add_argument("--require", default="",
                     help="comma-separated metric families the exposition "
                          "must carry (device-plane pinning)")
@@ -530,9 +585,10 @@ def main() -> int:
             print(f"FAIL {p}", file=sys.stderr)
         return 1 if problems else 0
 
-    if not (args.metrics or args.telemetry or args.events or args.alertz):
+    if not (args.metrics or args.telemetry or args.events or args.alertz
+            or args.healthz):
         ap.error("give at least one of --metrics/--telemetry/--events/"
-                 "--alertz (or --lineage)")
+                 "--alertz/--healthz (or --lineage)")
     if (args.tail or args.summary) and not (args.events or args.telemetry):
         ap.error("--tail/--summary need --events or --telemetry")
 
@@ -568,6 +624,19 @@ def main() -> int:
                 if not probs:
                     print(f"alertz: OK ({len(obj.get('rules', []))} "
                           f"rule(s), {len(obj.get('firing', []))} firing)")
+        if args.healthz:
+            try:
+                obj = _load_json_obj(args.healthz)
+            except (OSError, ValueError) as e:
+                problems.append(f"healthz {args.healthz}: {e}")
+            else:
+                probs = validate_healthz(obj)
+                problems += [f"healthz: {p}" for p in probs]
+                if not probs:
+                    kind = "fleet" if obj.get("fleet") else "engine"
+                    print(f"healthz: OK ({kind}, status "
+                          f"{obj.get('status')}, "
+                          f"{len(obj.get('reasons', []))} reason(s))")
         if args.telemetry:
             probs = validate_telemetry(args.telemetry)
             problems += [f"telemetry: {p}" for p in probs]
@@ -596,6 +665,8 @@ def main() -> int:
         print(_load_metrics_text(args.metrics), end="")
     if args.alertz:
         print(json.dumps(_load_json_obj(args.alertz), indent=1))
+    if args.healthz:
+        print(json.dumps(_load_json_obj(args.healthz), indent=1))
     if args.events:
         _summarize_events(args.events)
     if args.telemetry:

@@ -52,7 +52,8 @@ VERDICTS = ("baseline", "ok", "regression")
 #: substrings marking a metric as lower-is-better (latencies, and the
 #: mesh lane's compile counts — MORE compiles is the re-jit regression)
 _LOWER_MARKERS = ("latency", "_ms", "p50", "p95", "p99", "wall_sec",
-                  "compiles", "programs", "rebuild_wall_s")
+                  "compiles", "programs", "rebuild_wall_s",
+                  "restart_wall_s", "shed_ratio")
 
 
 def lower_is_better(name: str) -> bool:
@@ -100,7 +101,22 @@ def flatten_serve_bench(doc: dict) -> Dict[str, float]:
     v = closed.get("speedup")
     if isinstance(v, (int, float)) and math.isfinite(v):
         out["closed.speedup"] = float(v)
+    _flatten_burst(doc.get("open_loop_burst", {}), out)
     return out
+
+
+def _flatten_burst(burst: dict, out: Dict[str, float]) -> None:
+    """The burst-profile series shared by the serve_bench and
+    fleet_bench lanes: achieved rate, shed ratio (admission pressure),
+    and the sustained latency percentiles."""
+    v = burst.get("achieved_req_per_sec")
+    if isinstance(v, (int, float)) and math.isfinite(v):
+        out["burst.achieved_req_per_sec"] = float(v)
+    sent, shed = burst.get("sent"), burst.get("shed")
+    if (isinstance(sent, (int, float)) and sent
+            and isinstance(shed, (int, float))):
+        out["burst.shed_ratio"] = float(shed) / float(sent)
+    _walk_numbers("burst.latency_ms", burst.get("latency_ms", {}), out)
 
 
 def flatten_mesh_parity(doc: dict) -> Dict[str, float]:
@@ -164,11 +180,28 @@ def flatten_elastic(doc: dict) -> Dict[str, float]:
     return out
 
 
+def flatten_fleet_bench(doc: dict) -> Dict[str, float]:
+    """The FLEET lane's series (``tools/fleet_smoke.py``): replica
+    restart wall-clock (lower is better — a change that slows
+    detection, backoff, or replica startup drifts it up), sustained
+    p50/p99 under the burst profile, the achieved rate, and the shed
+    ratio (admission pressure; a change that sheds much more under the
+    same offered load leaves the band even while the hard zero-error
+    assertions still pass)."""
+    out: Dict[str, float] = {}
+    v = doc.get("restart_wall_s")
+    if isinstance(v, (int, float)) and math.isfinite(v):
+        out["restart_wall_s"] = float(v)
+    _flatten_burst(doc.get("burst", {}), out)
+    return out
+
+
 FLATTENERS = {"io_bench": flatten_io_bench,
               "serve_bench": flatten_serve_bench,
               "mesh_parity": flatten_mesh_parity,
               "quant_bench": flatten_quant_bench,
-              "elastic": flatten_elastic}
+              "elastic": flatten_elastic,
+              "fleet_bench": flatten_fleet_bench}
 
 
 # ----------------------------------------------------------------------

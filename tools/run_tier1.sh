@@ -61,6 +61,22 @@
 #                                     # shrink/grow schedule; rebuild
 #                                     # latency + recovered throughput
 #                                     # append to a perf_guard history
+#        FLEET=1 tools/run_tier1.sh   # also run the serving-fleet
+#                                     # smoke: a REAL 2-replica
+#                                     # task=serve fleet (CLI child
+#                                     # processes) under open-loop
+#                                     # burst load has one replica
+#                                     # SIGKILLed mid-run — every
+#                                     # non-shed request must still
+#                                     # succeed, the supervisor must
+#                                     # restart the dead replica in
+#                                     # budget (JSON verdict via
+#                                     # tools/fleet_smoke.py), plus a
+#                                     # scaled-down in-process
+#                                     # serve_bench --open-loop --burst
+#                                     # profile; both land in a
+#                                     # perf_guard history
+#                                     # (fleet_bench / serve_bench)
 #        OBS=1 tools/run_tier1.sh     # also run the observability smoke:
 #                                     # short telemetry=1 train + serve
 #                                     # scrape of /metricsz + /alertz
@@ -153,6 +169,29 @@ if [ "${QUANT:-0}" = "1" ]; then
       --input "$quant_out/verdict.json" \
       --history "$quant_out/bench_history.jsonl" > /dev/null || rc=1
   echo "QUANT lane verdict: $quant_out/verdict.json"
+fi
+if [ "${FLEET:-0}" = "1" ]; then
+  echo "=== opt-in serving-fleet smoke (FLEET=1) ==="
+  fleet_out=/tmp/_fleet_smoke
+  rm -rf "$fleet_out"; mkdir -p "$fleet_out"
+  timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python tools/fleet_smoke.py --out "$fleet_out" --replicas 2 \
+      > "$fleet_out/verdict.json" || rc=1
+  timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python tools/perf_guard.py --bench fleet_bench \
+      --input "$fleet_out/fleet_smoke.json" \
+      --history "$fleet_out/bench_history.jsonl" > /dev/null || rc=1
+  # scaled-down burst profile over the in-process engine (the full
+  # >=10^6-request invocation is queued in tpu_queue.sh)
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python tools/serve_bench.py --open-loop --burst --duration 6 \
+      --base-rate 50 --burst-rate 200 --phase 1 \
+      --json "$fleet_out/burst.json" > /dev/null || rc=1
+  timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python tools/perf_guard.py --bench serve_bench \
+      --input "$fleet_out/burst.json" \
+      --history "$fleet_out/bench_history.jsonl" > /dev/null || rc=1
+  echo "FLEET lane verdict: $fleet_out/fleet_smoke.json"
 fi
 if [ "${OBS:-0}" = "1" ]; then
   echo "=== opt-in observability smoke (OBS=1) ==="

@@ -12,6 +12,16 @@ micro-batcher + compiled-predict-cache data path:
   completions (the honest way to measure latency under load — a
   closed loop self-throttles and hides queueing collapse); reports
   achieved rate and p50/p95/p99 latency at each offered rate.
+* **open-loop burst profile** (``--open-loop --burst``): a square-wave
+  arrival schedule alternating ``--base-rate`` and ``--burst-rate``
+  every ``--phase`` seconds, sustained for ``--duration`` seconds (or
+  until ``--total-requests`` arrivals — the ROADMAP's >= 10^6-request
+  story; the full-scale invocation is queued in ``tpu_queue.sh``, a
+  scaled-down one runs in the FLEET=1 tier-1 lane).  Reports sustained
+  p50/p99 plus explicit shed (429) / expired (504) / error counts, so
+  admission-control behavior under burst pressure is a first-class
+  series.  ``--url`` points the same harness at a running HTTP front
+  end (e.g. the serving fleet) instead of the in-process engine.
 
 Prints one JSON document on stdout.
 
@@ -160,6 +170,177 @@ def open_loop(eng, x, rate, duration):
             "p99": lat[min(n - 1, int(n * 0.99))] * 1e3,
         }
     return out
+
+
+def open_loop_burst(fire, base_rate, burst_rate, phase_s, duration_s,
+                    total_requests=0, clients=64):
+    """Square-wave open-loop driver: arrivals alternate between
+    ``base_rate`` and ``burst_rate`` req/s every ``phase_s`` seconds.
+
+    ``fire()`` executes one request and returns ``(outcome, dt)`` with
+    outcome one of ``ok`` / ``shed`` (429) / ``expired`` (504) /
+    ``error``.  A fixed pool of ``clients`` workers drains a bounded
+    arrival queue, so arrivals are never blocked by completions; if the
+    pool cannot keep up the queue overflows into ``client_drop``
+    (reported — a silent cap would read as 'covered the offered load'
+    when it didn't)."""
+    import queue as _q
+
+    lat = []
+    counts = {"ok": 0, "shed": 0, "expired": 0, "error": 0,
+              "client_drop": 0}
+    lock = threading.Lock()
+    work: "_q.Queue" = _q.Queue(maxsize=10000)
+
+    def worker():
+        while True:
+            item = work.get()
+            if item is None:
+                return
+            outcome, dt = fire()
+            with lock:
+                counts[outcome] = counts.get(outcome, 0) + 1
+                if outcome == "ok":
+                    lat.append(dt)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(clients)]
+    for t in threads:
+        t.start()
+    t0 = time.perf_counter()
+    t_next = t0
+    sent = 0
+    while True:
+        now = time.perf_counter()
+        elapsed = now - t0
+        if total_requests and sent >= total_requests:
+            break
+        if not total_requests and elapsed >= duration_s:
+            break
+        if t_next > now:
+            time.sleep(min(t_next - now, 0.01))
+            continue
+        try:
+            work.put_nowait(1)
+            sent += 1
+        except _q.Full:
+            with lock:
+                counts["client_drop"] += 1
+        in_burst = int(elapsed / phase_s) % 2 == 1
+        rate = burst_rate if in_burst else base_rate
+        t_next += 1.0 / max(rate, 1e-9)
+        if t_next < now - 1.0:
+            t_next = now  # don't unwind a deep arrival backlog forever
+    for _ in threads:
+        work.put(None)
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    lat.sort()
+    n = len(lat)
+    out = {
+        "base_rate": base_rate,
+        "burst_rate": burst_rate,
+        "phase_s": phase_s,
+        "wall_sec": wall,
+        "sent": sent,
+        "completed": n,
+        "shed": counts["shed"],
+        "expired": counts["expired"],
+        "errors": counts["error"],
+        "client_drop": counts["client_drop"],
+        "achieved_req_per_sec": n / wall if wall > 0 else 0.0,
+    }
+    if n:
+        out["latency_ms"] = {
+            "p50": lat[n // 2] * 1e3,
+            "p95": lat[min(n - 1, int(n * 0.95))] * 1e3,
+            "p99": lat[min(n - 1, int(n * 0.99))] * 1e3,
+        }
+    return out
+
+
+def make_engine_fire(eng, x, deadline_ms=0.0):
+    """Burst-driver fire() over the in-process engine."""
+    from cxxnet_tpu import serve as _serve
+
+    def fire():
+        t0 = time.perf_counter()
+        try:
+            eng.predict(x, deadline_ms=deadline_ms or None)
+        except _serve.ServeError as e:
+            kind = ("shed" if e.http_status == 429
+                    else "expired" if e.http_status == 504 else "error")
+            return kind, time.perf_counter() - t0
+        except Exception:  # noqa: BLE001 - counted, bench keeps going
+            return "error", time.perf_counter() - t0
+        return "ok", time.perf_counter() - t0
+
+    return fire
+
+
+def make_url_fire(url, x, deadline_ms=0.0, priority=""):
+    """Burst-driver fire() over a running HTTP front end (single
+    engine or fleet router) — POST /predict per request."""
+    import urllib.error
+    import urllib.request
+
+    body = {"data": x.tolist()}
+    if deadline_ms:
+        body["deadline_ms"] = deadline_ms
+    if priority:
+        body["priority"] = priority
+    payload = json.dumps(body).encode("utf-8")
+
+    def fire():
+        t0 = time.perf_counter()
+        req = urllib.request.Request(
+            url.rstrip("/") + "/predict", data=payload,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+        except urllib.error.HTTPError as e:
+            e.read()
+            kind = ("shed" if e.code == 429
+                    else "expired" if e.code == 504 else "error")
+            return kind, time.perf_counter() - t0
+        except Exception:  # noqa: BLE001 - network errors counted
+            return "error", time.perf_counter() - t0
+        return "ok", time.perf_counter() - t0
+
+    return fire
+
+
+def run_open_loop_burst(args) -> dict:
+    """The ``--open-loop --burst`` entry: in-process engine by default,
+    a running front end with ``--url``."""
+    eng = None
+    if args.url:
+        row = [0.5] * 16
+        x = np.asarray([row] * args.rows, np.float32)
+        fire = make_url_fire(args.url, x, deadline_ms=args.deadline_ms)
+    else:
+        eng, x = build_engine(args)
+        for _ in range(8):
+            eng.predict(x)
+        fire = make_engine_fire(eng, x, deadline_ms=args.deadline_ms)
+    burst = open_loop_burst(
+        fire, args.base_rate, args.burst_rate, args.phase,
+        args.open_duration, total_requests=args.total_requests,
+        clients=args.clients)
+    result = {
+        "model": args.model,
+        "dev": args.dev,
+        "url": args.url or None,
+        "rows_per_request": args.rows,
+        "max_batch_size": args.max_batch,
+        "open_loop_burst": burst,
+    }
+    if eng is not None:
+        result["serving_stats"] = eng.snapshot_stats()
+        eng.close()
+    return result
 
 
 def run_quant_ab(args) -> dict:
@@ -342,7 +523,34 @@ def main(argv=None):
     ap.add_argument("--timeout-ms", type=float, default=2.0)
     ap.add_argument("--open-rates", default="",
                     help="comma-separated offered req/s for open-loop runs")
-    ap.add_argument("--open-duration", type=float, default=3.0)
+    ap.add_argument("--open-duration", type=float, default=3.0,
+                    dest="open_duration",
+                    help="seconds per open-loop run (and the burst "
+                         "profile's total duration)")
+    ap.add_argument("--duration", type=float, dest="open_duration",
+                    default=argparse.SUPPRESS,
+                    help="alias of --open-duration for the burst mode")
+    ap.add_argument("--open-loop", action="store_true",
+                    help="run the open-loop driver (with --burst: the "
+                         "square-wave burst profile)")
+    ap.add_argument("--burst", action="store_true",
+                    help="burst profile: alternate --base-rate and "
+                         "--burst-rate every --phase seconds")
+    ap.add_argument("--base-rate", type=float, default=100.0)
+    ap.add_argument("--burst-rate", type=float, default=400.0)
+    ap.add_argument("--phase", type=float, default=1.0,
+                    help="seconds per burst-profile phase")
+    ap.add_argument("--total-requests", type=int, default=0,
+                    help="stop after this many arrivals instead of "
+                         "--duration (the >= 10^6-request story)")
+    ap.add_argument("--clients", type=int, default=64,
+                    help="burst-driver worker pool size")
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="per-request deadline for the burst driver")
+    ap.add_argument("--url", default="",
+                    help="drive a running HTTP front end (fleet router "
+                         "or single server) instead of the in-process "
+                         "engine")
     ap.add_argument("--json", dest="json_path", default="",
                     help="also write the JSON report here")
     ap.add_argument("--quant", default="",
@@ -357,6 +565,23 @@ def main(argv=None):
     ap.add_argument("--recovery", type=float, default=0.9,
                     help="autotune pass bar vs the hand-tuned rate")
     args = ap.parse_args(argv)
+
+    if args.open_loop and args.burst:
+        result = run_open_loop_burst(args)
+        b = result["open_loop_burst"]
+        print(json.dumps(result, indent=1))
+        if args.json_path:
+            with open(args.json_path, "w", encoding="utf-8") as f:
+                json.dump(result, f, indent=1)
+        lat = b.get("latency_ms", {})
+        print(f"bench[burst:{args.model}] sent {b['sent']} "
+              f"ok {b['completed']} shed {b['shed']} "
+              f"expired {b['expired']} err {b['errors']} "
+              f"achieved {b['achieved_req_per_sec']:.1f} req/s "
+              f"p50 {lat.get('p50', float('nan')):.2f} ms "
+              f"p99 {lat.get('p99', float('nan')):.2f} ms",
+              file=sys.stderr, flush=True)
+        return 0 if b["errors"] == 0 else 1
 
     if args.quant:
         result = run_quant_ab(args)

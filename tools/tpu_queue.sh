@@ -104,6 +104,14 @@ sweep() {
     --quant int8 --max-batch 128 --rows 8 --requests 100
   run 600 python tools/serve_bench.py --model mnist_mlp --dev tpu \
     --quant int8 --requests 200
+  # serving-fleet burst story (ROADMAP item 1 / PR 12): >= 10^6
+  # open-loop requests through the serve data path at a bursty
+  # arrival profile — sustained p50/p99 + shed counts are the
+  # million-user evidence (doc/serving.md "Serving fleet"); the
+  # scaled-down twin runs in the FLEET=1 tier-1 lane
+  run 2700 python tools/serve_bench.py --model mnist_mlp --dev tpu \
+    --open-loop --burst --base-rate 2000 --burst-rate 8000 --phase 5 \
+    --total-requests 1000000 --clients 128 --rows 8 --max-batch 128
   # TPU-backend HLO fusion audit (compile-only; doc/performance.md)
   run 900 python tools/hlo_inspect.py googlenet 128
   run 900 python tools/hlo_inspect.py googlenet 128 conv_branch_embed=1
