@@ -965,6 +965,18 @@ class LearnTask:
             self.itr_train = None
             self.itr_evals = []
             self.eval_names = []
+            if self.net_trainer is not None:
+                # async data-parallel: in-flight aggregates were reduced
+                # by the DEAD generation's collectives — generation-stamp
+                # them out so nothing stale can ever be applied (the
+                # rebuilt trainer reloads a drained checkpoint anyway;
+                # this guards the window until it does, and the event
+                # makes the discard auditable)
+                try:
+                    self.net_trainer.async_abandon(
+                        generation=plan.generation, reason="rebuild")
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
             self.net_trainer = None
             gc.collect()
             # zero-RPC teardown: a shutdown barrier can never complete
@@ -1247,6 +1259,15 @@ class LearnTask:
         if self.divergence_lr_backoff != 1.0:
             self._lr_scale *= self.divergence_lr_backoff
             tr.scale_learning_rate(self._lr_scale)
+        if self.net_trainer is not None:
+            # async data-parallel: the discarded trainer may hold
+            # pending staleness aggregates — count + event-log the
+            # discard (same auditability as the elastic-rebuild path)
+            # so the staleness gauges don't misreport dead work
+            try:
+                self.net_trainer.async_abandon(reason="rollback")
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
         self.net_trainer = tr
         self.start_counter = round_ + 1
         obs_emit("divergence.rollback", round=round_, path=path,
@@ -1274,6 +1295,11 @@ class LearnTask:
 
         nproc = process_info()[1]
         check_preempt = nproc == 1
+        # async data-parallel (doc/parallel.md "Async data-parallel"):
+        # per-step fences move to the round boundary — the loop must
+        # not sync after every update or the overlap is gone
+        async_on = (self.test_io == 0
+                    and self.net_trainer._async_active())
         preempted = False
         sample_counter = 0
         self.net_trainer.start_round(self.start_counter)
@@ -1387,6 +1413,7 @@ class LearnTask:
         # stacks at the same points
         scan_ok = (
             self.scan_steps > 1
+            and not async_on  # the scan program is the fused sync step
             and self.net_trainer.update_period == 1
             and not self.net_trainer._n_extras()
             # node-bound train metrics need the per-step node
@@ -1459,12 +1486,16 @@ class LearnTask:
                                 self.net_trainer.stage_batch(staged_next)
                             else:
                                 exhausted = True
-                        t0 = time.perf_counter()
-                        self.net_trainer.sync()
-                        pipeline_stats().add(
-                            "device_wait", time.perf_counter() - t0,
-                            rows=self.net_trainer.batch_size,
-                        )
+                        if not async_on:
+                            # async mode: NO per-step fence — the
+                            # dispatch pipeline runs free until the
+                            # round-boundary async_round_end below
+                            t0 = time.perf_counter()
+                            self.net_trainer.sync()
+                            pipeline_stats().add(
+                                "device_wait", time.perf_counter() - t0,
+                                rows=self.net_trainer.batch_size,
+                            )
                     timer.stop()
                     self._global_step += 1
                     pipe_mark = time.perf_counter()  # span was timed
@@ -1482,6 +1513,18 @@ class LearnTask:
                 break
         _flush_pending()  # tail chunk shorter than scan_steps
         _drain_in_flight()  # round/preemption boundary: queue empty
+        if async_on:
+            # round-boundary fence (and, on resync rounds, the hard
+            # barrier draining the staleness buffers); billed as one
+            # device_wait lap so the round timing stays honest
+            t0 = time.perf_counter()
+            self.net_trainer.async_round_end(self.start_counter)
+            dt = time.perf_counter() - t0
+            pipeline_stats().add(
+                "device_wait", dt,
+                rows=sample_counter * self.net_trainer.batch_size,
+            )
+            timer.add(dt, 0)
         if preempted:
             return False
         stage_line = pipeline_stats().report()
@@ -1566,6 +1609,11 @@ class LearnTask:
             # round deltas are computable between records
             "device": obs_device.summary(),
         }
+        async_snap = self.net_trainer.async_snapshot()
+        if async_snap is not None:
+            # async data-parallel pipeline block: pending aggregate
+            # depths, push/apply/drop totals, last overlap fraction
+            record["async"] = async_snap
         if self.elastic_member is not None or self._elastic_rebuilds:
             from .parallel.distributed import process_info as _pinfo
 

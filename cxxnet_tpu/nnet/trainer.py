@@ -32,6 +32,7 @@ from __future__ import annotations
 import io as _io
 import json
 import struct
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -71,6 +72,14 @@ class NetTrainer:
         self.update_on_server = 0
         self.zero = 0
         self.det_reduce = 0
+        # async data-parallel (parallel/async_ps, doc/parallel.md
+        # "Async data-parallel"): per-group overlapped gradient
+        # exchange + bounded-staleness updates
+        self.async_overlap = 0
+        self.async_groups = 0       # 0 = auto parameter-count buckets
+        self.staleness = 0          # bounded staleness (aggregates)
+        self.async_resync_period = 1  # hard re-sync barrier period
+        self._async = None          # lazily built AsyncStepper
         self.save_ustate = 0
         self.divergence_policy = ""  # "" off | "abort" | "rollback"
         self.inject_nan_step = -1  # fault-injection hook (tests only)
@@ -122,6 +131,31 @@ class NetTrainer:
             if int(val) not in (0, 1):
                 raise ValueError(f"det_reduce={val}: must be 0 or 1")
             self.det_reduce = int(val)
+        elif name == "async_overlap":
+            # overlapped per-group gradient exchange (the mshadow-ps
+            # async heritage, parallel/async_ps): the fused step splits
+            # into per-shard backward + one async collective per
+            # gradient-exchange group, applies overlapping exchanges
+            if int(val) not in (0, 1):
+                raise ValueError(f"async_overlap={val}: must be 0 or 1")
+            self.async_overlap = int(val)
+        elif name == "async_groups":
+            if int(val) < 0:
+                raise ValueError(
+                    f"async_groups={val}: must be >= 0 (0 = auto)")
+            self.async_groups = int(val)
+        elif name == "staleness":
+            # bounded staleness: slow replicas apply k-step-old reduced
+            # aggregates instead of blocking; 0 = synchronous semantics
+            # (bitwise — the parity suite pins it)
+            if int(val) < 0:
+                raise ValueError(f"staleness={val}: must be >= 0")
+            self.staleness = int(val)
+        elif name == "async_resync_period":
+            if int(val) < 1:
+                raise ValueError(
+                    f"async_resync_period={val}: must be >= 1")
+            self.async_resync_period = int(val)
         elif name == "compile_cache_dir":
             # persistent XLA compilation cache: restarts/reloads reuse
             # compiled programs instead of re-jitting (utils/compile_cache)
@@ -207,6 +241,7 @@ class NetTrainer:
         self.graph = graph
         self._jit_cache.clear()  # drop closures over any previous net/mesh
         self._staged = None      # staged transfers belong to the old net
+        self._async = None       # async programs close over the old net
         self.net = FunctionalNet(graph)
         if self.net.batch_size:
             self.batch_size = self.net.batch_size
@@ -449,17 +484,25 @@ class NetTrainer:
         return bool(self.det_reduce and self.mesh_plan is not None
                     and self.mesh_plan.n_devices > 1)
 
-    def _validate_det_reduce(self) -> None:
-        """``det_reduce = 1`` constraints, checked at model build time.
+    def _async_active(self) -> bool:
+        """Is the overlapped per-group exchange (``async_overlap = 1``)
+        in effect?  Same 1-device no-op contract as ``det_reduce`` —
+        with no cross-replica exchange there is nothing to overlap, and
+        ``staleness`` has no collective to absorb."""
+        return bool(self.async_overlap and self.mesh_plan is not None
+                    and self.mesh_plan.n_devices > 1
+                    and not self.quant_scheme)
 
-        The shard_map step runs the forward per data shard, so it
-        supports exactly the shapes whose math is row-separable: pure
-        data parallelism (no model axis), replicated state (no ZeRO
+    def _row_separable_problems(self) -> list:
+        """Constraints shared by every shard_map step re-expression
+        (``det_reduce`` and ``async_overlap``): the forward runs per
+        data shard, so only row-separable math qualifies — pure data
+        parallelism (no model axis), replicated state (no ZeRO
         annotations inside the manual region), no extra-data nodes, no
         cross-batch aux state (BN running stats would silently become
-        per-shard statistics), and the fused single-update path."""
-        if not self._det_active():
-            return
+        per-shard statistics), the fused single-update path, and no
+        stochastic layers (the replicated per-shard rng would correlate
+        noise masks across shards)."""
         problems = []
         if self.mesh_plan.n_model != 1:
             problems.append(f"model_parallel={self.mesh_plan.n_model} "
@@ -487,11 +530,64 @@ class NetTrainer:
             problems.append(
                 f"stochastic layers {stochastic} (per-shard rng would "
                 "correlate noise masks across data shards)")
+        return problems
+
+    def _validate_det_reduce(self) -> None:
+        """``det_reduce = 1`` constraints, checked at model build time
+        (see :meth:`_row_separable_problems`) — and the async-overlap
+        twin, which shares the identical shard_map contract."""
+        if self._det_active():
+            problems = self._row_separable_problems()
+            if problems:
+                raise ValueError(
+                    "det_reduce=1 is incompatible with: "
+                    + "; ".join(problems)
+                    + " (doc/parallel.md 'Determinism contract')")
+        self._validate_async()
+
+    def _validate_async(self) -> None:
+        """``async_overlap = 1`` constraints (doc/parallel.md "Async
+        data-parallel"): the same row-separable shard_map contract as
+        ``det_reduce``, plus the async-only key coherence checks."""
+        if self.staleness and not self.async_overlap:
+            raise ValueError(
+                f"staleness={self.staleness} requires async_overlap=1 "
+                "(the synchronous step has no aggregate buffer to "
+                "delay; doc/parallel.md 'Async data-parallel')")
+        if not self._async_active():
+            return
+        problems = self._row_separable_problems()
         if problems:
             raise ValueError(
-                "det_reduce=1 is incompatible with: "
+                "async_overlap=1 is incompatible with: "
                 + "; ".join(problems)
-                + " (doc/parallel.md 'Determinism contract')")
+                + " (doc/parallel.md 'Async data-parallel')")
+
+    def _shard_grad_fn(self):
+        """The per-shard summed-loss gradient closure: grad of THIS
+        data shard's rows' summed loss, plus the per-shard loss and
+        out-node rows.  SHARED by the ``det_reduce`` fold step below
+        and the async per-group exchange (``parallel/async_ps``) —
+        the ``staleness = 0`` bitwise-parity contract depends on both
+        re-expressions tracing the IDENTICAL backward, so there is
+        exactly one copy of it."""
+        net = self.net
+        out_idx = net.out_node_index()
+
+        def per_shard_grad(params, data, labels, mask, rng, epoch):
+            def sum_loss(p):
+                nodes, loss, _ = net.forward(
+                    p, data, labels=labels, extras=(), train=True,
+                    rng=rng, step=epoch, aux={}, return_aux=True,
+                    sample_mask=mask,
+                )
+                return loss, nodes[out_idx].astype(jnp.float32)
+
+            (loss, out), g = jax.value_and_grad(
+                sum_loss, has_aux=True)(params)
+            return g, loss, out
+
+        return per_shard_grad
 
     def _det_grad_fn(self):
         """The shard_map re-expression of the step's cross-replica
@@ -505,24 +601,15 @@ class NetTrainer:
         implementation, process layout, or partitioner mood.  The loss
         layers already sum (not average) over rows, so the fold IS the
         global gradient with no renormalization."""
-        net = self.net
         plan = self.mesh_plan
         n = plan.n_data
-        out_idx = net.out_node_index()
+        per_shard_grad = self._shard_grad_fn()
         from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
 
         def per_shard(params, data, labels, mask, rng, epoch):
-            def sum_loss(p):
-                nodes, loss, _ = net.forward(
-                    p, data, labels=labels, extras=(), train=True,
-                    rng=rng, step=epoch, aux={}, return_aux=True,
-                    sample_mask=mask,
-                )
-                return loss, nodes[out_idx].astype(jnp.float32)
-
-            (loss, out), g = jax.value_and_grad(
-                sum_loss, has_aux=True)(params)
+            g, loss, out = per_shard_grad(
+                params, data, labels, mask, rng, epoch)
 
             def fold(x):
                 parts = jax.lax.all_gather(x, "data")
@@ -733,6 +820,12 @@ class NetTrainer:
             )
         if self.update_period != 1:
             raise ValueError("update_scan requires update_period == 1")
+        if self._async_active():
+            raise ValueError(
+                "update_scan is the fused multi-step program — it "
+                "cannot interleave the per-group async exchange; use "
+                "update() (scan_steps=1) with async_overlap=1"
+            )
         if self._n_extras():
             raise ValueError(
                 "update_scan does not support extra_data nodes; use update()"
@@ -995,6 +1088,47 @@ class NetTrainer:
         for up in self.updaters.values():
             up.param.base_lr *= factor
         self._jit_cache.clear()
+        self._async = None  # async programs bake the schedule in too
+
+    # ------------------------------------------------------------------
+    # async data-parallel (parallel/async_ps, doc/parallel.md)
+    def _async_stepper(self):
+        """The lazily built :class:`~cxxnet_tpu.parallel.async_ps.step.
+        AsyncStepper` driving the overlapped per-group exchange; rebuilt
+        whenever the net/mesh/jit cache is (programs close over both)."""
+        if self._async is None:
+            from ..parallel.async_ps import AsyncStepper
+
+            self._async = AsyncStepper(self)
+        return self._async
+
+    def async_round_end(self, round_: int) -> bool:
+        """Round-boundary fence for async mode — and, every
+        ``async_resync_period`` rounds, the hard re-sync barrier
+        (staleness buffers drained first).  No-op when async mode is
+        off or no async step ran yet.  Returns True on a resync."""
+        if self._async is None or not self._async_active():
+            return False
+        return self._async.round_end(round_)
+
+    def async_abandon(self, generation: Optional[int] = None,
+                      reason: str = "rebuild") -> int:
+        """Elastic rebuild hook: discard every pending (in-flight)
+        gradient aggregate and move the async updater to a new
+        membership generation, so an aggregate reduced by a dead
+        generation's collectives is never applied to the rebuilt
+        mesh's weights.  Returns the number of aggregates dropped."""
+        if self._async is None:
+            return 0
+        return self._async.updater.reset_staleness(
+            generation=generation, reason=reason)
+
+    def async_snapshot(self) -> Optional[dict]:
+        """Pipeline telemetry block (pending depths, pushes/applies,
+        overlap fraction) — ``None`` outside async mode."""
+        if self._async is None:
+            return None
+        return self._async.snapshot()
 
     def start_round(self, round_: int) -> None:
         self.round = round_
@@ -1414,6 +1548,32 @@ class NetTrainer:
         node_cache = {}
         if self.eval_train and self.train_metric.need_nodes():
             node_cache = self._node_pred_cache(data, extras, n_real)
+        if self._async_active():
+            # overlapped per-group exchange (parallel/async_ps): the
+            # host never blocks here — fences belong to
+            # async_round_end (and the opt-in divergence guard / train
+            # metrics below, which fetch and therefore sync)
+            stepper = self._async_stepper()
+            losses, out = stepper.step(
+                data, labels, mask, self._next_rng(), self.epoch_counter)
+            if self.divergence_policy or self.eval_train:
+                # these fetches fence the pipeline every step — billed
+                # against the round's overlap fraction so the gauge
+                # cannot report a fully-overlapped round that is
+                # effectively synchronous
+                t0 = time.perf_counter()
+                if self.divergence_policy:
+                    self._guard_loss(losses, self.epoch_counter)
+                if self.eval_train:
+                    self.train_metric.add_eval(
+                        self._train_metric_preds(out, n_real, node_cache),
+                        np.asarray(batch.label)[:n_real],
+                        self._label_ranges(),
+                    )
+                stepper.add_blocked(time.perf_counter() - t0)
+            self.epoch_counter += 1
+            obs_device.maybe_sample_step(self.epoch_counter, self.sync)
+            return
         if self.update_period == 1:
             # fused SPMD fast path: fwd+bwd+update in one donated program
             (self.params, self.ustates, self.aux, loss, out) = (
@@ -1696,6 +1856,17 @@ class NetTrainer:
         (``fetch_array``) allgathers across the job, so EVERY process
         must call this even when only rank 0 writes the file (the
         driver's discipline — ``cli.py::_save_model``)."""
+        if self._async is not None:
+            # checkpoints are SYNCHRONOUS states: apply every pending
+            # staleness aggregate first (every process drains the same
+            # buffer contents, so the collective apply order agrees),
+            # then PullWait every group — the serializer below reads
+            # the weights on host — then serialize; a resumed run
+            # restarts the pipeline
+            up = self._async.updater
+            up.drain()
+            for gid in range(len(up.groups)):
+                up.pull_wait(gid)
         header = {
             "structure": json.loads(self.graph.structure_to_json()),
             "epoch_counter": self.epoch_counter,

@@ -1,0 +1,52 @@
+"""Async data-parallel: the parameter-server heritage on the mesh.
+
+cxxnet's signature scaling trick was asynchrony — mshadow-ps
+``IAsyncUpdater`` hid each layer's gradient exchange behind the
+backward of the layers below it and tolerated bounded staleness across
+workers (PAPER.md; the relaxed-consistency case of arXiv 1605.08695).
+The port's mesh trainer was fully synchronous; this subsystem
+resurrects the model (ROADMAP item 5, ``async_overlap = 1``):
+
+* :mod:`~cxxnet_tpu.parallel.async_ps.groups` — partition the tensors
+  into gradient-exchange groups (``async_groups``, parameter-count
+  buckets by default);
+* :mod:`~cxxnet_tpu.parallel.async_ps.step` — the overlapped step:
+  per-shard backward with NO monolithic all-reduce, then one
+  dispatch-ordered async collective (all-gather + ordered fold) per
+  group, the apply of group k overlapping the exchange of group k+1;
+* :mod:`~cxxnet_tpu.parallel.async_ps.updater` — the
+  Push/PullReq/PullWait-shaped bounded-staleness buffers
+  (``staleness = k``) over the existing updater registry, with hard
+  re-sync barriers every ``async_resync_period`` rounds and
+  generation-stamped aggregates so an elastic rebuild can never apply
+  a dead generation's gradient.
+
+Correctness contract (doc/parallel.md "Async data-parallel"):
+``staleness = 0`` is BITWISE equal to the synchronous ``det_reduce``
+fused step (same all-gather + ordered fold, same updater math — the
+parity suite and the ASYNC=1 CLI lane pin the checkpoint CRCs);
+``staleness > 0`` changes the training math (delayed aggregates) and
+is gated by the measured convergence A/B (``tools/async_ab.py``).
+"""
+
+from __future__ import annotations
+
+from .groups import (
+    group_param_counts,
+    partition_groups,
+    subtree,
+    tensor_sizes,
+    write_back,
+)
+from .step import AsyncStepper
+from .updater import AsyncUpdater
+
+__all__ = [
+    "AsyncStepper",
+    "AsyncUpdater",
+    "group_param_counts",
+    "partition_groups",
+    "subtree",
+    "tensor_sizes",
+    "write_back",
+]

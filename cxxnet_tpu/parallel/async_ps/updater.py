@@ -1,0 +1,229 @@
+"""Push / PullReq / PullWait: the bounded-staleness async updater.
+
+The reference's ``IAsyncUpdater`` contract (``updater.h`` /
+``async_updater-inl.hpp``): after a layer's backward, ``Push`` hands its
+gradient to the parameter server, ``PullReq`` requests the updated
+weights, and ``PullWait`` — called only right before the NEXT forward
+needs that layer — blocks until they arrived.  Everything between Push
+and PullWait overlaps with the backward of the remaining layers.
+
+This module re-expresses that contract per gradient-exchange *group*
+on the SPMD mesh, wrapping the existing updater registry
+(``cxxnet_tpu/updater``) instead of a server process:
+
+* :meth:`AsyncUpdater.push` — enqueue a group's REDUCED (cross-replica
+  folded) gradient into the group's aggregate buffer, stamped with its
+  origin step and the current membership *generation*;
+* :meth:`AsyncUpdater.pull_req` — dispatch the updater apply for the
+  oldest buffered aggregate **once more than ``staleness`` aggregates
+  are pending**: with ``staleness = 0`` every push applies immediately
+  (synchronous semantics, bitwise — the parity suite pins it); with
+  ``staleness = k`` the apply consumes the k-step-old aggregate, so a
+  replica whose step-t reduction is still in flight keeps training on
+  weights that lag at most k applied updates instead of stalling the
+  pod;
+* :meth:`AsyncUpdater.pull_wait` — block until a group's weights are
+  resident (the fence before anything reads them on host);
+* :meth:`AsyncUpdater.drain` — the hard re-sync barrier: apply every
+  pending aggregate in push order (the trainer runs it every
+  ``async_resync_period`` rounds and before serializing a checkpoint,
+  so checkpoints are always fully-applied synchronous states).
+
+Staleness accounting per group is exported as
+``async_staleness_steps{group}``; every push bumps
+``async_pushes_total{group}``.
+
+**Generation stamping** (elastic pods, doc/parallel.md): each buffered
+aggregate carries the membership generation it was reduced under.  An
+elastic rebuild calls :meth:`reset_staleness`, which discards every
+pending aggregate and bumps the generation — and the apply path
+independently re-checks the stamp, so an aggregate reduced by a dead
+generation's collectives can NEVER be applied to the rebuilt mesh's
+weights (``async_stale_dropped_total{reason}`` counts both paths).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Deque, Dict, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...obs import events as obs_events
+from ...obs.registry import registry as obs_registry
+from .groups import GroupKey, subtree, write_back
+
+
+class _Aggregate(NamedTuple):
+    grads: dict      # {key: {tag: reduced grad}} — replicated leaves
+    epoch: int       # origin step (the updater schedule position)
+    generation: int  # membership generation the reduction ran under
+
+
+def _staleness_gauge():
+    return obs_registry().gauge(
+        "async_staleness_steps",
+        "Pending (not yet applied) gradient aggregates per exchange "
+        "group — the staleness the next apply will carry.",
+        labelnames=("group",),
+    )
+
+
+def _pushes_counter():
+    return obs_registry().counter(
+        "async_pushes_total",
+        "Gradient aggregates pushed into the async exchange buffers.",
+        labelnames=("group",),
+    )
+
+
+def _dropped_counter():
+    return obs_registry().counter(
+        "async_stale_dropped_total",
+        "Buffered aggregates discarded instead of applied.",
+        labelnames=("reason",),
+    )
+
+
+class AsyncUpdater:
+    """Bounded-staleness aggregate buffers over the trainer's updaters.
+
+    One instance per trainer; ``apply_fn(gid)`` must return the jitted
+    per-group apply program ``(params_sub, ustates_sub, grads_sub,
+    epoch) -> (new_params_sub, new_ustates_sub)`` (built by the
+    stepper, which owns program construction)."""
+
+    def __init__(self, trainer, groups: List[List[GroupKey]],
+                 staleness: int = 0, apply_fn=None) -> None:
+        self.trainer = trainer
+        self.groups = groups
+        self.staleness = max(0, int(staleness))
+        self.generation = 0
+        self._apply_fn = apply_fn
+        self._pending: List[Deque[_Aggregate]] = [
+            collections.deque() for _ in groups
+        ]
+        self.pushes = 0
+        self.applies = 0
+        self.dropped = 0
+
+    # ------------------------------------------------------------------
+    def pending_depth(self, gid: int) -> int:
+        return len(self._pending[gid])
+
+    def push(self, gid: int, grads: dict, epoch: int) -> None:
+        """Enqueue one group's reduced gradient aggregate (generation-
+        stamped); the dispatch that produced ``grads`` may still be in
+        flight — nothing here blocks."""
+        self._pending[gid].append(
+            _Aggregate(grads, int(epoch), self.generation))
+        self.pushes += 1
+        try:
+            _pushes_counter().labels(group=str(gid)).inc()
+            _staleness_gauge().labels(group=str(gid)).set(
+                len(self._pending[gid]))
+        except Exception:  # noqa: BLE001 - telemetry never aborts a step
+            pass
+
+    def pull_req(self, gid: int) -> int:
+        """Dispatch applies until at most ``staleness`` aggregates stay
+        pending.  Returns the number of applies dispatched (0 while the
+        pipeline is still filling; stale-generation discards do not
+        count — they never reach the weights)."""
+        n = 0
+        while len(self._pending[gid]) > self.staleness:
+            if self._apply_oldest(gid):
+                n += 1
+        return n
+
+    def pull_wait(self, gid: int) -> None:
+        """Block until this group's weights are resident — the fence a
+        host-side reader needs before touching them (device-side
+        consumers just get dependency-ordered behind the apply)."""
+        for key, tag in self.groups[gid]:
+            jax.block_until_ready(self.trainer.params[key][tag])
+
+    # ------------------------------------------------------------------
+    def _apply_oldest(self, gid: int) -> bool:
+        """Pop + apply one aggregate; returns False when the stamp
+        check discarded it instead."""
+        agg = self._pending[gid].popleft()
+        try:
+            _staleness_gauge().labels(group=str(gid)).set(
+                len(self._pending[gid]))
+        except Exception:  # noqa: BLE001
+            pass
+        if agg.generation != self.generation:
+            # an aggregate reduced under a dead membership generation:
+            # its collective may have folded contributions from a
+            # replica that no longer exists — never apply it
+            self.dropped += 1
+            try:
+                _dropped_counter().labels(reason="generation").inc()
+            except Exception:  # noqa: BLE001
+                pass
+            obs_events.emit("async.stale_generation_dropped", group=gid,
+                            epoch=agg.epoch, aggregate_gen=agg.generation,
+                            current_gen=self.generation)
+            return False
+        tr = self.trainer
+        psub = subtree(tr.params, self.groups[gid])
+        usub = subtree(tr.ustates, self.groups[gid])
+        new_p, new_u = self._apply_fn(gid)(
+            psub, usub, agg.grads, jnp.asarray(agg.epoch, jnp.int32))
+        write_back(tr.params, self.groups[gid], new_p)
+        write_back(tr.ustates, self.groups[gid], new_u)
+        self.applies += 1
+        return True
+
+    # ------------------------------------------------------------------
+    def drain(self) -> int:
+        """Apply every pending aggregate in push order (stale-generation
+        entries are discarded, not applied — and not counted) — the hard
+        re-sync barrier's first half; the caller fences afterwards."""
+        n = 0
+        for gid in range(len(self.groups)):
+            while self._pending[gid]:
+                if self._apply_oldest(gid):
+                    n += 1
+        return n
+
+    def reset_staleness(self, generation: Optional[int] = None,
+                        reason: str = "rebuild") -> int:
+        """Elastic rebuild hook: discard EVERY pending aggregate and
+        move to a new membership generation.  ``generation`` pins the
+        new stamp (the elastic member's); default bumps by one.
+        Returns how many aggregates were dropped."""
+        dropped = 0
+        for gid, dq in enumerate(self._pending):
+            dropped += len(dq)
+            dq.clear()
+            try:
+                _staleness_gauge().labels(group=str(gid)).set(0)
+            except Exception:  # noqa: BLE001
+                pass
+        if dropped:
+            self.dropped += dropped
+            try:
+                _dropped_counter().labels(reason=reason).inc(dropped)
+            except Exception:  # noqa: BLE001
+                pass
+        old = self.generation
+        self.generation = (old + 1 if generation is None
+                           else int(generation))
+        obs_events.emit("async.reset_staleness", reason=reason,
+                        dropped=dropped, old_generation=old,
+                        generation=self.generation)
+        return dropped
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "groups": len(self.groups),
+            "staleness": self.staleness,
+            "generation": self.generation,
+            "pending": [len(dq) for dq in self._pending],
+            "pushes": self.pushes,
+            "applies": self.applies,
+            "dropped": self.dropped,
+        }

@@ -92,8 +92,12 @@ SITES: Dict[str, Tuple[str, ...]] = {
     # fence): hang = a peer wedged in a collective (the deadline must
     # surface ReplicaLossError in bounded time), ioerror = the abrupt
     # connection reset a SIGKILLed peer produces (classified into
-    # ReplicaLossError by the elastic driver) — doc/parallel.md
-    "mesh.replica": ("hang", "ioerror"),
+    # ReplicaLossError by the elastic driver), latency = a STRAGGLER —
+    # a slow-but-alive peer stretching every collective fence by
+    # ``fault_latency_ms`` (calibrated); the sync step pays it at every
+    # per-step fence while ``async_overlap=1, staleness>=1`` pays it
+    # once per round boundary (doc/parallel.md "Async data-parallel")
+    "mesh.replica": ("hang", "ioerror", "latency"),
     # serving-fleet replica (serve/server.py::replica_fault_probe, the
     # health plane of a task=serve replica process): hang = a wedged
     # replica (probes stall; the fleet supervisor must eject it from

@@ -1,0 +1,455 @@
+"""Async data-parallel (``cxxnet_tpu/parallel/async_ps``): overlapped
+per-group gradient exchange + bounded staleness.
+
+The correctness contract (doc/parallel.md "Async data-parallel"):
+
+* ``staleness = 0, async_overlap = 1`` is BITWISE equal to the
+  synchronous ``det_reduce`` fused step — same all-gather + ordered
+  fold, same updater math, just split into dispatch-ordered per-group
+  programs (and allclose to the stock GSPMD step, the bound
+  ``det_reduce`` itself carries);
+* the compiled pipeline has NO monolithic all-reduce anywhere — the
+  per-group reduce programs exist (one per exchange group) and each
+  carries its own all-gather;
+* ``staleness = k`` delays every apply by exactly k aggregates, the
+  hard re-sync barrier (``async_resync_period``) and checkpoint
+  serialization drain the pipeline, and the whole thing replays
+  deterministically.
+"""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.parallel.async_ps import (
+    group_param_counts,
+    partition_groups,
+)
+
+MLP_CFG = [
+    ("dev", "tpu:0-3"),
+    ("batch_size", "16"),
+    ("input_shape", "1,1,16"),
+    ("seed", "7"),
+    ("eta", "0.1"),
+    ("momentum", "0.9"),
+    ("netconfig", "start"),
+    ("layer[0->1]", "fullc:fc1"),
+    ("nhidden", "32"),
+    ("layer[1->2]", "sigmoid"),
+    ("layer[2->3]", "fullc:fc2"),
+    ("nhidden", "8"),
+    ("layer[3->3]", "softmax"),
+    ("netconfig", "end"),
+]
+
+
+def _build(extra=()):
+    tr = NetTrainer()
+    tr.set_params(list(MLP_CFG) + list(extra))
+    tr.init_model()
+    return tr
+
+
+def _batches(n=4, seed=3, bs=16, nin=16, nout=8):
+    rng = np.random.RandomState(seed)
+    return [
+        DataBatch(data=rng.randn(bs, nin).astype(np.float32),
+                  label=rng.randint(0, nout, (bs, 1)).astype(np.float32))
+        for _ in range(n)
+    ]
+
+
+def _params_np(tr):
+    return {k: {t: np.asarray(w) for t, w in tags.items()}
+            for k, tags in tr.params.items()}
+
+
+def _assert_params(a, b, exact=True, msg=""):
+    for key in a:
+        for tag in a[key]:
+            if exact:
+                np.testing.assert_array_equal(
+                    a[key][tag], b[key][tag], err_msg=f"{key}/{tag}: {msg}")
+            else:
+                np.testing.assert_allclose(
+                    a[key][tag], b[key][tag], rtol=2e-4, atol=2e-5,
+                    err_msg=f"{key}/{tag}: {msg}")
+
+
+# ----------------------------------------------------------------------
+# group partitioning
+def test_partition_groups_balanced_and_contiguous():
+    params = {
+        f"l{i}": {"wmat": np.zeros((s,)), "bias": np.zeros((1,))}
+        for i, s in enumerate([100, 100, 100, 100])
+    }
+    groups = partition_groups(params, 4)
+    assert len(groups) == 4
+    flat = [kt for g in groups for kt in g]
+    # contiguous: the concatenation is exactly the tensor order
+    assert flat == [(f"l{i}", t) for i in range(4)
+                    for t in ("wmat", "bias")]
+    counts = group_param_counts(params, groups)
+    assert all(c >= 100 for c in counts)  # every group got real weight
+
+
+def test_partition_groups_auto_and_clamp():
+    params = {"l0": {"wmat": np.zeros((10,)), "bias": np.zeros((2,))}}
+    assert len(partition_groups(params, 0)) == 2   # auto: min(4, n)
+    assert len(partition_groups(params, 99)) == 2  # clamped to n
+    groups = partition_groups(params, 1)
+    assert groups == [[("l0", "wmat"), ("l0", "bias")]]
+
+
+# ----------------------------------------------------------------------
+# exact parity: the acceptance contract
+def test_async_staleness0_bitwise_equals_sync_fused():
+    """The overlapped pipeline at staleness=0 IS the synchronous fused
+    step: bitwise equal to ``det_reduce = 1`` (identical ordered fold +
+    updater math), allclose to the stock GSPMD step (the same bound
+    det_reduce itself carries vs all-reduce ordering)."""
+    sync_gspmd = _build()
+    sync_det = _build([("det_reduce", "1")])
+    async_tr = _build([("async_overlap", "1")])
+    for tr in (sync_gspmd, sync_det, async_tr):
+        for b in _batches():
+            tr.update(b)
+    async_tr.async_round_end(1)
+    _assert_params(_params_np(sync_det), _params_np(async_tr),
+                   exact=True, msg="async(staleness=0) != det_reduce sync")
+    _assert_params(_params_np(sync_gspmd), _params_np(async_tr),
+                   exact=False, msg="async drifted from the GSPMD step")
+    snap = async_tr.async_snapshot()
+    assert snap["pushes"] == snap["applies"] == 4 * snap["groups"]
+    assert snap["pending"] == [0] * snap["groups"]
+
+
+def test_async_is_deterministic():
+    a, b = (_build([("async_overlap", "1"), ("async_groups", "3")])
+            for _ in range(2))
+    for tr in (a, b):
+        for batch in _batches():
+            tr.update(batch)
+        tr.async_round_end(1)
+    _assert_params(_params_np(a), _params_np(b), exact=True,
+                   msg="async step not deterministic")
+
+
+def test_async_group_count_key():
+    tr = _build([("async_overlap", "1"), ("async_groups", "2")])
+    tr.update(_batches(1)[0])
+    assert tr.async_snapshot()["groups"] == 2
+    auto = _build([("async_overlap", "1")])
+    auto.update(_batches(1)[0])
+    assert auto.async_snapshot()["groups"] == 4  # 4 tensors -> min(4, 4)
+
+
+# ----------------------------------------------------------------------
+# compiled-HLO contract: per-group collectives, no monolithic all-reduce
+def test_async_hlo_per_group_collectives_no_allreduce():
+    import jax
+    import jax.numpy as jnp
+
+    tr = _build([("async_overlap", "1"), ("async_groups", "2")])
+    tr.update(_batches(1)[0])  # builds every program
+    stepper = tr._async
+    assert len(stepper._reduce_progs) == 2
+    assert all(p is not None for p in stepper._reduce_progs)
+
+    grad_txt = stepper._grad_fn().lower(
+        tr.params, jnp.zeros((16, 16), jnp.float32),
+        jnp.zeros((16, 1), jnp.float32), jnp.ones((16,), jnp.float32),
+        jax.random.PRNGKey(0), jnp.asarray(0, jnp.int32),
+    ).compile().as_text()
+    # the backward carries NO cross-replica collective at all — the
+    # exchange belongs to the per-group reduce dispatches
+    assert "all-reduce" not in grad_txt
+
+    from cxxnet_tpu.parallel.async_ps.groups import subtree
+
+    n = tr.mesh_plan.n_data
+    for gid, group in enumerate(stepper.groups):
+        stack = {
+            k: {t: jnp.zeros((n,) + np.shape(tr.params[k][t]), jnp.float32)
+                for t in tags}
+            for k, tags in subtree(tr.params, group).items()
+        }
+        txt = stepper._reduce_fn(gid).lower(stack).compile().as_text()
+        assert "all-gather" in txt, f"group {gid}: no all-gather"
+        assert "all-reduce" not in txt, f"group {gid}: monolithic reduce"
+
+
+# ----------------------------------------------------------------------
+# bounded staleness semantics
+def test_staleness_delays_applies_by_exactly_k():
+    tr = _build([("async_overlap", "1"), ("staleness", "2"),
+                 ("async_resync_period", "1000")])
+    init = _params_np(tr)
+    batches = _batches(5)
+    for i, b in enumerate(batches):
+        tr.update(b)
+        snap = tr.async_snapshot()
+        # applies lag pushes by exactly min(steps, k) aggregates
+        expect_pending = min(i + 1, 2)
+        assert snap["pending"] == [expect_pending] * snap["groups"]
+    # first two steps applied nothing: params were still the init for
+    # steps 1-2 (the pipeline fill), then moved
+    assert tr.async_snapshot()["applies"] == 3 * tr.async_snapshot()["groups"]
+    changed = any(
+        not np.array_equal(init[k][t], np.asarray(tr.params[k][t]))
+        for k in init for t in init[k])
+    assert changed
+
+
+def test_staleness_zero_applies_immediately():
+    tr = _build([("async_overlap", "1")])
+    init = _params_np(tr)
+    tr.update(_batches(1)[0])
+    snap = tr.async_snapshot()
+    assert snap["pending"] == [0] * snap["groups"]
+    assert any(not np.array_equal(init[k][t], np.asarray(tr.params[k][t]))
+               for k in init for t in init[k])
+
+
+def test_resync_period_controls_the_drain():
+    tr = _build([("async_overlap", "1"), ("staleness", "1"),
+                 ("async_resync_period", "2")])
+    tr.update(_batches(1)[0])
+    assert sum(tr.async_snapshot()["pending"]) > 0
+    assert tr.async_round_end(1) is False  # 1 % 2 != 0: fence only
+    assert sum(tr.async_snapshot()["pending"]) > 0
+    assert tr.async_round_end(2) is True   # the hard barrier
+    assert sum(tr.async_snapshot()["pending"]) == 0
+
+
+def test_checkpoint_serialization_drains_the_pipeline():
+    """Checkpoints are SYNCHRONOUS states: every pushed aggregate is
+    applied before the bytes are assembled, and the saved weights load
+    back bit-equal."""
+    import os
+    import tempfile
+
+    tr = _build([("async_overlap", "1"), ("staleness", "2"),
+                 ("async_resync_period", "1000")])
+    for b in _batches(3):
+        tr.update(b)
+    assert sum(tr.async_snapshot()["pending"]) > 0
+    blob = tr.checkpoint_bytes()
+    snap = tr.async_snapshot()
+    assert snap["pending"] == [0] * snap["groups"]
+    assert snap["applies"] == snap["pushes"]
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.model")
+        with open(path, "wb") as f:
+            f.write(blob)
+        tr2 = NetTrainer()
+        tr2.set_params(list(MLP_CFG) + [("async_overlap", "1")])
+        tr2.load_model(path)
+        _assert_params(_params_np(tr), _params_np(tr2), exact=True,
+                       msg="drained checkpoint did not round-trip")
+
+
+def test_staleness_drained_run_matches_explicit_delayed_math():
+    """staleness=1 over T steps + drain applies EVERY pushed gradient
+    exactly once, in push order — pushes == applies and two identical
+    runs (one drained mid-way via checkpoint, one at the end) agree."""
+    a = _build([("async_overlap", "1"), ("staleness", "1"),
+                ("async_resync_period", "1000")])
+    b = _build([("async_overlap", "1"), ("staleness", "1"),
+                ("async_resync_period", "1000")])
+    for batch in _batches(4):
+        a.update(batch)
+        b.update(batch)
+    a._async.updater.drain()
+    b.checkpoint_bytes()  # drains too
+    _assert_params(_params_np(a), _params_np(b), exact=True,
+                   msg="drain path order-dependent")
+
+
+# ----------------------------------------------------------------------
+# validation / guard rails
+def test_async_rejects_unsupported_shapes():
+    for extra in ([("model_parallel", "2")], [("zero", "1")],
+                  [("update_period", "2")]):
+        with pytest.raises(ValueError, match="async_overlap"):
+            _build([("async_overlap", "1")] + extra)
+
+
+def test_async_rejects_stochastic_layers():
+    cfg = [
+        ("dev", "tpu:0-3"), ("batch_size", "16"),
+        ("input_shape", "1,1,16"), ("seed", "7"), ("eta", "0.1"),
+        ("async_overlap", "1"),
+        ("netconfig", "start"),
+        ("layer[0->1]", "fullc:fc1"), ("nhidden", "32"),
+        ("layer[1->2]", "dropout"), ("threshold", "0.5"),
+        ("layer[2->3]", "fullc:fc2"), ("nhidden", "8"),
+        ("layer[3->3]", "softmax"),
+        ("netconfig", "end"),
+    ]
+    tr = NetTrainer()
+    tr.set_params(cfg)
+    with pytest.raises(ValueError, match="stochastic"):
+        tr.init_model()
+
+
+def test_staleness_requires_async_overlap():
+    with pytest.raises(ValueError, match="staleness"):
+        _build([("staleness", "1")])
+
+
+def test_async_key_value_validation():
+    tr = NetTrainer()
+    with pytest.raises(ValueError):
+        tr.set_param("async_overlap", "2")
+    with pytest.raises(ValueError):
+        tr.set_param("staleness", "-1")
+    with pytest.raises(ValueError):
+        tr.set_param("async_resync_period", "0")
+    with pytest.raises(ValueError):
+        tr.set_param("async_groups", "-1")
+
+
+def test_async_single_device_is_noop():
+    """On a 1-device mesh there is no exchange to overlap — the key is
+    accepted and training runs the plain synchronous path."""
+    tr = NetTrainer()
+    tr.set_params([("dev", "cpu") if k == "dev" else (k, v)
+                   for k, v in MLP_CFG]
+                  + [("async_overlap", "1"), ("staleness", "1")])
+    tr.init_model()
+    for b in _batches(2):
+        tr.update(b)
+    assert tr.epoch_counter == 2
+    assert tr._async is None  # the stepper was never built
+    assert tr.async_round_end(1) is False
+
+
+def test_update_scan_rejects_async():
+    tr = _build([("async_overlap", "1")])
+    data = np.zeros((2, 16, 16), np.float32)
+    labels = np.zeros((2, 16, 1), np.float32)
+    with pytest.raises(ValueError, match="async"):
+        tr.update_scan(data, labels)
+
+
+# ----------------------------------------------------------------------
+# observability
+def test_async_metric_families_exported():
+    from cxxnet_tpu.obs.registry import registry
+
+    tr = _build([("async_overlap", "1"), ("staleness", "1"),
+                 ("async_resync_period", "1")])
+    for b in _batches(2):
+        tr.update(b)
+    tr.async_round_end(1)
+    snap = registry().snapshot()
+    assert "async_pushes_total" in snap
+    assert 'async_pushes_total{group="0"}' in snap["async_pushes_total"]
+    assert "async_staleness_steps" in snap
+    assert "async_overlap_fraction" in snap
+    frac = snap["async_overlap_fraction"]["async_overlap_fraction"]
+    assert 0.0 <= frac <= 1.0
+
+
+def test_async_divergence_guard_sees_the_loss():
+    from cxxnet_tpu.utils.checkpoint import DivergenceError
+
+    tr = _build([("async_overlap", "1"),
+                 ("divergence_policy", "abort"),
+                 ("inject_nan_step", "1")])
+    batches = _batches(2)
+    tr.update(batches[0])
+    with pytest.raises(DivergenceError):
+        tr.update(batches[1])
+
+
+def test_async_eval_train_metrics_match_sync():
+    """eval_train metrics consume the async step's out rows — same
+    numbers the det-sync step reports for the same stream."""
+    a = _build([("det_reduce", "1"), ("eval_train", "1"),
+                ("metric", "error")])
+    b = _build([("async_overlap", "1"), ("eval_train", "1"),
+                ("metric", "error")])
+    for tr in (a, b):
+        for batch in _batches():
+            tr.update(batch)
+    line_a = a.evaluate(None, "train")
+    b.async_round_end(1)
+    line_b = b.evaluate(None, "train")
+    assert line_a == line_b
+
+
+# ----------------------------------------------------------------------
+# end to end through the CLI round loop (single process, 4-device mesh)
+def _write_cli_conf(tmp_path, overrides):
+    import os
+
+    from cxxnet_tpu.io.mnist import write_idx_images, write_idx_labels
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (64, 4, 4)).astype(np.uint8)
+    labels = (imgs.reshape(64, -1).mean(1) > 127).astype(np.uint8)
+    write_idx_images(str(tmp_path / "img.idx"), imgs)
+    write_idx_labels(str(tmp_path / "lab.idx"), labels)
+    conf = tmp_path / "async.conf"
+    conf.write_text(f"""
+data = train
+iter = mnist
+  path_img = "{tmp_path}/img.idx"
+  path_label = "{tmp_path}/lab.idx"
+  shuffle = 1
+iter = end
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.1
+layer[fc1->out] = fullc:fc2
+  nhidden = 2
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 16
+dev = tpu:0-3
+num_round = 2
+eval_train = 0
+eta = 0.1
+momentum = 0.9
+seed = 7
+metric = error
+silent = 1
+""")
+    mdir = tmp_path / ("models_" + overrides[0].split("=")[0])
+    os.makedirs(mdir, exist_ok=True)
+    return str(conf), str(mdir)
+
+
+def _cli_crcs(tmp_path, overrides):
+    from cxxnet_tpu.cli import LearnTask
+    from cxxnet_tpu.utils import checkpoint as ckpt
+
+    conf, mdir = _write_cli_conf(tmp_path, overrides)
+    task = LearnTask()
+    rc = task.run([conf, f"model_dir={mdir}"] + overrides)
+    assert rc in (0, None)
+    out = {}
+    for round_, path in ckpt.list_checkpoints(mdir):
+        man = ckpt.read_manifest(path)
+        assert man is not None
+        out[round_] = man["crc32"]
+    return out
+
+
+@pytest.mark.slow
+def test_cli_async_round_loop_bitwise_parity(tmp_path):
+    """The whole CLI round loop (iterators, padding, telemetry, the
+    round-boundary fence) at async_overlap=1 staleness=0 writes
+    checkpoint CRCs bitwise equal to the det_reduce synchronous run —
+    the in-process twin of the ASYNC=1 4-process lane."""
+    sync = _cli_crcs(tmp_path, ["det_reduce=1"])
+    async_ = _cli_crcs(tmp_path, ["async_overlap=1", "staleness=0"])
+    assert sync and sync == async_, (
+        f"CLI CRCs diverged: sync {sync} vs async {async_}")

@@ -380,6 +380,75 @@ def test_det_reduce_single_device_is_noop():
 
 
 # ----------------------------------------------------------------------
+# elastic x async data-parallel (doc/parallel.md "Async data-parallel"):
+# a rebuild must reset the staleness buffers and generation-stamp
+# in-flight aggregates so a dead generation's gradient is never applied
+def _params_np(tr):
+    return {k: {t: np.asarray(w) for t, w in tags.items()}
+            for k, tags in tr.params.items()}
+
+
+def test_async_rebuild_resets_staleness_buffers():
+    """The cli rebuild hook (``NetTrainer.async_abandon``): every
+    pending aggregate is dropped, the updater moves to the NEW
+    membership generation, and the pipeline keeps working after."""
+    from cxxnet_tpu.obs.registry import registry
+
+    tr = NetTrainer()
+    tr.set_params(list(MLP_CFG) + [("async_overlap", "1"),
+                                   ("staleness", "2"),
+                                   ("async_resync_period", "1000")])
+    tr.init_model()
+    _steps(tr, n=2)
+    snap = tr.async_snapshot()
+    assert sum(snap["pending"]) > 0 and snap["applies"] == 0
+    dropped = tr.async_abandon(generation=5, reason="rebuild")
+    assert dropped == sum(snap["pending"])
+    snap = tr.async_snapshot()
+    assert snap["pending"] == [0] * snap["groups"]
+    assert snap["generation"] == 5
+    reg = registry().snapshot()
+    assert ('async_stale_dropped_total{reason="rebuild"}'
+            in reg["async_stale_dropped_total"])
+    # the rebuilt-generation pipeline still trains
+    _steps(tr, n=3)
+    tr.async_round_end(1000)  # resync drains the new-gen aggregates
+    assert sum(tr.async_snapshot()["pending"]) == 0
+    assert tr.async_snapshot()["applies"] > 0
+
+
+def test_async_stale_generation_aggregate_is_never_applied():
+    """The independent guard behind the reset: even if a dead
+    generation's aggregate is still sitting in the buffer when the
+    generation moves on, the APPLY path re-checks the stamp and
+    discards it — the weights never see it."""
+    from cxxnet_tpu.obs.registry import registry
+
+    tr = NetTrainer()
+    tr.set_params(list(MLP_CFG) + [("async_overlap", "1"),
+                                   ("staleness", "1"),
+                                   ("async_resync_period", "1000")])
+    tr.init_model()
+    init = _params_np(tr)
+    _steps(tr, n=1)  # one aggregate pending per group, generation 0
+    up = tr._async.updater
+    assert sum(len(dq) for dq in up._pending) == len(up.groups)
+    up.generation = 1  # the membership moved on; buffers not cleared
+    drained = up.drain()
+    assert drained == 0  # nothing was APPLIED...
+    assert up.dropped == len(up.groups)  # ...everything was discarded
+    for key in init:
+        for tag in init[key]:
+            np.testing.assert_array_equal(
+                init[key][tag], np.asarray(tr.params[key][tag]),
+                err_msg=f"{key}/{tag}: a dead generation's gradient "
+                        "reached the weights")
+    reg = registry().snapshot()
+    assert ('async_stale_dropped_total{reason="generation"}'
+            in reg["async_stale_dropped_total"])
+
+
+# ----------------------------------------------------------------------
 # shutdown/re-init regression (satellite: maybe_init_distributed was
 # one-shot init-only).  Runs in a SUBPROCESS: the resilient client's
 # poll thread cannot be stopped from Python, so an in-pytest client

@@ -702,17 +702,99 @@ class _StubMember:
         return None
 
 
+def _scn_mesh_replica_latency():
+    """The STRAGGLER scenario (doc/parallel.md "Async data-parallel"):
+    a calibrated per-fence delay at the ``mesh.replica`` site models a
+    slow-but-alive peer.  The synchronous loop fences after EVERY step
+    (the CLI's per-batch discipline), so its round stalls >= the
+    injected delay x steps; ``async_overlap=1, staleness=1`` fences
+    once at the round boundary, so the same straggler is absorbed —
+    measured round wall-clock must beat sync by >= 1.3x, and the fault
+    site must record exactly ONE firing for the whole async round."""
+    import time as _time
+
+    import numpy as np
+
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    delay_s, n_steps = 0.15, 6
+    cfg = [
+        ("dev", "tpu:0-3"), ("batch_size", "8"),
+        ("input_shape", "1,1,16"), ("seed", "7"), ("eta", "0.1"),
+        ("eval_train", "0"),
+        ("netconfig", "start"),
+        ("layer[0->1]", "fullc:fc1"), ("nhidden", "16"),
+        ("layer[1->2]", "sigmoid"),
+        ("layer[2->3]", "fullc:fc2"), ("nhidden", "4"),
+        ("layer[3->3]", "softmax"),
+        ("netconfig", "end"),
+    ]
+
+    def build(extra):
+        tr = NetTrainer()
+        tr.set_params(cfg + extra)
+        tr.init_model()
+        return tr
+
+    def batches(seed=3):
+        rng = np.random.RandomState(seed)
+        return [
+            DataBatch(data=rng.randn(8, 16).astype(np.float32),
+                      label=rng.randint(0, 4, (8, 1)).astype(np.float32))
+            for _ in range(n_steps)
+        ]
+
+    sync_tr = build([("det_reduce", "1")])
+    async_tr = build([("async_overlap", "1"), ("staleness", "1"),
+                      ("async_resync_period", "1")])
+    # warm the compiles BEFORE arming the fault — the measurement must
+    # time the straggler, not XLA
+    for tr in (sync_tr, async_tr):
+        tr.update(batches()[0])
+        tr.sync() if tr is sync_tr else tr.async_round_end(0)
+
+    faults.injector().latency_s = delay_s  # = fault_latency_ms / 1e3
+
+    spec = faults.install("mesh.replica:latency:1")
+    t0 = _time.perf_counter()
+    for b in batches():
+        sync_tr.update(b)
+        sync_tr.sync()  # the CLI's per-step fence
+    sync_wall = _time.perf_counter() - t0
+    assert spec.fired == n_steps
+    assert sync_wall >= n_steps * delay_s  # stalls >= the injected delay
+    faults.reset()
+
+    faults.injector().latency_s = delay_s
+    spec = faults.install("mesh.replica:latency:1")
+    t0 = _time.perf_counter()
+    for b in batches():
+        async_tr.update(b)  # no per-step fence
+    async_tr.async_round_end(1)  # the ONE round-boundary fence
+    async_wall = _time.perf_counter() - t0
+    assert spec.fired == 1  # the straggler is paid once per round
+    assert async_wall >= delay_s  # the bound: one fence is still real
+    assert sync_wall / async_wall >= 1.3, (
+        f"async did not absorb the straggler: sync {sync_wall:.2f}s vs "
+        f"async {async_wall:.2f}s ({sync_wall / async_wall:.2f}x < 1.3x)")
+
+
 def _scn_mesh_replica(kind, tmp_path):
     """Replica-loss faults must surface as the TYPED ReplicaLossError in
     bounded time — never an indefinite hang inside a collective.
     ``hang`` models a peer wedged in a collective: the deadline
     (collective_timeout_s) fires while the liveness monitor suspects
     the peer.  ``ioerror`` models the connection-reset a SIGKILLed peer
-    produces: the raised error is classified into ReplicaLossError."""
+    produces: the raised error is classified into ReplicaLossError.
+    ``latency`` models a straggler — see _scn_mesh_replica_latency."""
     import time as _time
 
     from cxxnet_tpu.parallel import elastic as par_elastic
 
+    if kind == "latency":
+        _scn_mesh_replica_latency()
+        return
     if kind == "hang":
         faults.install("mesh.replica:hang:1:1")
         member = _StubMember(suspects=[2])

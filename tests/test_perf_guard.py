@@ -137,6 +137,30 @@ def test_flatten_serve_bench():
     assert m["closed.sequential.req_per_sec"] == 50.0
 
 
+def test_flatten_async_bench():
+    doc = {
+        "parity": {"crc_equal": True, "sync_wall_sec": 20.0,
+                   "async_wall_sec": 19.0, "rounds": 3},
+        "ab": {"legs": {
+            "sync": {"final_err": 0.05, "wall_sec": 8.0},
+            "staleness1": {"final_err": 0.06, "wall_sec": 7.5,
+                           "overlap_fraction": 0.9},
+        }},
+        "overlap": {"sync_step_wall_sec": 0.002,
+                    "async_step_wall_sec": 0.001,
+                    "overlap_fraction": 0.95, "speedup": 2.0},
+    }
+    m = perf_guard.flatten_async_bench(doc)
+    assert m["parity.crc_equal"] == 1.0
+    assert m["ab.staleness1.final_err"] == 0.06
+    assert m["overlap.overlap_fraction"] == 0.95
+    # orientation: errors and walls regress UP, overlap regresses DOWN
+    assert perf_guard.lower_is_better("ab.sync.final_err")
+    assert perf_guard.lower_is_better("overlap.async_step_wall_sec")
+    assert not perf_guard.lower_is_better("overlap.overlap_fraction")
+    assert not perf_guard.lower_is_better("parity.crc_equal")
+
+
 def test_empty_metrics_is_an_error(tmp_path):
     with pytest.raises(ValueError):
         perf_guard.run_once("io_bench", {"results": []},

@@ -53,7 +53,7 @@ VERDICTS = ("baseline", "ok", "regression")
 #: mesh lane's compile counts — MORE compiles is the re-jit regression)
 _LOWER_MARKERS = ("latency", "_ms", "p50", "p95", "p99", "wall_sec",
                   "compiles", "programs", "rebuild_wall_s",
-                  "restart_wall_s", "shed_ratio")
+                  "restart_wall_s", "shed_ratio", "final_err")
 
 
 def lower_is_better(name: str) -> bool:
@@ -196,12 +196,47 @@ def flatten_fleet_bench(doc: dict) -> Dict[str, float]:
     return out
 
 
+def flatten_async_bench(doc: dict) -> Dict[str, float]:
+    """The ASYNC lane's series (``tools/async_ab.py``): the parity bit
+    (crc_equal as 0/1 — a run that stops being bitwise equal collapses
+    far outside any band), per-leg final error (lower is better — a
+    staleness leg drifting from the sync baseline shows up here even
+    inside the lane's --tol) and wall seconds, and the overlap
+    micro-bench's step-wall/overlap-fraction pair (step_wall lower is
+    better, overlap_fraction higher — a change that silently
+    de-overlaps the dispatch pipeline drags the fraction down)."""
+    out: Dict[str, float] = {}
+    parity = doc.get("parity")
+    if isinstance(parity, dict):
+        out["parity.crc_equal"] = 1.0 if parity.get("crc_equal") else 0.0
+        for key in ("sync_wall_sec", "async_wall_sec"):
+            v = parity.get(key)
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                out[f"parity.{key}"] = float(v)
+    for name, leg in ((doc.get("ab") or {}).get("legs") or {}).items():
+        if not isinstance(leg, dict):
+            continue
+        for key in ("final_err", "wall_sec", "overlap_fraction"):
+            v = leg.get(key)
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                out[f"ab.{name}.{key}"] = float(v)
+    overlap = doc.get("overlap")
+    if isinstance(overlap, dict):
+        for key in ("sync_step_wall_sec", "async_step_wall_sec",
+                    "overlap_fraction", "speedup"):
+            v = overlap.get(key)
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                out[f"overlap.{key}"] = float(v)
+    return out
+
+
 FLATTENERS = {"io_bench": flatten_io_bench,
               "serve_bench": flatten_serve_bench,
               "mesh_parity": flatten_mesh_parity,
               "quant_bench": flatten_quant_bench,
               "elastic": flatten_elastic,
-              "fleet_bench": flatten_fleet_bench}
+              "fleet_bench": flatten_fleet_bench,
+              "async_bench": flatten_async_bench}
 
 
 # ----------------------------------------------------------------------

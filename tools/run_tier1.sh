@@ -77,6 +77,23 @@
 #                                     # profile; both land in a
 #                                     # perf_guard history
 #                                     # (fleet_bench / serve_bench)
+#        ASYNC=1 tools/run_tier1.sh   # also run the async data-parallel
+#                                     # lane: a 4-process CPU-mesh CLI
+#                                     # train with async_overlap=1,
+#                                     # staleness=0 must write checkpoint
+#                                     # CRCs BITWISE equal to the
+#                                     # det_reduce synchronous run of the
+#                                     # same conf/seed (the overlap is
+#                                     # dispatch scheduling, not
+#                                     # different arithmetic), plus a
+#                                     # tiny staleness convergence A/B
+#                                     # smoke (sync vs staleness=0 legs,
+#                                     # schema-validated verdict JSON via
+#                                     # tools/async_ab.py); the verdict
+#                                     # appends to a perf_guard history
+#                                     # (async_bench flattener:
+#                                     # overlap_fraction higher-is-
+#                                     # better, step_wall lower)
 #        OBS=1 tools/run_tier1.sh     # also run the observability smoke:
 #                                     # short telemetry=1 train + serve
 #                                     # scrape of /metricsz + /alertz
@@ -192,6 +209,21 @@ if [ "${FLEET:-0}" = "1" ]; then
       --input "$fleet_out/burst.json" \
       --history "$fleet_out/bench_history.jsonl" > /dev/null || rc=1
   echo "FLEET lane verdict: $fleet_out/fleet_smoke.json"
+fi
+if [ "${ASYNC:-0}" = "1" ]; then
+  echo "=== opt-in async data-parallel lane (ASYNC=1) ==="
+  async_out=/tmp/_async_lane
+  rm -rf "$async_out"; mkdir -p "$async_out"
+  # outer budget > the tool's per-leg --timeout (240 s) x the smoke's
+  # four legs (2 parity + 2 A/B) plus data/conf setup slack
+  timeout -k 10 1080 env JAX_PLATFORMS=cpu \
+    python tools/async_ab.py --smoke --out "$async_out" \
+      > /dev/null || rc=1
+  timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python tools/perf_guard.py --bench async_bench \
+      --input "$async_out/async_ab.json" \
+      --history "$async_out/bench_history.jsonl" > /dev/null || rc=1
+  echo "ASYNC lane verdict: $async_out/async_ab.json"
 fi
 if [ "${OBS:-0}" = "1" ]; then
   echo "=== opt-in observability smoke (OBS=1) ==="

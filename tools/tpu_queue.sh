@@ -112,6 +112,13 @@ sweep() {
   run 2700 python tools/serve_bench.py --model mnist_mlp --dev tpu \
     --open-loop --burst --base-rate 2000 --burst-rate 8000 --phase 5 \
     --total-requests 1000000 --clients 128 --rows 8 --max-batch 128
+  # async data-parallel overlap bench (ROADMAP item 5 / PR 13): the
+  # on-chip step-wall measurement — per-step fence (sync) vs one
+  # round-boundary fence (async_overlap=1, staleness=1) over the same
+  # stream (doc/parallel.md "Async data-parallel"); CPU numbers only
+  # show dispatch overhead, the chip shows exchange/compute overlap
+  run 900 python tools/async_ab.py --overlap-bench --dev tpu \
+    --steps 100 --hidden 4096
   # TPU-backend HLO fusion audit (compile-only; doc/performance.md)
   run 900 python tools/hlo_inspect.py googlenet 128
   run 900 python tools/hlo_inspect.py googlenet 128 conv_branch_embed=1
