@@ -85,19 +85,22 @@ class LearnTask:
         # tune.options_from_cfg from the raw cfg stream
         self.controller = 0
         # closed-loop continuous training (task=serve_train,
-        # doc/continuous_training.md)
+        # doc/continuous_training.md).  The loop_*/publish_*/feedback_*
+        # defaults live in ONE table shared with the per-tenant parser
+        # (loop/tenant.py TenantOptions) so task=serve_train and
+        # task=loop_fleet can never drift apart on the same conf.
+        from .loop.tenant import TenantOptions
+
+        for _key, _default in TenantOptions.DEFAULTS.items():
+            setattr(self, _key, _default)
         self.loop_dir = "loop"
-        self.loop_rounds_per_cycle = 2
-        self.loop_replay_ratio = 0.25
-        self.loop_min_records = 64
-        self.loop_max_records = 0  # per cycle; 0 = everything pending
         self.loop_cycle_period_s = 2.0
         self.loop_max_cycles = 0  # stop fine-tuning after N trained cycles
-        self.publish_min_delta = 0.0
-        self.publish_metric = ""  # substring match; "" = first reported
         self.capture_predict = 0  # log /predict inputs+predictions too
-        self.feedback_page_bytes = 1 << 20
-        self.feedback_rotate_bytes = 8 << 20
+        # multi-tenant loops (task=loop_fleet, loop/tenant.py): keys
+        # inside a 'tenant = <name>' .. 'tenant = end' section bind to
+        # that tenant, not to the driver
+        self._in_tenant_section = False
         # quantized inference (task=export_quant / quant= at serve
         # time; doc/performance.md "Quantized inference")
         self.quant = "int8"  # export scheme (serve reads the raw key)
@@ -122,6 +125,16 @@ class LearnTask:
 
     # ------------------------------------------------------------------
     def set_param(self, name: str, val: str) -> None:
+        # tenant sections pass through untouched: a tenant's model_dir
+        # (or any other key) must never clobber the driver's globals —
+        # loop/tenant.py re-splits them from the raw stream
+        if name == "tenant":
+            self._in_tenant_section = val != "end"
+            self.cfg.append((name, val))
+            return
+        if self._in_tenant_section:
+            self.cfg.append((name, val))
+            return
         if val == "default":
             return
         if name == "print_step":
@@ -226,12 +239,22 @@ class LearnTask:
             self.publish_min_delta = float(val)
         elif name == "publish_metric":
             self.publish_metric = val
+        elif name == "publish_slice_floor":
+            self.publish_slice_floor = float(val)
+        elif name == "publish_slice_min_count":
+            self.publish_slice_min_count = int(val)
+        elif name == "publish_source_field":
+            self.publish_source_field = int(val)
         elif name == "capture_predict":
             self.capture_predict = int(val)
         elif name == "feedback_page_bytes":
             self.feedback_page_bytes = int(val)
         elif name == "feedback_rotate_bytes":
             self.feedback_rotate_bytes = int(val)
+        elif name == "feedback_retain_shards":
+            self.feedback_retain_shards = int(val)
+        elif name == "feedback_retain_bytes":
+            self.feedback_retain_bytes = int(val)
         elif name == "quant":
             self.quant = "" if val in ("0", "off", "none") else val
         elif name == "quant_min_agreement":
@@ -283,7 +306,8 @@ class LearnTask:
         compile_cache.configure(self.cfg, silent=bool(self.silent))
         if self.task not in ("train", "finetune", "pred", "pred_raw",
                              "extract", "generate", "summary", "serve",
-                             "serve_train", "export_quant"):
+                             "serve_train", "loop_fleet",
+                             "export_quant"):
             raise ValueError(f"unknown task {self.task!r}")
         if self.elastic_opts.join:
             # a rejoining process has no mesh yet: admission, backend
@@ -312,6 +336,8 @@ class LearnTask:
             self.task_serve()
         elif self.task == "serve_train":
             self.task_serve_train()
+        elif self.task == "loop_fleet":
+            self.task_loop_fleet()
         else:
             raise ValueError(f"unknown task {self.task!r}")
         return 0
@@ -352,6 +378,17 @@ class LearnTask:
                     "task=serve_train is single-process (the trainer "
                     "rides beside the serving engine)")
             self._create_iterators()
+            return
+        if self.task == "loop_fleet":
+            # every tenant builds its OWN engine + iterators from its
+            # effective config (loop/tenant.py) — the driver only
+            # validates the process shape here
+            from .parallel.distributed import process_info
+
+            if process_info()[1] > 1:
+                raise ValueError(
+                    "task=loop_fleet is single-process (N tenants "
+                    "share this process's device pool)")
             return
         if self.task == "train" and self.continue_training:
             if self._sync_latest_model():
@@ -1878,6 +1915,15 @@ class LearnTask:
             page_bytes=self.feedback_page_bytes,
             rotate_bytes=self.feedback_rotate_bytes,
         )
+        retention = None
+        if self.feedback_retain_shards >= 0:
+            from .loop.retention import RetentionOptions, Sweeper
+
+            retention = Sweeper(
+                feedback.dir,
+                RetentionOptions(self.feedback_retain_shards,
+                                 self.feedback_retain_bytes),
+                silent=bool(self.silent))
         loop = ContinuousLoop(
             engine,
             self.cfg,
@@ -1892,7 +1938,15 @@ class LearnTask:
             cycle_period_s=self.loop_cycle_period_s,
             publish_min_delta=self.publish_min_delta,
             publish_metric=self.publish_metric,
+            publish_slice_floor=(self.publish_slice_floor
+                                 if self.publish_slice_floor >= 0
+                                 else None),
+            publish_slice_min_count=self.publish_slice_min_count,
+            publish_source_field=(self.publish_source_field
+                                  if self.publish_source_field >= 0
+                                  else None),
             feedback_writer=feedback,
+            retention=retention,
             silent=bool(self.silent),
         )
         loop_thread = threading.Thread(
@@ -1945,6 +1999,134 @@ class LearnTask:
             engine.close()
             feedback.close()
         print("serve_train: shutdown complete", flush=True)
+
+    def task_loop_fleet(self) -> None:
+        """``task=loop_fleet``: multi-tenant continuous learning
+        (doc/continuous_training.md "Multi-tenant loops").
+
+        Hosts one serving engine + feedback log + fine-tune loop per
+        ``[tenant:<name>]`` conf section, all sharing this process's
+        device pool.  One HTTP front door dispatches by the request's
+        ``model`` field (``serve/router.ModelRouter``); a scheduler
+        thread round-robins the tenants' fine-tune cycles under the
+        SLO-constrained arbiter — while any ``alert=`` rule fires
+        (e.g. the serve plane's p99 bound), ALL tune cycles shed.
+        Gates are per-slice when ``publish_slice_floor >= 0``; consumed
+        feedback shards compact when ``feedback_retain_shards >= 0``.
+        Shutdown drains like ``task=serve``."""
+        import signal as _signal
+        import threading
+
+        from .loop.tenant import TenantManager
+        from .serve import Engine
+        from .serve.server import serve_forever
+        from .tune import options_from_cfg
+
+        if self.replicas > 1:
+            raise ValueError(
+                "task=loop_fleet is single-replica per tenant engine; "
+                "front a replica fleet with task=serve separately")
+        if any(n == "quant" and v not in ("", "0", "off", "none")
+               for n, v in self.cfg):
+            raise ValueError(
+                "task=loop_fleet cannot serve quantized models: the "
+                "fine-tune loops train on the served weights")
+        shared_cfg, tenant_secs = cfgmod.split_tenant_sections(self.cfg)
+        if not tenant_secs:
+            raise ValueError(
+                "task=loop_fleet needs at least one tenant section "
+                "(tenant = <name> .. tenant = end)")
+        if not cfgmod.split_sections(shared_cfg).find("eval"):
+            raise ValueError(
+                "task=loop_fleet needs an eval section — every "
+                "tenant's publish gate scores on held-out data")
+
+        def engine_factory(tenant_cfg, model_dir):
+            return Engine(
+                cfg=tenant_cfg,
+                model_dir=model_dir,
+                max_batch_size=self.serve_max_batch,
+                batch_timeout_ms=self.batch_timeout_ms,
+                queue_limit=self.queue_limit,
+                default_deadline_ms=self.serve_deadline_ms,
+                silent=bool(self.silent),
+                reload_breaker_threshold=self.reload_breaker_threshold,
+                reload_breaker_cooldown_s=self.reload_breaker_cooldown_s,
+                watchdog_timeout_s=self.watchdog_timeout_s,
+            )
+
+        def make_iters(tenant_cfg):
+            # a tenant's iterators come from the SHARED data/eval
+            # sections with the tenant's own overrides applied last
+            # (e.g. seed_data) — fresh instances per tenant, iterator
+            # state is never shared
+            tsplit = cfgmod.split_sections(tenant_cfg)
+            data = tsplit.find("data")
+            evals = tsplit.find("eval")
+            base = create_iterator(data[0].entries) if data else None
+            ev = create_iterator(evals[0].entries)
+            for it in (base, ev):
+                if it is None:
+                    continue
+                for n, v in tsplit.global_entries:
+                    it.set_param(n, v)
+                it.init()
+            return base, ev, evals[0].tag or "eval"
+
+        manager = TenantManager(
+            shared_cfg, tenant_secs,
+            engine_factory=engine_factory,
+            make_iters=make_iters,
+            loop_dir=self.loop_dir,
+            period_s=self.loop_cycle_period_s,
+            # the fleet-wide arbiter reads the SHARED stream: a tune_*
+            # key inside a tenant section must never retune the shared
+            # controller (the same isolation set_param enforces)
+            tune_opts=options_from_cfg(shared_cfg),
+            silent=bool(self.silent),
+        )
+        router = manager.router()
+        httpd_box = {}
+
+        def _ready(httpd):
+            httpd_box["httpd"] = httpd
+            names = ", ".join(t.name for t in manager.tenants)
+            print(f"loop_fleet: serving {len(manager.tenants)} "
+                  f"tenant(s) [{names}] on "
+                  f"http://{httpd.server_address[0]}:{httpd.server_port}",
+                  flush=True)
+            manager.start()
+
+        def _stop(signum, frame):
+            # signal only — joining the scheduler here would stall the
+            # accept loop for up to a whole fine-tune cycle and eat the
+            # drain window; close() in the finally block does the join
+            print(f"loop_fleet: shutdown requested, draining (up to "
+                  f"{self.drain_timeout_s:g}s)", flush=True)
+            manager.request_stop()
+            h = httpd_box.get("httpd")
+            if h is not None:
+                threading.Thread(target=h.shutdown, daemon=True).start()
+
+        prev = {s: _signal.signal(s, _stop)
+                for s in (_signal.SIGTERM, _signal.SIGINT)}
+        try:
+            serve_forever(
+                manager.tenants[0].engine,
+                host=self.serve_host,
+                port=self.serve_port,
+                reload_period_s=self.serve_reload_period,
+                drain_timeout_s=self.drain_timeout_s,
+                verbose=not self.silent,
+                ready_fn=_ready,
+                capture_predict=bool(self.capture_predict),
+                router=router,
+            )
+        finally:
+            for s, p in prev.items():
+                _signal.signal(s, p)
+            manager.close()
+        print("loop_fleet: shutdown complete", flush=True)
 
     def task_export_quant(self) -> int:
         """``task=export_quant``: post-training quantized export with

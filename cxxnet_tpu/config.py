@@ -236,3 +236,67 @@ def cfg_get(cfg: Sequence[ConfigEntry], name: str, default: str | None = None) -
         if n == name:
             out = v
     return out
+
+
+@dataclasses.dataclass
+class TenantSection:
+    """One ``[tenant:<name>]`` block: ``tenant = <name>`` .. ``tenant = end``.
+
+    Everything between the opener and the closer belongs to the tenant —
+    its ``model_dir``, feedback-log location, and any per-tenant
+    overrides of the loop/publish/iterator keys (applied LAST over the
+    shared globals, so the usual last-entry-wins rule resolves them).
+    """
+
+    name: str
+    entries: List[ConfigEntry]
+
+
+def split_tenant_sections(
+    cfg: Sequence[ConfigEntry],
+) -> Tuple[List[ConfigEntry], List[TenantSection]]:
+    """Strip ``tenant = <name>`` .. ``tenant = end`` blocks out of the
+    ordered stream; returns ``(remaining_entries, tenant_sections)``.
+
+    The remaining stream is what the shared planes (netconfig, data/eval
+    sections, serve keys) parse from; each tenant's effective config is
+    ``remaining + section.entries`` (``loop/tenant.py``).  Iterator and
+    netconfig sections may not open inside a tenant block — a tenant
+    customizes the shared sections by overriding their keys (e.g.
+    ``seed_data``), it does not define new ones."""
+    rest: List[ConfigEntry] = []
+    tenants: List[TenantSection] = []
+    cur: List[ConfigEntry] | None = None
+    cur_name = ""
+    seen = set()
+    for name, val in cfg:
+        if name == "tenant":
+            if val == "end":
+                if cur is None:
+                    raise ConfigError("'tenant = end' outside a tenant section")
+                tenants.append(TenantSection(cur_name, cur))
+                cur, cur_name = None, ""
+            else:
+                if cur is not None:
+                    raise ConfigError(
+                        f"'tenant = {val}' opens a new tenant section while "
+                        f"[tenant:{cur_name}] is missing 'tenant = end'")
+                if not val or val in seen:
+                    raise ConfigError(
+                        f"tenant name {val!r} is empty or duplicated")
+                seen.add(val)
+                cur, cur_name = [], val
+            continue
+        if cur is not None:
+            if name in ("data", "eval", "pred", "netconfig"):
+                raise ConfigError(
+                    f"'{name} = {val}' inside [tenant:{cur_name}]: tenants "
+                    "override the shared sections' keys, they do not open "
+                    "their own sections")
+            cur.append((name, val))
+        else:
+            rest.append((name, val))
+    if cur is not None:
+        raise ConfigError(
+            f"tenant section [tenant:{cur_name}] not closed by 'tenant = end'")
+    return rest, tenants

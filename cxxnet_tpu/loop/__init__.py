@@ -10,9 +10,16 @@ Three parts (doc/continuous_training.md):
   tail the log, mix with base-iterator replay, train, gate, advance
   the cursor;
 * :mod:`~cxxnet_tpu.loop.publisher` — the eval gate: divergence guard
-  + held-out-metric comparison against the serving model; only passing
+  + held-out-metric comparison against the serving model, plus the
+  per-slice cohort gate (``publish_slice_floor``); only passing
   candidates reach the model directory (and the engine's hot reload),
-  with a publish pointer recording rollback state.
+  with a publish pointer recording rollback state, the gate metric and
+  its cohort vector;
+* :mod:`~cxxnet_tpu.loop.retention` — compaction of consumed feedback
+  shards behind the resolved cursor, crash-safe (boundary fsynced
+  before unlink);
+* :mod:`~cxxnet_tpu.loop.tenant` — ``task=loop_fleet``: N tenants on
+  one device pool behind an SLO-constrained round arbiter.
 """
 
 from .continuous import ContinuousLoop
@@ -21,11 +28,14 @@ from .feedback_log import (
     FeedbackReader,
     FeedbackRecord,
     FeedbackWriter,
+    StaleCursorError,
     decode_record,
     encode_record,
     loop_metrics,
 )
 from .publisher import EvalGatedPublisher, metric_improvement, parse_eval_metric
+from .retention import RetentionOptions, Sweeper
+from .tenant import Tenant, TenantArbiter, TenantManager
 
 __all__ = [
     "ContinuousLoop",
@@ -34,6 +44,12 @@ __all__ = [
     "FeedbackRecord",
     "FeedbackWriter",
     "EvalGatedPublisher",
+    "RetentionOptions",
+    "StaleCursorError",
+    "Sweeper",
+    "Tenant",
+    "TenantArbiter",
+    "TenantManager",
     "decode_record",
     "encode_record",
     "loop_metrics",

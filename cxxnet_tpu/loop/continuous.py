@@ -111,8 +111,13 @@ class ContinuousLoop:
         cycle_period_s: float = 2.0,
         publish_min_delta: float = 0.0,
         publish_metric: str = "",
+        publish_slice_floor: Optional[float] = None,
+        publish_slice_min_count: int = 8,
+        publish_source_field: Optional[int] = None,
         cursor_path: Optional[str] = None,
         feedback_writer=None,
+        retention=None,
+        name: str = "",
         silent: bool = True,
     ) -> None:
         if eval_iter is None:
@@ -134,15 +139,25 @@ class ContinuousLoop:
         self.max_records_per_cycle = int(max_records_per_cycle)
         self.cycle_period_s = float(cycle_period_s)
         self.feedback_writer = feedback_writer
+        self.retention = retention  # loop/retention.py Sweeper or None
+        self.name = name
         self.silent = silent
         self._m = loop_metrics()
         self._stop = threading.Event()
         self.cycles = 0
         self.trained_cycles = 0
+        # the in-flight cycle's first lineage id: records read but not
+        # yet resolved (published/rejected).  Retention must never
+        # compact the shard holding this range — a crash mid-cycle
+        # replays exactly these records into the next attempt.
+        self.pending_first_seq: Optional[int] = None
         self.publisher = EvalGatedPublisher(
             engine, eval_iter, eval_name=eval_name,
             metric_name=publish_metric, min_delta=publish_min_delta,
-            silent=silent,
+            slice_floor=publish_slice_floor,
+            slice_min_count=publish_slice_min_count,
+            source_field=publish_source_field,
+            tenant=name, silent=silent,
         )
         self.trainer = self._load_trainer(engine.model_path)
         self._row_shape = tuple(
@@ -223,32 +238,71 @@ class ContinuousLoop:
         # and building it fresh per cycle means a cycle that failed
         # mid-training and replays its records cannot double-count them
         lineage = self._cycle_lineage(records)
-        with obs_trace.span("loop.cycle", cycle=self.cycles,
-                            records=len(records)):
-            steps = 0
-            for _ in range(self.rounds_per_cycle):
-                for data, labels in self._batches(records):
-                    self.trainer.update_all(data, labels)
-                    steps += 1
-            self.trainer.sync()
-            published = self.publisher.consider(
-                self.trainer, cycle=self.cycles, lineage=lineage)
-            if not published:  # these records are spent either way
-                self._rollback()
-        self.cursor_file.store(new_cursor)
+        self.pending_first_seq = lineage["first_seq"]
+        try:
+            with obs_trace.span("loop.cycle", cycle=self.cycles,
+                                records=len(records)):
+                steps = 0
+                for _ in range(self.rounds_per_cycle):
+                    for data, labels in self._batches(records):
+                        self.trainer.update_all(data, labels)
+                        steps += 1
+                self.trainer.sync()
+                published = self.publisher.consider(
+                    self.trainer, cycle=self.cycles, lineage=lineage)
+                if not published:  # these records are spent either way
+                    self._rollback()
+            self.cursor_file.store(new_cursor)
+        finally:
+            # the range is pending until the cursor durably passes it:
+            # a cycle that dies mid-training keeps its shard compaction-
+            # proof so the replay can actually read the records back
+            self.pending_first_seq = None
         self._m.pending.set(self.reader.pending(new_cursor))
         self._m.cycles.labels(outcome="trained").inc()
         self.trained_cycles += 1
         obs_events.emit(
             "loop.cycle", cycle=self.cycles, records=len(records),
             steps=steps, published=published, lineage=lineage,
-            elapsed_s=time.monotonic() - t0)
+            elapsed_s=time.monotonic() - t0, **self._tag())
         if not self.silent:
-            print(f"loop: cycle {self.cycles}: {len(records)} records, "
-                  f"{steps} steps, "
+            print(f"loop{self._label()}: cycle {self.cycles}: "
+                  f"{len(records)} records, {steps} steps, "
                   f"{'published' if published else 'rejected'} "
                   f"({time.monotonic() - t0:.2f}s)", flush=True)
+        self.sweep_retention()
         return "published" if published else "rejected"
+
+    # ------------------------------------------------------------------
+    def _tag(self) -> dict:
+        return {"tenant": self.name} if self.name else {}
+
+    def _label(self) -> str:
+        return f"[{self.name}]" if self.name else ""
+
+    def set_rounds_per_cycle(self, n) -> int:
+        """Live setter for the arbiter's per-tenant knob
+        (``loop/tenant.py``): fine-tune passes per cycle, floor 1."""
+        self.rounds_per_cycle = max(1, int(n))
+        return self.rounds_per_cycle
+
+    def sweep_retention(self) -> Optional[dict]:
+        """One retention pass over this loop's feedback dir (no-op
+        without a sweeper).  The cursor handed over is the PERSISTED
+        one — only ranges a resolved cycle has durably consumed are
+        behind it — clamped by the in-flight pending range."""
+        if self.retention is None:
+            return None
+        try:
+            return self.retention.sweep(
+                self.cursor_file.load(),
+                pending_first_seq=self.pending_first_seq)
+        except Exception as e:  # noqa: BLE001 - retention must not
+            # take down the loop; the disk keeps filling, loudly
+            obs_events.log_exception_once(
+                f"loop.retention.{self.name or 'default'}", e,
+                kind="loop.retention_error")
+            return None
 
     @staticmethod
     def _cycle_lineage(records: List[FeedbackRecord]) -> dict:
@@ -269,16 +323,17 @@ class ContinuousLoop:
         target = self.publisher.rollback_target()
         if target is None:  # no checkpoint left: keep current weights
             obs_events.emit("loop.rollback", ok=False,
-                            reason="no valid rollback checkpoint")
+                            reason="no valid rollback checkpoint",
+                            **self._tag())
             return
         round_, path = target
         self.trainer = self._load_trainer(path)
         self._m.publishes.labels(decision="rollback").inc()
         obs_events.emit("loop.rollback", ok=True, round=round_,
-                        path=path)
+                        path=path, **self._tag())
         if not self.silent:
-            print(f"loop: rolled trainer back to round {round_} "
-                  f"({path})", flush=True)
+            print(f"loop{self._label()}: rolled trainer back to round "
+                  f"{round_} ({path})", flush=True)
 
     # ------------------------------------------------------------------
     def run(self, max_cycles: int = 0) -> None:

@@ -66,14 +66,22 @@ __all__ = [
     "FeedbackWriter",
     "FeedbackReader",
     "CursorFile",
+    "StaleCursorError",
     "encode_record",
     "decode_record",
     "loop_metrics",
+    "read_retention",
 ]
 
 SHARD_RE = re.compile(r"feedback-(\d{6})\.bin$")
 COMMIT_SUFFIX = ".commit"
 SEQ_FILE = "seq.json"
+#: retention pointer (loop/retention.py): ``{"compacted_below": k}``
+#: means every shard with index < k has been compacted away — the
+#: pointer is fsynced BEFORE any unlink, so a crash mid-compaction
+#: leaves orphan files below the boundary (ignored by readers, deleted
+#: by the next sweep) instead of a boundary that lies
+RETENTION_FILE = "retention.json"
 #: lineage ids are handed out from durably RESERVED blocks: one atomic
 #: sidecar write reserves this many ids ahead, so an id acknowledged to
 #: a /feedback client can never be reassigned after a crash (the
@@ -171,6 +179,41 @@ def decode_record(blob) -> FeedbackRecord:
     return FeedbackRecord(data.copy(), labels)
 
 
+class StaleCursorError(RuntimeError):
+    """A reader's cursor points into a shard that retention compacted
+    away: the records it expects are GONE, and silently skipping ahead
+    would hand the trainer a hole it can never audit.  The holder must
+    decide — re-baseline the cursor (a fresh consumer) or treat the
+    loss as fatal (a consumer that believed it was caught up)."""
+
+    def __init__(self, cursor: Dict, compacted_below: int,
+                 dir_: str) -> None:
+        super().__init__(
+            f"cursor {cursor} points into a compacted shard of {dir_}: "
+            f"every shard below index {compacted_below} was deleted by "
+            "retention (records behind the consumed-and-published "
+            "cursor); re-baseline the cursor or restore the log")
+        self.cursor = dict(cursor)
+        self.compacted_below = int(compacted_below)
+        self.dir = dir_
+
+
+def read_retention(dir_: str) -> Dict:
+    """The retention pointer: ``{"compacted_below": 0, ...}`` when the
+    log was never compacted (or the pointer is unreadable — a missing
+    pointer can only UNDER-report the boundary, never invent one)."""
+    try:
+        with open(os.path.join(dir_, RETENTION_FILE), "r",
+                  encoding="utf-8") as f:
+            ret = json.load(f)
+        if isinstance(ret, dict) and isinstance(
+                ret.get("compacted_below"), int):
+            return ret
+    except (OSError, ValueError):
+        pass
+    return {"compacted_below": 0}
+
+
 def _shard_path(dir_: str, idx: int) -> str:
     return os.path.join(dir_, f"feedback-{idx:06d}.bin")
 
@@ -247,8 +290,13 @@ class FeedbackWriter:
         os.makedirs(dir_, exist_ok=True)
         shards = list_shards(dir_)
         # resume at the last shard's committed length (a torn tail past
-        # it is dead bytes; truncate so offsets stay contiguous)
-        self._shard_idx = shards[-1][0] if shards else 0
+        # it is dead bytes; truncate so offsets stay contiguous); never
+        # resume BELOW the retention boundary — if every shard was
+        # compacted away, reusing index 0 would put new records behind
+        # the boundary where readers must ignore them
+        self._shard_idx = max(
+            shards[-1][0] if shards else 0,
+            read_retention(dir_)["compacted_below"])
         self._f = None
         # lineage: the next record sequence id, resumed past everything
         # ever ASSIGNED — the committed pages' coverage AND the durable
@@ -512,12 +560,24 @@ class FeedbackReader:
         return [(idx, path, _read_commits(path))
                 for idx, path in list_shards(self.dir)]
 
+    def _compacted_below(self, cur: Cursor) -> int:
+        """Retention boundary check shared by :meth:`pending` and
+        :meth:`read_since`: a cursor pointing below the boundary wants
+        records that no longer exist — fail loud, never skip."""
+        below = read_retention(self.dir)["compacted_below"]
+        if cur["shard"] < below:
+            raise StaleCursorError(cur, below, self.dir)
+        return below
+
     def pending(self, cursor: Optional[Cursor] = None) -> int:
-        """Committed records past ``cursor`` (cheap: sidecars only)."""
+        """Committed records past ``cursor`` (cheap: sidecars only).
+        Raises :class:`StaleCursorError` for a cursor pointing into a
+        compacted shard."""
         cur = cursor or _cursor()
+        below = self._compacted_below(cur)
         n = 0
         for idx, _path, commits in self._shard_commits():
-            if idx < cur["shard"]:
+            if idx < max(cur["shard"], below):
                 continue
             for ent in commits:
                 if idx == cur["shard"] and ent["off"] < cur["off"]:
@@ -533,12 +593,18 @@ class FeedbackReader:
         consumed.  A CRC-mismatching or unreadable committed page is
         skipped and counted (``loop_feedback_bad_pages_total``) — the
         cursor still advances past it.  ``max_records > 0`` caps the
-        read (the cursor then stops at a page boundary)."""
+        read (the cursor then stops at a page boundary).  A cursor
+        pointing into a compacted shard raises
+        :class:`StaleCursorError`; shards below the retention boundary
+        that still exist on disk (a crash between the boundary fsync
+        and the unlinks) are ignored — they are already deleted as far
+        as the protocol is concerned."""
         cur = dict(cursor) if cursor else _cursor()
+        below = self._compacted_below(cur)
         out: List[FeedbackRecord] = []
         m = loop_metrics()
         for idx, path, commits in self._shard_commits():
-            if idx < cur["shard"]:
+            if idx < max(cur["shard"], below):
                 continue
             for ent in commits:
                 if idx == cur["shard"] and ent["off"] < cur["off"]:

@@ -54,10 +54,86 @@ from typing import Dict, List, Optional, Tuple
 from ..obs import events as obs_events
 from .fleet import Replica, fleet_metrics
 
-__all__ = ["FleetRouter", "FleetStats", "PRIORITIES"]
+__all__ = ["FleetRouter", "FleetStats", "ModelRouter",
+           "UnknownModelError", "PRIORITIES"]
 
 PRIORITIES = ("interactive", "batch")
 MAX_BODY_BYTES = 64 << 20
+
+
+class UnknownModelError(KeyError):
+    """A request named a model no route serves.  ``reason`` is the
+    stable machine-readable token clients and supervisors key on (the
+    HTTP layer maps this to a 404 carrying it)."""
+
+    reason = "unknown_model"
+
+    def __init__(self, model, known) -> None:
+        self.model = model
+        self.known = sorted(known)
+        super().__init__(
+            f"unknown model {model!r}; serving: "
+            f"{', '.join(self.known) or '(none)'}")
+
+    def __str__(self) -> str:  # KeyError.__str__ repr()s its arg
+        return self.args[0]
+
+
+class ModelRouter:
+    """Per-model dispatch: a request's ``model`` field → the named
+    tenant's engine + feedback log.
+
+    The in-process half of per-model routing (ROADMAP item 1): the
+    single-engine HTTP front-end (``serve/server.py``) and the
+    multi-tenant loop manager (``loop/tenant.py``) both resolve
+    through one of these.  A model-less request takes the DEFAULT
+    route — the first model registered, or the explicitly flagged one
+    — so single-model clients keep working unchanged against a
+    multi-model server.  Routes are fixed after startup, so resolution
+    is lock-free on the hot path."""
+
+    def __init__(self) -> None:
+        self._routes: Dict[str, Tuple[object, object]] = {}
+        self._default: Optional[str] = None
+
+    def add(self, name: str, engine, feedback=None,
+            default: bool = False) -> "ModelRouter":
+        if not name:
+            raise ValueError("a model route needs a non-empty name")
+        if name in self._routes:
+            raise ValueError(f"duplicate model route {name!r}")
+        self._routes[name] = (engine, feedback)
+        if default or self._default is None:
+            self._default = name
+        return self
+
+    def resolve(self, model=None) -> Tuple[str, object, object]:
+        """``(name, engine, feedback)`` for a request's ``model`` field
+        (None/empty → the default route).  Raises
+        :class:`UnknownModelError` for a name no route serves."""
+        if model in (None, ""):
+            model = self._default
+        if model not in self._routes:
+            raise UnknownModelError(model, self._routes.keys())
+        engine, feedback = self._routes[model]
+        return str(model), engine, feedback
+
+    def models(self) -> List[str]:
+        return sorted(self._routes)
+
+    def engines(self) -> List[object]:
+        return [e for e, _fb in self._routes.values()]
+
+    def healthz_models(self) -> Dict[str, dict]:
+        """Per-model identity block for the front-end's ``/healthz``."""
+        out = {}
+        for name, (engine, _fb) in sorted(self._routes.items()):
+            h = engine.healthz()
+            out[name] = {"status": h.get("status"),
+                         "round": h.get("round"),
+                         "model_crc32": h.get("model_crc32"),
+                         "default": name == self._default}
+        return out
 
 #: network-layer dispatch failures that trigger failover (a replica
 #: HTTP error response is NOT one of these — it relays)

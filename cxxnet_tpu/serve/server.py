@@ -139,6 +139,11 @@ class _Handler(BaseHTTPRequestHandler):
     verbose = False
     feedback = None  # FeedbackWriter when the loop is armed
     capture_predict = False  # log /predict inputs + predictions
+    # per-model routing (serve/router.py ModelRouter): when armed, a
+    # request's "model" field selects the tenant engine + feedback log;
+    # model-less requests take the default route, unknown models get a
+    # 404 with the machine-readable "unknown_model" reason token
+    router = None
     # correlation ids: a short per-server token + a monotonic counter,
     # minted per POST and echoed in the response as "rid" so a client
     # can tie its request to server-side events and feedback lineage
@@ -189,9 +194,15 @@ class _Handler(BaseHTTPRequestHandler):
         with self.inflight:
             if self.path == "/healthz":
                 replica_fault_probe()  # serve.replica chaos site
-                self._reply(200, self.engine.healthz())
+                h = self.engine.healthz()
+                if self.router is not None:
+                    h["models"] = self.router.healthz_models()
+                self._reply(200, h)
             elif self.path == "/statsz":
-                self._reply(200, self.engine.snapshot_stats())
+                st = self.engine.snapshot_stats()
+                if self.router is not None:
+                    st["models"] = self.router.models()
+                self._reply(200, st)
             elif self.path == "/metricsz":
                 from ..obs import registry as obs_registry
 
@@ -227,14 +238,33 @@ class _Handler(BaseHTTPRequestHandler):
                 self.close_connection = True
                 self._reply(400, {"error": "oversized body", "rid": rid})
                 return
-            if length > 0:
-                self.rfile.read(length)
-            swapped = self.engine.try_reload()
+            body = self.rfile.read(length) if length > 0 else b""
+            engine = self.engine
+            if self.router is not None and body:
+                # model-aware reload: {"model": <name>} picks the
+                # tenant whose engine should attempt the swap
+                try:
+                    req = json.loads(body.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    req = {}
+                if isinstance(req, dict) and req.get("model"):
+                    from .router import UnknownModelError
+
+                    try:
+                        _n, engine, _fb = self.router.resolve(
+                            req["model"])
+                    except UnknownModelError as e:
+                        self._reply(404, {"error": str(e),
+                                          "reason": e.reason,
+                                          "models": e.known,
+                                          "rid": rid})
+                        return
+            swapped = engine.try_reload()
             self._reply(200, {
-                "ok": self.engine.stats.last_reload_ok is not False,
+                "ok": engine.stats.last_reload_ok is not False,
                 "swapped": bool(swapped),
-                "round": self.engine.round,
-                "breaker": self.engine.reload_breaker.state,
+                "round": engine.round,
+                "breaker": engine.reload_breaker.state,
                 "rid": rid,
             })
             return
@@ -245,31 +275,42 @@ class _Handler(BaseHTTPRequestHandler):
         obj = self._read_json(rid)
         if obj is None:
             return
+        engine, feedback = self.engine, self.feedback
+        if self.router is not None:
+            from .router import UnknownModelError
+
+            try:
+                _name, engine, feedback = self.router.resolve(
+                    obj.get("model"))
+            except UnknownModelError as e:
+                self._reply(404, {"error": str(e), "reason": e.reason,
+                                  "models": e.known, "rid": rid})
+                return
         deadline = obj.get("deadline_ms")
         try:
             if self.path == "/feedback":
-                self._do_feedback(obj, rid)
+                self._do_feedback(obj, rid, feedback)
             elif self.path == "/extract":
                 node = obj.get("node")
                 if not node:
                     self._reply(400, {"error": "extract needs a node name",
                                       "rid": rid})
                     return
-                out = self.engine.extract(obj["data"], node,
-                                          deadline_ms=deadline)
+                out = engine.extract(obj["data"], node,
+                                     deadline_ms=deadline)
                 self._reply(200, {"features": out.tolist(), "rid": rid})
             else:
                 kind = "scores" if obj.get("raw") else "predict"
-                out = self.engine.submit(obj["data"], kind=kind,
-                                         deadline_ms=deadline)
+                out = engine.submit(obj["data"], kind=kind,
+                                    deadline_ms=deadline)
                 key = "scores" if kind == "scores" else "pred"
                 self._reply(200, {key: np.asarray(out).tolist(),
                                   "rid": rid})
                 # capture AFTER the reply: a page commit's fsyncs must
                 # never sit inside the client's request latency
-                if (self.capture_predict and self.feedback is not None
+                if (self.capture_predict and feedback is not None
                         and kind == "predict"):
-                    self._capture(obj["data"], out)
+                    self._capture(obj["data"], out, feedback)
         except ServeError as e:
             self._reply(e.http_status, {"error": str(e), "rid": rid})
         except (ValueError, TypeError) as e:
@@ -296,22 +337,23 @@ class _Handler(BaseHTTPRequestHandler):
                 f"{label.shape[0]} labels")
         return data, label
 
-    def _do_feedback(self, obj: dict, rid: str) -> None:
-        if self.feedback is None:
+    def _do_feedback(self, obj: dict, rid: str, feedback) -> None:
+        if feedback is None:
             self._reply(404, {
-                "error": "no feedback log armed (run task=serve_train)",
+                "error": "no feedback log armed (run task=serve_train "
+                         "or task=loop_fleet)",
                 "rid": rid,
             })
             return
         data, label = self._feedback_arrays(obj)
-        n, first, last = self.feedback.append_batch_ids(data, label)
+        n, first, last = feedback.append_batch_ids(data, label)
         self._reply(200, {"appended": n,
                           "dropped": data.shape[0] - n,
                           "seq": ([first, last] if first is not None
                                   else None),
                           "rid": rid})
 
-    def _capture(self, data, preds) -> None:
+    def _capture(self, data, preds, feedback) -> None:
         """Opt-in /predict capture: inputs + model predictions into the
         feedback log.  Never fails the request — the log's degrade
         discipline applies to capture too."""
@@ -319,7 +361,7 @@ class _Handler(BaseHTTPRequestHandler):
             arr = np.ascontiguousarray(data, np.float32)
             if arr.ndim == 1:
                 arr = arr[None, :]
-            self.feedback.append_batch(
+            feedback.append_batch(
                 arr, np.asarray(preds, np.float32).reshape(arr.shape[0], -1))
         except Exception as e:  # noqa: BLE001 - capture is best-effort
             from ..obs import log_exception_once
@@ -335,18 +377,23 @@ def make_server(
     verbose: bool = False,
     feedback=None,
     capture_predict: bool = False,
+    router=None,
 ) -> ThreadingHTTPServer:
     """Bind (but do not run) the HTTP server; ``port=0`` picks an
     ephemeral port — read it back from ``server.server_port``.  The
     in-flight gauge hangs off the server as ``httpd.inflight``.
     ``feedback`` (a :class:`~cxxnet_tpu.loop.feedback_log.
     FeedbackWriter`) arms the ``/feedback`` route; ``capture_predict``
-    additionally logs every successful ``/predict``."""
+    additionally logs every successful ``/predict``.  ``router`` (a
+    :class:`~cxxnet_tpu.serve.router.ModelRouter`) arms per-model
+    dispatch: the request's ``model`` field picks the engine + feedback
+    log, ``engine`` remains the identity/default route."""
     gauge = _InflightGauge()
     handler = type(
         "BoundHandler", (_Handler,),
         {"engine": engine, "verbose": verbose, "inflight": gauge,
          "feedback": feedback, "capture_predict": capture_predict,
+         "router": router,
          "rid_token": os.urandom(3).hex(),
          "rid_counter": itertools.count(1)},
     )
@@ -366,6 +413,7 @@ def serve_forever(
     ready_fn=None,
     feedback=None,
     capture_predict: bool = False,
+    router=None,
 ) -> Tuple[ThreadingHTTPServer, Optional[threading.Thread]]:
     """Run the server until ``httpd.shutdown()`` (blocking).
 
@@ -381,13 +429,20 @@ def serve_forever(
     caller then closes the engine, which 503s anything still queued)."""
     httpd = make_server(engine, host, port, verbose=verbose,
                         feedback=feedback,
-                        capture_predict=capture_predict)
+                        capture_predict=capture_predict,
+                        router=router)
     stop = threading.Event()
     reloader = None
-    if reload_period_s > 0 and engine.model_dir is not None:
+    # the poll covers every routed engine (multi-tenant servers reload
+    # each tenant's model_dir), falling back to the identity engine
+    poll_engines = [e for e in (router.engines() if router is not None
+                                else [engine])
+                    if e.model_dir is not None]
+    if reload_period_s > 0 and poll_engines:
         def _poll():
             while not stop.wait(reload_period_s):
-                engine.try_reload()  # breaker-gated; never raises
+                for e in poll_engines:
+                    e.try_reload()  # breaker-gated; never raises
 
         reloader = threading.Thread(
             target=_poll, name="cxxnet-serve-reload", daemon=True

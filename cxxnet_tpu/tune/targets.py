@@ -15,7 +15,8 @@ from typing import List, Optional
 
 from .controller import Knob
 
-__all__ = ["find_pipeline", "pipeline_knobs", "batcher_knobs"]
+__all__ = ["find_pipeline", "pipeline_knobs", "batcher_knobs",
+           "tenant_round_knobs"]
 
 
 def find_pipeline(it):
@@ -68,4 +69,20 @@ def batcher_knobs(engine) -> List[Knob]:
              getter=lambda: engine.batcher.batch_timeout * 1e3,
              setter=engine.set_batch_timeout_ms,
              lo=0.25, hi=50.0, integer=False),
+    ]
+
+
+def tenant_round_knobs(loops, max_rounds: int = 8) -> List[Knob]:
+    """One knob per tenant loop: its fine-tune ``rounds_per_cycle``
+    (live setter — the next cycle reads the new value).  These are the
+    units the multi-tenant arbiter trades against the shared device
+    pool (``loop/tenant.py``): more rounds for a tenant whose extra
+    passes keep turning into published improvements, fewer for one
+    whose feedback has gone stale."""
+    return [
+        Knob(f"tenant_rounds:{loop.name or i}",
+             getter=(lambda lp=loop: lp.rounds_per_cycle),
+             setter=(lambda v, lp=loop: lp.set_rounds_per_cycle(v)),
+             lo=1, hi=max(2, int(max_rounds)))
+        for i, loop in enumerate(loops)
     ]

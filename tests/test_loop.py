@@ -636,3 +636,627 @@ def test_acknowledged_seq_ids_never_reused_after_crash(tmp_path):
     _, first3, _ = w3.append_batch_ids(x, y)
     assert first3 == first2 + 3
     w3.close()
+
+
+# ----------------------------------------------------------------------
+# multi-tenant config grammar ([tenant:<name>] sections)
+def test_split_tenant_sections_basic_and_errors():
+    base = [("eta", "0.1"), ("batch_size", "8")]
+    cfg = base + [
+        ("tenant", "alpha"), ("model_dir", "ma"), ("eta", "0.2"),
+        ("tenant", "end"),
+        ("tenant", "beta"), ("model_dir", "mb"), ("tenant", "end"),
+        ("seed", "1"),
+    ]
+    rest, tenants = cfgmod.split_tenant_sections(cfg)
+    assert rest == base + [("seed", "1")]
+    assert [t.name for t in tenants] == ["alpha", "beta"]
+    assert tenants[0].entries == [("model_dir", "ma"), ("eta", "0.2")]
+    # the effective per-tenant stream resolves by last-entry-wins
+    eff = rest + tenants[0].entries
+    assert cfgmod.cfg_get(eff, "eta") == "0.2"
+    assert cfgmod.cfg_get(rest + tenants[1].entries, "eta") == "0.1"
+    with pytest.raises(cfgmod.ConfigError):  # unclosed section
+        cfgmod.split_tenant_sections([("tenant", "a"), ("x", "1")])
+    with pytest.raises(cfgmod.ConfigError):  # end without open
+        cfgmod.split_tenant_sections([("tenant", "end")])
+    with pytest.raises(cfgmod.ConfigError):  # nested open
+        cfgmod.split_tenant_sections(
+            [("tenant", "a"), ("tenant", "b")])
+    with pytest.raises(cfgmod.ConfigError):  # duplicate name
+        cfgmod.split_tenant_sections(
+            [("tenant", "a"), ("tenant", "end"),
+             ("tenant", "a"), ("tenant", "end")])
+    with pytest.raises(cfgmod.ConfigError):  # section opener inside
+        cfgmod.split_tenant_sections(
+            [("tenant", "a"), ("data", "start"), ("tenant", "end")])
+
+
+def test_cli_set_param_passes_tenant_sections_through():
+    """A tenant's model_dir must never clobber the driver's globals —
+    the CLI defers everything inside tenant blocks to loop/tenant.py."""
+    from cxxnet_tpu.cli import LearnTask
+
+    t = LearnTask()
+    t.set_param("model_dir", "driver_models")
+    t.set_param("tenant", "a")
+    t.set_param("model_dir", "tenant_models")
+    t.set_param("task", "loop_fleet")  # inside section: NOT the driver's
+    t.set_param("tenant", "end")
+    t.set_param("seed", "3")
+    assert t.name_model_dir == "driver_models"
+    assert t.task != "loop_fleet"
+    _, tenants = cfgmod.split_tenant_sections(t.cfg)
+    assert tenants and tenants[0].entries[0] == ("model_dir",
+                                                "tenant_models")
+
+
+# ----------------------------------------------------------------------
+# per-slice cohort gate
+def test_accumulate_cohort_counts_and_accuracy():
+    from cxxnet_tpu.loop.publisher import (accumulate_cohort_counts,
+                                           cohort_accuracy)
+
+    counts = {}
+    preds = np.array([0, 1, 1, 0], np.float32)
+    labels = np.array([[0, 7], [1, 7], [0, 9], [0, 9]], np.float32)
+    accumulate_cohort_counts(counts, preds, labels, source_field=1)
+    assert counts["class:0"] == [2, 3]  # rows 0,2,3: hits 0 and 3
+    assert counts["class:1"] == [1, 1]
+    assert counts["source:7"] == [2, 2]
+    assert counts["source:9"] == [1, 2]
+    acc = cohort_accuracy(counts, min_count=2)
+    assert acc["class:0"] == pytest.approx(2 / 3)
+    assert "class:1" not in acc  # below min_count: dropped
+    assert acc["source:9"] == pytest.approx(0.5)
+
+
+def test_slice_gate_rejects_naming_worst_cohort(tmp_path):
+    """A candidate that improves the aggregate but sacrifices one
+    cohort beyond publish_slice_floor is rejected, and the reject
+    event names the cohort and carries the cycle's lineage."""
+    cfg, mdir, tr = make_trained_checkpoint(tmp_path)
+    eng = serve.Engine(cfg=cfg, model_dir=mdir, max_batch_size=32)
+    try:
+        pub = EvalGatedPublisher(eng, synth_iter(), slice_floor=0.05,
+                                 slice_min_count=1)
+        pub.evaluate = lambda trainer: ("eval-error", 0.30)
+        pub.evaluate_cohorts = lambda trainer: {
+            "class:0": 0.9, "class:1": 0.8, "class:2": 0.7}
+        pub.record_serving_baseline(tr)
+        assert pub.serving_cohorts == {
+            "class:0": 0.9, "class:1": 0.8, "class:2": 0.7}
+        # candidate: aggregate improves, class:1 collapses, class:2
+        # dips within the floor
+        pub.evaluate = lambda trainer: ("eval-error", 0.10)
+        pub.evaluate_cohorts = lambda trainer: {
+            "class:0": 0.95, "class:1": 0.60, "class:2": 0.66}
+        lin = {"first_seq": 10, "last_seq": 42, "records": 33,
+               "cycles": 1}
+        assert pub.consider(tr, cycle=7, lineage=lin) is False
+        from cxxnet_tpu.obs import recent
+
+        ev = [e for e in recent(10) if e["kind"] == "loop.reject"][-1]
+        assert ev["cohort"] == "class:1"
+        assert "class:1" in ev["reason"]
+        assert "publish_slice_floor" in ev["reason"]
+        assert ev["lineage"] == lin  # regression attributable to seqs
+        assert eng.round == 1  # nothing published
+        # same aggregate, cohorts all within the floor -> publishes
+        pub.evaluate_cohorts = lambda trainer: {
+            "class:0": 0.95, "class:1": 0.79, "class:2": 0.70}
+        assert pub.consider(tr, cycle=8, lineage=lin) is True
+        assert eng.round == 2
+        # published cohort vector becomes the next bar, and persists
+        ptr = ckpt.read_publish_pointer(mdir)
+        assert ptr["metric"]["cohorts"]["class:1"] == pytest.approx(0.79)
+        assert pub.serving_cohorts["class:1"] == pytest.approx(0.79)
+    finally:
+        eng.close()
+
+
+def test_cohort_too_small_in_candidate_is_not_gated(tmp_path):
+    """A cohort that shrank below slice_min_count in the candidate eval
+    cannot be compared -- the gate skips it instead of inventing a
+    regression."""
+    cfg, mdir, tr = make_trained_checkpoint(tmp_path)
+    eng = serve.Engine(cfg=cfg, model_dir=mdir, max_batch_size=32)
+    try:
+        pub = EvalGatedPublisher(eng, synth_iter(), slice_floor=0.01,
+                                 slice_min_count=1)
+        pub.evaluate = lambda trainer: ("eval-error", 0.30)
+        pub.evaluate_cohorts = lambda trainer: {"class:0": 0.9,
+                                                "class:1": 0.8}
+        pub.record_serving_baseline(tr)
+        pub.evaluate = lambda trainer: ("eval-error", 0.10)
+        pub.evaluate_cohorts = lambda trainer: {"class:0": 0.95}
+        assert pub.consider(tr) is True  # class:1 absent: not gated
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# baseline persistence (no re-baselining on restart)
+def test_serving_baseline_recorded_not_reevaluated_on_restart(tmp_path):
+    cfg, mdir, tr = make_trained_checkpoint(tmp_path)
+    eng = serve.Engine(cfg=cfg, model_dir=mdir, max_batch_size=32)
+    try:
+        pub = EvalGatedPublisher(eng, synth_iter())
+        bar = pub.record_serving_baseline(tr)
+        ptr = ckpt.read_publish_pointer(mdir)
+        assert ptr["round"] == 1  # first boot persisted the bar
+        assert ptr["metric"]["value"] == pytest.approx(bar)
+        # a restarted publisher reads the recorded bar back: with no
+        # publish_metric configured, one eval validates the metric NAME
+        # but the VALUE bar must stay recorded (re-baselining reset the
+        # bar every bounce)
+        pub2 = EvalGatedPublisher(eng, synth_iter())
+        pub2.evaluate = lambda trainer: (ptr["metric"]["name"], 0.99)
+        assert pub2.record_serving_baseline(tr) == pytest.approx(bar)
+        assert pub2.serving_metric_name == ptr["metric"]["name"]
+        from cxxnet_tpu.obs import recent
+
+        ev = [e for e in recent(5) if e["kind"] == "loop.baseline"][-1]
+        assert ev["source"] == "recorded"
+        # with publish_metric pinned, the substring check suffices and
+        # NO eval runs at all on restart
+        pub3 = EvalGatedPublisher(eng, synth_iter(),
+                                  metric_name="error")
+
+        def boom(trainer):
+            raise AssertionError("pinned metric must not re-evaluate")
+
+        pub3.evaluate = boom
+        assert pub3.record_serving_baseline(tr) == pytest.approx(bar)
+        # the eval conf changed between restarts (metric renamed): the
+        # recorded bar is for a DIFFERENT metric -> fresh re-baseline,
+        # never a cross-metric comparison
+        pub4 = EvalGatedPublisher(eng, synth_iter())
+        pub4.evaluate = lambda trainer: ("eval-rec@1", 0.7)
+        assert pub4.record_serving_baseline(tr) == pytest.approx(0.7)
+        assert pub4.serving_metric_name == "eval-rec@1"
+        ev = [e for e in recent(5) if e["kind"] == "loop.baseline"][-1]
+        assert ev["source"] == "evaluated"
+    finally:
+        eng.close()
+
+
+def test_slice_baseline_vector_persists_across_restart(tmp_path):
+    """The cohort vector gates against the RECORDED serving bar after a
+    restart; a pre-slice-gating pointer is grown the vector once."""
+    cfg, mdir, tr = make_trained_checkpoint(tmp_path)
+    eng = serve.Engine(cfg=cfg, model_dir=mdir, max_batch_size=32)
+    try:
+        # legacy pointer: recorded metric but no cohort vector
+        ckpt.write_publish_pointer(
+            mdir, 1, eng.model_path, net_fp=tr.net_fp(),
+            metric={"name": "eval-error", "value": 0.25})
+        pub = EvalGatedPublisher(eng, synth_iter(), slice_floor=0.05,
+                                 slice_min_count=1)
+        pub.evaluate_cohorts = lambda trainer: {"class:0": 0.75}
+        assert pub.record_serving_baseline(tr) == pytest.approx(0.25)
+        ptr = ckpt.read_publish_pointer(mdir)
+        assert ptr["metric"]["cohorts"] == {"class:0": 0.75}
+        # restart: vector comes back recorded, no cohort re-eval (the
+        # scalar eval still runs once to validate the metric name)
+        pub2 = EvalGatedPublisher(eng, synth_iter(), slice_floor=0.05,
+                                  slice_min_count=1)
+
+        def boom(trainer):
+            raise AssertionError("recorded vector must be read back")
+
+        pub2.evaluate = lambda trainer: ("eval-error", 0.5)
+        pub2.evaluate_cohorts = boom
+        assert pub2.record_serving_baseline(tr) == pytest.approx(0.25)
+        assert pub2.serving_cohorts == {"class:0": 0.75}
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# retention: compaction of consumed shards (loop/retention.py)
+def _rotated_log(tmp_path, n=60):
+    """A feedback log forced into many small shards, fully committed."""
+    d = str(tmp_path / "log")
+    w = FeedbackWriter(d, page_bytes=256, rotate_bytes=512)
+    X = np.random.RandomState(0).randn(n, 16).astype(np.float32)
+    w.append_batch_ids(X, np.arange(n, dtype=np.float32)[:, None])
+    w.flush()
+    return d, w
+
+
+def test_retention_compacts_consumed_shards(tmp_path):
+    from cxxnet_tpu.loop.feedback_log import read_retention
+    from cxxnet_tpu.loop.retention import RetentionOptions, Sweeper
+
+    d, w = _rotated_log(tmp_path)
+    shards0 = list_shards(d)
+    assert len(shards0) > 3, "rotation never happened"
+    bytes0 = sum(os.path.getsize(p) for _, p in shards0)
+    recs, cur = FeedbackReader(d).read_since(None)
+    assert len(recs) == 60
+    sw = Sweeper(d, RetentionOptions(0, 0), tenant="t0")
+    out = sw.sweep(cur)
+    assert out["deleted_shards"] >= 3
+    assert out["compacted_below"] == cur["shard"]
+    assert read_retention(d)["compacted_below"] == cur["shard"]
+    left = list_shards(d)
+    assert all(idx >= cur["shard"] for idx, _ in left)
+    assert out["disk_bytes"] < bytes0
+    # the consumed-up-to cursor still works; new appends still commit
+    # and read back CRC-verified
+    r = FeedbackReader(d)
+    assert r.pending(cur) == 0
+    w.append_batch(np.ones((4, 16), np.float32),
+                   np.zeros((4, 1), np.float32))
+    w.flush()
+    recs2, _ = r.read_since(cur)
+    assert len(recs2) == 4
+    assert _counter_value("loop_compactions_total", tenant="t0") >= 1
+    w.close()
+
+
+def test_retention_never_deletes_pending_lineage_or_unconsumed(tmp_path):
+    from cxxnet_tpu.loop.retention import (RetentionOptions, Sweeper,
+                                           safe_boundary)
+
+    d, w = _rotated_log(tmp_path)
+    nshards = len(list_shards(d))
+    _, cur = FeedbackReader(d).read_since(None)
+    sw = Sweeper(d, RetentionOptions(0, 0))
+    # an in-flight cycle is still training on seq 0 (shard 0): even a
+    # fully-advanced cursor must not free its shard
+    assert safe_boundary(d, cur, pending_first_seq=0) == 0
+    out = sw.sweep(cur, pending_first_seq=0)
+    assert out["deleted_shards"] == 0
+    assert len(list_shards(d)) == nshards
+    # a cursor that consumed nothing frees nothing (the live tail and
+    # every unconsumed shard are above it)
+    out = sw.sweep({"shard": 0, "off": 0})
+    assert out["deleted_shards"] == 0
+    # a pending id that cannot be located freezes the boundary at 0
+    assert safe_boundary(d, cur, pending_first_seq=10 ** 9) == 0
+    w.close()
+
+
+def test_retention_retain_shards_and_bytes_bounds(tmp_path):
+    from cxxnet_tpu.loop.retention import RetentionOptions, Sweeper
+
+    d, w = _rotated_log(tmp_path)
+    _, cur = FeedbackReader(d).read_since(None)
+    consumed = [idx for idx, _ in list_shards(d) if idx < cur["shard"]]
+    # retain_bytes larger than the log: nothing deleted even though
+    # every candidate is consumed
+    out = Sweeper(d, RetentionOptions(0, 1 << 30)).sweep(cur)
+    assert out["deleted_shards"] == 0
+    # keep the newest 2 consumed shards as the operator re-read hedge
+    out = Sweeper(d, RetentionOptions(2, 0)).sweep(cur)
+    assert out["deleted_shards"] == len(consumed) - 2
+    kept = [idx for idx, _ in list_shards(d)]
+    assert consumed[-2] in kept and consumed[-1] in kept
+    w.close()
+
+
+def test_stale_cursor_into_compacted_shard_fails_loud(tmp_path):
+    from cxxnet_tpu.loop import StaleCursorError
+    from cxxnet_tpu.loop.retention import RetentionOptions, Sweeper
+
+    d, w = _rotated_log(tmp_path)
+    _, cur = FeedbackReader(d).read_since(None)
+    Sweeper(d, RetentionOptions(0, 0)).sweep(cur)
+    r = FeedbackReader(d)
+    stale = {"shard": 0, "off": 0}
+    with pytest.raises(StaleCursorError) as e:
+        r.read_since(stale)
+    assert e.value.compacted_below == cur["shard"]
+    assert e.value.cursor == stale
+    with pytest.raises(StaleCursorError):
+        r.pending(stale)
+    w.close()
+
+
+def test_retention_crash_between_pointer_and_unlink_is_safe(tmp_path):
+    """kill -9 after the boundary fsync but before the unlinks: the
+    orphans below the boundary are invisible to readers, every record
+    above it stays CRC-readable, and the next sweep deletes them."""
+    import json as _json
+
+    from cxxnet_tpu.loop.feedback_log import RETENTION_FILE
+    from cxxnet_tpu.loop.retention import RetentionOptions, Sweeper
+
+    d, w = _rotated_log(tmp_path)
+    _, cur = FeedbackReader(d).read_since(None)
+    boundary = cur["shard"]
+    assert boundary >= 2
+    # the crash: pointer durable, files still on disk
+    with open(os.path.join(d, RETENTION_FILE), "w") as f:
+        _json.dump({"compacted_below": boundary}, f)
+    n_files = len(list_shards(d))
+    # records above the boundary read back CRC-verified from the
+    # consumed cursor; the orphans are protocol-deleted (ignored)
+    r = FeedbackReader(d)
+    assert r.pending(cur) == 0
+    w.append_batch(np.ones((4, 16), np.float32),
+                   np.zeros((4, 1), np.float32))
+    w.flush()
+    recs, _ = r.read_since(cur)
+    assert len(recs) == 4
+    # the next sweep deletes the orphans without moving the boundary
+    out = Sweeper(d, RetentionOptions(0, 0)).sweep(cur)
+    assert out["compacted_below"] == boundary
+    assert out["deleted_shards"] == n_files - len(list_shards(d))
+    assert all(idx >= boundary for idx, _ in list_shards(d))
+    w.close()
+
+
+def test_writer_never_resumes_below_retention_boundary(tmp_path):
+    """Every shard compacted away + writer restart: reusing index 0
+    would put new records BEHIND the boundary where readers must
+    ignore them."""
+    import json as _json
+
+    from cxxnet_tpu.loop.feedback_log import RETENTION_FILE
+
+    d = str(tmp_path / "log")
+    os.makedirs(d)
+    with open(os.path.join(d, RETENTION_FILE), "w") as f:
+        _json.dump({"compacted_below": 5}, f)
+    w = FeedbackWriter(d)
+    w.append_batch(np.ones((2, 16), np.float32),
+                   np.zeros((2, 1), np.float32))
+    w.flush()
+    (idx, _), = list_shards(d)
+    assert idx == 5
+    recs, _ = FeedbackReader(d).read_since({"shard": 5, "off": 0})
+    assert len(recs) == 2
+    w.close()
+
+
+def test_loop_cycle_sweeps_retention_end_to_end(tmp_path):
+    """The closed loop with retention armed: a published cycle's sweep
+    reclaims the consumed shards and the disk gauge drops."""
+    from cxxnet_tpu.loop.retention import RetentionOptions, Sweeper
+
+    cfg, mdir, _ = make_trained_checkpoint(tmp_path)
+    eng = serve.Engine(cfg=cfg, model_dir=mdir, max_batch_size=32)
+    try:
+        fdir = str(tmp_path / "feedback")
+        w = FeedbackWriter(fdir, page_bytes=2048, rotate_bytes=4096)
+        X, Y = synth_rows(synth_iter())
+        w.append_batch(X, Y)
+        w.flush()
+        shards_before = len(list_shards(fdir))
+        assert shards_before > 1
+        loop = ContinuousLoop(
+            eng, cfg, feedback_dir=fdir, base_iter=synth_iter(),
+            eval_iter=synth_iter(), rounds_per_cycle=2, min_records=64,
+            feedback_writer=w,
+            retention=Sweeper(fdir, RetentionOptions(0, 0),
+                              tenant="e2e"),
+            silent=True,
+        )
+        bytes_before = sum(os.path.getsize(p)
+                           for _, p in list_shards(fdir))
+        assert loop.run_cycle() == "published"
+        assert len(list_shards(fdir)) < shards_before
+        after = _counter_value("feedback_disk_bytes", tenant="e2e")
+        assert 0 < after < bytes_before
+        # cursor and reader agree after compaction: next cycle is idle
+        assert loop.run_cycle() == "idle"
+        w.close()
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# per-model routing (serve/router.py ModelRouter + HTTP front-end)
+def test_model_router_resolve_default_and_unknown():
+    from cxxnet_tpu.serve.router import ModelRouter, UnknownModelError
+
+    ea, eb = object(), object()
+    r = ModelRouter()
+    r.add("a", ea).add("b", eb, feedback="fb")
+    assert r.resolve(None) == ("a", ea, None)  # first added = default
+    assert r.resolve("") == ("a", ea, None)
+    assert r.resolve("b") == ("b", eb, "fb")
+    assert r.models() == ["a", "b"]
+    with pytest.raises(UnknownModelError) as e:
+        r.resolve("nope")
+    assert e.value.reason == "unknown_model"
+    assert e.value.known == ["a", "b"]
+    assert "nope" in str(e.value)
+    with pytest.raises(ValueError):
+        r.add("a", ea)  # duplicate
+    with pytest.raises(ValueError):
+        r.add("", ea)
+    r2 = ModelRouter()
+    r2.add("x", ea).add("y", eb, default=True)
+    assert r2.resolve(None)[0] == "y"  # explicit default wins
+
+
+def test_http_per_model_routing(tmp_path):
+    """The request's model field selects the tenant's engine + feedback
+    log; unknown model is a 404 with the machine-readable reason."""
+    from cxxnet_tpu.serve.router import ModelRouter
+
+    cfg, mdir_a, _ = make_trained_checkpoint(tmp_path / "a")
+    _, mdir_b, _ = make_trained_checkpoint(tmp_path / "b", rounds=2,
+                                           seed=1)
+    ea = serve.Engine(cfg=cfg, model_dir=mdir_a, max_batch_size=32,
+                      batch_timeout_ms=1)
+    eb = serve.Engine(cfg=cfg, model_dir=mdir_b, max_batch_size=32,
+                      batch_timeout_ms=1)
+    wa = FeedbackWriter(str(tmp_path / "fa"))
+    wb = FeedbackWriter(str(tmp_path / "fb"))
+    router = ModelRouter()
+    router.add("alpha", ea, feedback=wa)
+    router.add("beta", eb, feedback=wb)
+    httpd = serve.make_server(ea, port=0, feedback=wa, router=router)
+    port = httpd.server_port
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+    try:
+        # /healthz names every model with its identity + default flag
+        h = _get(port, "/healthz")
+        assert set(h["models"]) == {"alpha", "beta"}
+        assert h["models"]["alpha"]["default"] is True
+        assert h["models"]["alpha"]["model_crc32"] == ea.model_crc32
+        assert h["models"]["beta"]["model_crc32"] == eb.model_crc32
+        assert _get(port, "/statsz")["models"] == ["alpha", "beta"]
+        # /predict dispatches by model; both engines answer
+        assert len(_post(port, "/predict",
+                         {"data": x.tolist(), "model": "beta"})["pred"]) == 4
+        assert len(_post(port, "/predict",
+                         {"data": x.tolist()})["pred"]) == 4
+        # /feedback routes to the NAMED tenant's log
+        out = _post(port, "/feedback",
+                    {"data": x.tolist(), "label": [0, 1, 2, 3],
+                     "model": "beta"})
+        assert out["appended"] == 4
+        wb.flush()
+        wa.flush()
+        assert len(FeedbackReader(str(tmp_path / "fb"))
+                   .read_since(None)[0]) == 4
+        assert FeedbackReader(str(tmp_path / "fa")).read_since(
+            None)[0] == []  # alpha's log untouched
+        # model-less /feedback takes the default route (alpha)
+        _post(port, "/feedback", {"data": x.tolist(),
+                                  "label": [0, 1, 2, 3]})
+        wa.flush()
+        assert len(FeedbackReader(str(tmp_path / "fa"))
+                   .read_since(None)[0]) == 4
+        # unknown model: 404 with the machine-readable reason token
+        for path in ("/predict", "/feedback", "/extract"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(port, path, {"data": x.tolist(), "label": [0] * 4,
+                                   "node": "fc1", "model": "ghost"})
+            assert e.value.code == 404
+            body = json.loads(e.value.read())
+            assert body["reason"] == "unknown_model"
+            assert body["models"] == ["alpha", "beta"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        wa.close()
+        wb.close()
+        ea.close()
+        eb.close()
+
+
+# ----------------------------------------------------------------------
+# the tenant manager: N loops, one pool, SLO-constrained arbiter
+def _tenant_fixture(tmp_path, names=("alpha", "beta")):
+    from cxxnet_tpu.loop.tenant import TenantManager
+
+    shared = cfgmod.parse_pairs(MLP_CFG)
+    secs = []
+    for i, name in enumerate(names):
+        _, mdir, _ = make_trained_checkpoint(tmp_path / name, seed=i)
+        secs.append(cfgmod.TenantSection(name, [
+            ("model_dir", mdir),
+            ("feedback_dir", str(tmp_path / name / "feedback")),
+            ("feedback_page_bytes", "2048"),
+            ("feedback_rotate_bytes", "4096"),
+            ("feedback_retain_shards", "0"),
+        ]))
+    mgr = TenantManager(
+        shared, secs,
+        engine_factory=lambda cfg, mdir: serve.Engine(
+            cfg=cfg, model_dir=mdir, max_batch_size=32),
+        make_iters=lambda cfg: (synth_iter(), synth_iter(), "eval"),
+        loop_dir=str(tmp_path / "loop"),
+    )
+    return mgr
+
+
+def test_tenant_manager_two_tenants_share_one_pool(tmp_path):
+    """Two tenants tick round-robin on one device pool: the poisoned
+    tenant rejects (cohort-attributable in its own event stream), the
+    healthy one publishes, retention compacts behind both, and an SLO
+    alert sheds ALL tune cycles."""
+    mgr = _tenant_fixture(tmp_path)
+    try:
+        alpha, beta = mgr.tenants
+        assert mgr.tenant("beta") is beta
+        with pytest.raises(KeyError):
+            mgr.tenant("ghost")
+        # no feedback yet: both idle
+        assert mgr.tick_once() == {"alpha": "idle", "beta": "idle"}
+        X, Y = synth_rows(synth_iter())
+        alpha.feedback.append_batch(X, Y)  # correct labels
+        beta.feedback.append_batch(X[:200], (Y[:200] + 1.0) % 4)
+        shards_a = len(list_shards(alpha.feedback_dir))
+        out = mgr.tick_once()
+        assert out == {"alpha": "published", "beta": "rejected"}
+        # a publish feeds the arbiter's work objective
+        assert mgr.arbiter.work() >= 1.0
+        assert alpha.engine.round == 2 and beta.engine.round == 1
+        # retention ran behind the resolved cursors
+        assert len(list_shards(alpha.feedback_dir)) < shards_a
+        # per-tenant outcome counters
+        assert _counter_value("tenant_cycles_total", tenant="alpha",
+                              outcome="published") >= 1
+        assert _counter_value("tenant_cycles_total", tenant="beta",
+                              outcome="rejected") >= 1
+        # SLO overlay: a firing alert sheds EVERY tenant's tune cycle
+        mgr.arbiter.slo_firing = lambda: ["serve_p99_high"]
+        sheds0 = _counter_value("loop_shed_total")
+        assert mgr.tick_once() == {"alpha": "shed", "beta": "shed"}
+        assert _counter_value("loop_shed_total") == sheds0 + 1
+        assert mgr.arbiter.shedding
+        from cxxnet_tpu.obs import recent
+
+        assert any(e["kind"] == "tenant.shed" for e in recent(10))
+        # alert clears: training resumes
+        mgr.arbiter.slo_firing = lambda: []
+        out = mgr.tick_once()
+        assert set(out.values()) <= {"idle", "published", "rejected"}
+        assert not mgr.arbiter.shedding
+        # the HTTP router covers every tenant; healthz names them
+        r = mgr.router()
+        assert r.models() == ["alpha", "beta"]
+        assert r.resolve(None)[0] == "alpha"  # first tenant = default
+        hz = mgr.healthz_tenants()
+        assert hz["alpha"]["round"] == 2
+    finally:
+        mgr.close()
+
+
+def test_tenant_manager_isolation_and_knobs(tmp_path):
+    """One tenant's broken cycle must not starve its neighbor, and the
+    arbiter's per-tenant round knobs bind to the live loops."""
+    mgr = _tenant_fixture(tmp_path)
+    try:
+        alpha, beta = mgr.tenants
+        X, Y = synth_rows(synth_iter())
+        alpha.feedback.append_batch(X, Y)
+        beta.loop.run_cycle = None  # not callable -> TypeError inside
+        out = mgr.tick_once()
+        assert out["alpha"] == "published"
+        assert out["beta"] == "error"
+        # knobs: one per tenant, bound to rounds_per_cycle
+        knobs = mgr.arbiter.controller.knobs
+        assert sorted(k.name for k in knobs) == [
+            "tenant_rounds:alpha", "tenant_rounds:beta"]
+        k = next(k for k in knobs if k.name.endswith("alpha"))
+        k.apply(5)
+        assert alpha.loop.rounds_per_cycle == 5
+        assert k.read() == 5
+        k.apply(0)  # floor is 1
+        assert alpha.loop.rounds_per_cycle == 1
+    finally:
+        mgr.close()
+
+
+def test_tenant_manager_requires_model_dir_and_sections(tmp_path):
+    from cxxnet_tpu.loop.tenant import TenantManager
+
+    with pytest.raises(ValueError, match="at least one"):
+        TenantManager(cfgmod.parse_pairs(MLP_CFG), [],
+                      engine_factory=None, make_iters=None)
+    with pytest.raises(ValueError, match="model_dir"):
+        TenantManager(
+            cfgmod.parse_pairs(MLP_CFG),
+            [cfgmod.TenantSection("a", [])],
+            engine_factory=lambda cfg, mdir: None,
+            make_iters=lambda cfg: (None, synth_iter(), "eval"))

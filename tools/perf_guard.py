@@ -53,7 +53,8 @@ VERDICTS = ("baseline", "ok", "regression")
 #: mesh lane's compile counts — MORE compiles is the re-jit regression)
 _LOWER_MARKERS = ("latency", "_ms", "p50", "p95", "p99", "wall_sec",
                   "compiles", "programs", "rebuild_wall_s",
-                  "restart_wall_s", "shed_ratio", "final_err")
+                  "restart_wall_s", "shed_ratio", "final_err",
+                  "elapsed_s", "disk_bytes_final")
 
 
 def lower_is_better(name: str) -> bool:
@@ -230,13 +231,41 @@ def flatten_async_bench(doc: dict) -> Dict[str, float]:
     return out
 
 
+def flatten_tenant_bench(doc: dict) -> Dict[str, float]:
+    """The TENANT lane's series (``tools/tenant_smoke.py``): per-tenant
+    publish counts, the compaction yield (reclaimed shards/bytes — a
+    change that silently stops compacting collapses these to zero far
+    outside any band), the residual disk footprint after retention
+    (lower is better: a retention bug shows up as the log growing
+    again), the SLO overlay's engagement (alerts_fired/sheds must stay
+    0 under the lane's light load), the crash-window CRC bit, and the
+    end-to-end wall clock."""
+    out: Dict[str, float] = {}
+    for key in ("records", "compactions", "compacted_shards",
+                "compacted_bytes", "alerts_fired", "sheds",
+                "elapsed_s"):
+        v = doc.get(key)
+        if isinstance(v, (int, float)) and math.isfinite(v):
+            out[key] = float(v)
+    out["crc_ok_after_kill"] = (
+        1.0 if doc.get("crc_ok_after_kill") else 0.0)
+    for tname, n in (doc.get("published") or {}).items():
+        if isinstance(n, (int, float)) and math.isfinite(n):
+            out[f"published.{tname}"] = float(n)
+    for tname, v in (doc.get("disk_bytes_final") or {}).items():
+        if isinstance(v, (int, float)) and math.isfinite(v):
+            out[f"disk_bytes_final.{tname}"] = float(v)
+    return out
+
+
 FLATTENERS = {"io_bench": flatten_io_bench,
               "serve_bench": flatten_serve_bench,
               "mesh_parity": flatten_mesh_parity,
               "quant_bench": flatten_quant_bench,
               "elastic": flatten_elastic,
               "fleet_bench": flatten_fleet_bench,
-              "async_bench": flatten_async_bench}
+              "async_bench": flatten_async_bench,
+              "tenant_bench": flatten_tenant_bench}
 
 
 # ----------------------------------------------------------------------

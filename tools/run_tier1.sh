@@ -94,6 +94,21 @@
 #                                     # (async_bench flattener:
 #                                     # overlap_fraction higher-is-
 #                                     # better, step_wall lower)
+#        TENANT=1 tools/run_tier1.sh  # also run the multi-tenant loop
+#                                     # smoke: a REAL task=loop_fleet
+#                                     # process hosting 2 tenants on one
+#                                     # device pool — per-model HTTP
+#                                     # routing, a cohort-poisoned
+#                                     # candidate rejected by the
+#                                     # per-slice gate (cohort named,
+#                                     # lineage-attributable), BOTH
+#                                     # tenants publishing while the
+#                                     # serve p99 alert stays silent,
+#                                     # retention compacting consumed
+#                                     # shards (disk bytes drop), and a
+#                                     # kill -9 crash-window CRC check;
+#                                     # verdict JSON appends to a
+#                                     # perf_guard history (tenant_bench)
 #        OBS=1 tools/run_tier1.sh     # also run the observability smoke:
 #                                     # short telemetry=1 train + serve
 #                                     # scrape of /metricsz + /alertz
@@ -224,6 +239,19 @@ if [ "${ASYNC:-0}" = "1" ]; then
       --input "$async_out/async_ab.json" \
       --history "$async_out/bench_history.jsonl" > /dev/null || rc=1
   echo "ASYNC lane verdict: $async_out/async_ab.json"
+fi
+if [ "${TENANT:-0}" = "1" ]; then
+  echo "=== opt-in multi-tenant loop smoke (TENANT=1) ==="
+  tenant_out=/tmp/_tenant_smoke
+  rm -rf "$tenant_out"; mkdir -p "$tenant_out"
+  timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python tools/tenant_smoke.py --out "$tenant_out" \
+      > "$tenant_out/verdict.json" || rc=1
+  timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python tools/perf_guard.py --bench tenant_bench \
+      --input "$tenant_out/verdict.json" \
+      --history "$tenant_out/bench_history.jsonl" > /dev/null || rc=1
+  echo "TENANT lane verdict: $tenant_out/verdict.json"
 fi
 if [ "${OBS:-0}" = "1" ]; then
   echo "=== opt-in observability smoke (OBS=1) ==="
