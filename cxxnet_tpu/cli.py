@@ -1614,7 +1614,9 @@ class LearnTask:
         import re
 
         from .obs import device as obs_device
+        from .obs import events as obs_events
         from .obs import log_exception_once
+        from .utils import diskio
         from .utils.profiler import pipeline_stats
 
         metrics = {
@@ -1663,12 +1665,18 @@ class LearnTask:
                                else None),
             }
         try:
-            d = os.path.dirname(self.telemetry_path)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            with open(self.telemetry_path, "a", encoding="utf-8") as f:
-                f.write(json.dumps(record, separators=(",", ":")) + "\n")
+            line = json.dumps(record, separators=(",", ":")) + "\n"
+            diskio.append_bytes(self.telemetry_path,
+                                line.encode("utf-8"), site="obs.append")
         except (OSError, ValueError, TypeError) as e:
+            # degrade-don't-crash: a round record is droppable; training
+            # and serving keep going, the drop is counted and the first
+            # failure logged (disk-full additionally bumps
+            # disk_full_total inside diskio → the paging alert)
+            import errno as _errno
+            reason = ("disk" if getattr(e, "errno", None) == _errno.ENOSPC
+                      else "io")
+            obs_events.record_drop("telemetry", reason)
             log_exception_once("cli.telemetry", e, kind="telemetry.error",
                                path=self.telemetry_path)
 
@@ -2157,8 +2165,9 @@ class LearnTask:
         line = json.dumps(verdict, separators=(",", ":"))
         print(line, flush=True)
         if self.quant_report:
-            with open(self.quant_report, "w", encoding="utf-8") as f:
-                f.write(line + "\n")
+            from .utils.checkpoint import atomic_write_bytes
+            atomic_write_bytes(self.quant_report,
+                               (line + "\n").encode("utf-8"))
         return 0 if verdict["ok"] else 3
 
     def task_summary(self) -> None:

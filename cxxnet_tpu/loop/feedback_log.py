@@ -46,6 +46,7 @@ part round-trips through ``ImageBinIterator._decode_raw``.
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import re
@@ -59,7 +60,7 @@ import numpy as np
 from ..io.imgbin import PAGE_MAGIC, encode_raw
 from ..obs import events as obs_events
 from ..obs.registry import registry as obs_registry
-from ..utils import faults
+from ..utils import diskio, faults
 
 __all__ = [
     "FeedbackRecord",
@@ -232,29 +233,54 @@ def list_shards(dir_: str) -> List[Tuple[int, str]]:
     return sorted(out)
 
 
-def _read_commits(shard_path: str) -> List[Dict]:
-    """Committed-page entries of one shard.  A trailing torn line (a
-    crash mid-commit) is ignored — its page is simply uncommitted."""
+def _read_commits_full(shard_path: str) -> Tuple[List[Dict], int]:
+    """Committed-page entries of one shard, plus the sidecar byte length
+    they cover (the **clean length**).
+
+    A commit entry counts only when its line is newline-TERMINATED and
+    parses with the full schema: the trailing newline is part of the
+    fsynced commit record, so a line missing it was torn mid-write and
+    never acknowledged — its page is simply uncommitted.  Parsing stops
+    at the first bad line (nothing after a tear is trustworthy); the
+    clean length is where a recovering writer must truncate before
+    appending, so a torn partial line can never fuse with the next
+    entry into one unparseable line that hides every commit after it
+    (the crash-audit ``torn-commit-sidecar-append`` regression).
+    """
     out: List[Dict] = []
+    clean_len = 0
     try:
-        with open(shard_path + COMMIT_SUFFIX, "r", encoding="utf-8") as f:
-            text = f.read()
+        with open(shard_path + COMMIT_SUFFIX, "rb") as f:
+            raw = f.read()
     except OSError:
-        return out
-    for line in text.split("\n"):
-        line = line.strip()
+        return out, 0
+    pos = 0
+    while pos < len(raw):
+        nl = raw.find(b"\n", pos)
+        if nl < 0:
+            break  # unterminated tail: torn mid-line
+        line = raw[pos:nl].strip()
+        pos = nl + 1
         if not line:
+            clean_len = pos
             continue
         try:
-            ent = json.loads(line)
-        except ValueError:
-            break  # torn tail: nothing after it is trustworthy
+            ent = json.loads(line.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            break
         if isinstance(ent, dict) and {"off", "bytes", "crc32",
                                       "nrec"} <= set(ent):
             out.append(ent)
+            clean_len = pos
         else:
             break
-    return out
+    return out, clean_len
+
+
+def _read_commits(shard_path: str) -> List[Dict]:
+    """Committed-page entries of one shard (see
+    :func:`_read_commits_full` for the torn-tail rules)."""
+    return _read_commits_full(shard_path)[0]
 
 
 class FeedbackWriter:
@@ -347,10 +373,22 @@ class FeedbackWriter:
     # ------------------------------------------------------------------
     def _open_shard(self, truncate_torn: bool = False) -> None:
         path = _shard_path(self.dir, self._shard_idx)
-        commits = _read_commits(path)
+        commits, clean_len = _read_commits_full(path)
         committed_end = (commits[-1]["off"] + commits[-1]["bytes"]
                          if commits else 0)
-        self._f = open(path, "ab")
+        if truncate_torn:
+            # a torn trailing sidecar line must go BEFORE we append the
+            # next entry: appending onto a half-written line would fuse
+            # them into one unparseable line, and since commit parsing
+            # stops at the first bad line, every commit after it would
+            # silently vanish (committed records lost)
+            cpath = path + COMMIT_SUFFIX
+            try:
+                if os.path.getsize(cpath) > clean_len:
+                    diskio.truncate(cpath, clean_len)
+            except OSError:
+                pass
+        self._f = diskio.open_append(path)
         if truncate_torn and self._f.tell() > committed_end:
             self._f.truncate(committed_end)
             self._f.seek(committed_end)
@@ -441,6 +479,9 @@ class FeedbackWriter:
         (the metrics/event sinks take their own locks)."""
         self.dropped += nrec
         self._m.dropped.inc(nrec)
+        if getattr(exc, "errno", None) == errno.ENOSPC:
+            # disk-full is its own paging alert, not just a drop stat
+            diskio.count_disk_full("loop.append", self.dir)
         obs_events.log_exception_once(
             "loop.append", exc, kind="loop.append_error", dropped=nrec)
 
@@ -457,10 +498,10 @@ class FeedbackWriter:
             page += b
         page = bytes(page)
         try:
-            self._f.write(page)
+            self._f.write(page, site="loop.commit")
             self._f.flush()
             if self.fsync:
-                os.fsync(self._f.fileno())
+                self._f.fsync()
             # seq0 is the page's lineage anchor: buffered records are
             # committed in append order, so the page covers exactly
             # [seq0, seq0 + nrec) — readers reconstruct per-record ids
@@ -469,11 +510,9 @@ class FeedbackWriter:
                    "nrec": len(blobs), "seq0": blobs[0][1]}
             cpath = (_shard_path(self.dir, self._shard_idx)
                      + COMMIT_SUFFIX)
-            with open(cpath, "a", encoding="utf-8") as cf:
-                cf.write(json.dumps(ent, separators=(",", ":")) + "\n")
-                cf.flush()
-                if self.fsync:
-                    os.fsync(cf.fileno())
+            line = json.dumps(ent, separators=(",", ":")) + "\n"
+            diskio.append_bytes(cpath, line.encode("utf-8"),
+                                fsync=self.fsync, site="loop.commit")
         except OSError as e:
             # degrade: the page (and its records) are lost, serving
             # is not.  Reopen at the committed tail so the next page

@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..obs import events as obs_events
 from ..obs.registry import registry as obs_registry
+from ..utils import diskio
 from ..utils.checkpoint import atomic_write_bytes
 from .feedback_log import (
     COMMIT_SUFFIX,
@@ -223,7 +224,7 @@ class Sweeper:
             for p in (path, path + COMMIT_SUFFIX):
                 try:
                     size += os.path.getsize(p)
-                    os.unlink(p)
+                    diskio.unlink(p)
                 except OSError:
                     pass  # already gone / transient: next sweep retries
             out["deleted_shards"] += 1

@@ -29,6 +29,7 @@ Behavior:
 from __future__ import annotations
 
 import collections
+import errno
 import json
 import os
 import threading
@@ -44,6 +45,7 @@ __all__ = [
     "recent",
     "configure",
     "log_exception_once",
+    "record_drop",
 ]
 
 ConfigEntry = Tuple[str, str]
@@ -72,14 +74,29 @@ class EventLog:
     """One rotating JSONL sink + in-memory ring (see module docstring)."""
 
     def __init__(self, ring: int = 512) -> None:
-        self._lock = threading.Lock()
+        # reentrant: a failing file write reports through the diskio
+        # layer, whose disk-full accounting emits right back here
+        self._lock = threading.RLock()
         self._ring: Deque[dict] = collections.deque(maxlen=max(1, int(ring)))
         self.path: Optional[str] = None
         self.max_bytes = 4 << 20
         self.backups = 2
         self.dropped = 0
+        #: bounded drop under a sick disk: after a write failure the
+        #: file sink is skipped (events counted, ring still recording)
+        #: for this long, instead of re-running makedirs + rotation +
+        #: open against a full disk on EVERY event
+        self.holdoff_s = 2.0
+        self._skip_until = 0.0
+        self._skip_reason = "io"
         self._once_counts: Dict[str, int] = {}
         self._counter = None  # obs_events_total, created lazily
+        self._drop_counter = None  # events_dropped_total, lazy
+        # file-sink re-entrancy guard: writing an event can itself emit
+        # (a fault firing at the obs.append site, disk-full accounting
+        # in diskio) — nested events go to the ring only, never back
+        # into the file write that is already on this thread's stack
+        self._tls = threading.local()
 
     # config -------------------------------------------------------------
     def set_param(self, name: str, val: str) -> None:
@@ -89,6 +106,8 @@ class EventLog:
             self.max_bytes = max(1024, int(val))
         elif name == "event_log_backups":
             self.backups = max(0, int(val))
+        elif name == "event_log_holdoff_s":
+            self.holdoff_s = max(0.0, float(val))
         elif name == "event_log_ring":
             with self._lock:
                 self._ring = collections.deque(
@@ -107,6 +126,9 @@ class EventLog:
         self.max_bytes = 4 << 20
         self.backups = 2
         self.dropped = 0
+        self.holdoff_s = 2.0
+        self._skip_until = 0.0
+        self._skip_reason = "io"
 
     # emission -----------------------------------------------------------
     def _count(self, kind: str) -> None:
@@ -121,6 +143,22 @@ class EventLog:
         except Exception:  # noqa: BLE001 - never raise from emit
             pass
 
+    def record_drop(self, sink: str, reason: str) -> None:
+        """Count one dropped observability record:
+        ``events_dropped_total{sink,reason}`` (``reason="disk"`` is the
+        full-disk degrade path the ISSUE-16 alert watches)."""
+        try:
+            if self._drop_counter is None:
+                self._drop_counter = _registry.registry().counter(
+                    "events_dropped_total",
+                    "Observability records dropped by the file sink "
+                    "(bounded degrade; the ring keeps recording).",
+                    labelnames=("sink", "reason"),
+                )
+            self._drop_counter.labels(sink=sink, reason=reason).inc()
+        except Exception:  # noqa: BLE001 - never raise from emit
+            pass
+
     def _rotate_locked(self, need: int) -> None:
         """Rotate ``path`` when appending ``need`` bytes would cross
         ``max_bytes``.  Caller holds the lock."""
@@ -130,19 +168,19 @@ class EventLog:
             return
         if size + need <= self.max_bytes:
             return
+        from ..utils import diskio
         if self.backups <= 0:
             # no backups: truncate in place
-            with open(self.path, "w", encoding="utf-8"):
-                pass
+            diskio.truncate(self.path, 0)
             return
         oldest = f"{self.path}.{self.backups}"
         if os.path.exists(oldest):
-            os.remove(oldest)
+            diskio.unlink(oldest)
         for i in range(self.backups - 1, 0, -1):
             src = f"{self.path}.{i}"
             if os.path.exists(src):
-                os.replace(src, f"{self.path}.{i + 1}")
-        os.replace(self.path, f"{self.path}.1")
+                diskio.replace(src, f"{self.path}.{i + 1}")
+        diskio.replace(self.path, f"{self.path}.1")
 
     def emit(self, kind: str, /, **fields) -> dict:
         """Record one event; returns the record.  Never raises.
@@ -165,16 +203,35 @@ class EventLog:
             line = json.dumps(rec, separators=(",", ":"))
         with self._lock:
             self._ring.append(rec)
-            if self.path:
-                try:
-                    d = os.path.dirname(self.path)
-                    if d:
-                        os.makedirs(d, exist_ok=True)
-                    self._rotate_locked(len(line) + 1)
-                    with open(self.path, "a", encoding="utf-8") as f:
-                        f.write(line + "\n")
-                except OSError:
+            if self.path and getattr(self._tls, "writing", False):
+                pass  # nested emit inside a file write: ring only
+            elif self.path:
+                if time.monotonic() < self._skip_until:
+                    # bounded drop: the file sink failed recently; skip
+                    # the I/O attempt entirely until the holdoff passes
                     self.dropped += 1
+                    self.record_drop("events", self._skip_reason)
+                else:
+                    from ..utils import diskio
+                    self._tls.writing = True
+                    try:
+                        d = os.path.dirname(self.path)
+                        if d:
+                            os.makedirs(d, exist_ok=True)
+                        self._rotate_locked(len(line) + 1)
+                        diskio.append_bytes(
+                            self.path, (line + "\n").encode("utf-8"),
+                            site="obs.append")
+                    except OSError as e:
+                        self.dropped += 1
+                        reason = ("disk" if getattr(e, "errno", None)
+                                  == errno.ENOSPC else "io")
+                        self._skip_reason = reason
+                        self._skip_until = (time.monotonic()
+                                            + self.holdoff_s)
+                        self.record_drop("events", reason)
+                    finally:
+                        self._tls.writing = False
         self._count(rec["kind"])
         return rec
 
@@ -242,3 +299,9 @@ def configure(cfg: Sequence[ConfigEntry]) -> None:
 def log_exception_once(key: str, exc: BaseException,
                        kind: str = "error", **fields) -> bool:
     return _LOG.log_exception_once(key, exc, kind, **fields)
+
+
+def record_drop(sink: str, reason: str) -> None:
+    """Count one dropped observability record (telemetry.jsonl uses
+    this; the event sink counts its own drops internally)."""
+    _LOG.record_drop(sink, reason)

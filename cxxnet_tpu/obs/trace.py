@@ -245,12 +245,13 @@ class Tracer:
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def export(self, path: str) -> str:
-        """Write the ring as Chrome trace JSON; returns the path."""
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        with open(path, "w", encoding="utf-8") as f:
-            json.dump(self.to_chrome_trace(), f)
+        """Write the ring as Chrome trace JSON; returns the path.
+        Atomic (via the shared diskio helper) so a crash mid-export
+        can't leave a half-written trace that chrome://tracing rejects."""
+        from ..utils import diskio
+        diskio.write_atomic(
+            path, json.dumps(self.to_chrome_trace()).encode("utf-8"),
+            site=None)
         return path
 
     # train-loop capture window ------------------------------------------

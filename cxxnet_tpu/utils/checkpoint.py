@@ -39,7 +39,7 @@ import time
 import zlib
 from typing import Callable, List, Optional, Sequence, Tuple
 
-from . import faults
+from . import diskio, faults
 
 # model container magic (shared with nnet.trainer, which re-exports it)
 MODEL_MAGIC = b"CXTPU001"
@@ -69,36 +69,15 @@ class DivergenceError(RuntimeError):
 # atomic I/O + retry
 def atomic_write_bytes(path: str, data: bytes, fsync: bool = True) -> None:
     """Write ``data`` to ``path`` atomically: temp file in the same
-    directory, flush+fsync, rename.  A crash at any point leaves either
-    the old file or the new one, never a truncation."""
-    faults.fault_point("checkpoint.write")
-    d = os.path.dirname(os.path.abspath(path))
-    os.makedirs(d, exist_ok=True)
-    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
-    try:
-        with open(tmp, "wb") as f:
-            f.write(data)
-            if fsync:
-                f.flush()
-                os.fsync(f.fileno())
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass
-    if fsync:
-        # durability of the rename itself (dir entry) — best effort;
-        # not all filesystems support fsync on a directory fd
-        try:
-            dfd = os.open(d, os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
-        except OSError:
-            pass
+    directory, flush+fsync, rename, dir fsync.  A crash at any point
+    leaves either the old file or the new one, never a truncation.
+
+    The implementation lives in :mod:`~cxxnet_tpu.utils.diskio` (the
+    shared, recorded, fault-injectable write path) — every durable
+    writer funnels through that one helper so the fsync contract cannot
+    fork, and ``tools/crash_audit.py`` can replay every crash point.
+    """
+    diskio.write_atomic(path, data, fsync=fsync, site="checkpoint.write")
 
 
 def retry_io(
@@ -375,7 +354,7 @@ def apply_retention(
     for _, path in list_checkpoints(model_dir)[:-keep_latest]:
         for p in (path, manifest_path(path)):
             try:
-                os.remove(p)
+                diskio.unlink(p)
             except OSError:
                 continue
         removed.append(path)

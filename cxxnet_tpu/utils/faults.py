@@ -51,6 +51,8 @@ __all__ = [
     "KINDS",
     "InjectedFault",
     "InjectedCorruption",
+    "InjectedDiskFull",
+    "InjectedShortWrite",
     "WatchdogError",
     "BadDataError",
     "FaultSpec",
@@ -80,14 +82,28 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "text.read": ("ioerror", "latency"),
     "prefetch.producer": ("latency", "hang"),
     "pipeline.worker": ("latency", "hang"),
-    "checkpoint.write": ("ioerror", "latency"),
+    # checkpoint atomic write (utils/diskio.py::write_atomic): enospc =
+    # disk full before any byte lands (abort atomically, prior round
+    # stays loadable), short = ENOSPC mid-write leaving a torn tmp file
+    # (same abort contract — the torn file never becomes the target)
+    "checkpoint.write": ("ioerror", "latency", "enospc", "short"),
     "checkpoint.read": ("ioerror", "latency"),
     "serve.reload": ("ioerror", "latency"),
     "serve.batch": ("ioerror", "latency", "hang"),
     # feedback-log append (loop/feedback_log.py): an ioerror here must
     # DEGRADE — the record is dropped and counted, the serving request
     # still succeeds (doc/continuous_training.md)
-    "loop.append": ("ioerror", "latency"),
+    "loop.append": ("ioerror", "latency", "enospc"),
+    # feedback-log page/sidecar commit (loop/feedback_log.py, routed
+    # through utils/diskio.py): enospc/short here hit the DURABLE write
+    # path — the writer must degrade (drop + count), truncate any torn
+    # tail on reopen, and keep every previously committed page readable
+    "loop.commit": ("ioerror", "enospc", "short"),
+    # observability appends (obs/events.py events.jsonl + cli.py
+    # telemetry.jsonl, routed through utils/diskio.py): both are lossy
+    # by contract — a full disk means bounded drop + counter, never a
+    # raise out of the never-raising wrapper and never a retry spin
+    "obs.append": ("ioerror", "enospc"),
     # replica loss (nnet/trainer.py::sync, the elastic pod's collective
     # fence): hang = a peer wedged in a collective (the deadline must
     # surface ReplicaLossError in bounded time), ioerror = the abrupt
@@ -107,12 +123,37 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "serve.replica": ("hang", "ioerror"),
 }
 
-KINDS = ("ioerror", "corrupt", "latency", "hang")
+KINDS = ("ioerror", "corrupt", "latency", "hang", "enospc", "short")
 
 
 class InjectedFault(OSError):
     """Injected transient I/O failure (an ``OSError``, so the retry
     machinery treats it exactly like a real filesystem flake)."""
+
+
+class InjectedDiskFull(InjectedFault):
+    """Injected ENOSPC: ``errno`` is set so callers that special-case
+    disk-full (degrade + ``disk_full_total``) classify it exactly like
+    the real thing."""
+
+    def __init__(self, site: str) -> None:
+        import errno as _errno
+        super().__init__(_errno.ENOSPC,
+                         f"injected ENOSPC (disk full) at {site}")
+
+
+class InjectedShortWrite(InjectedDiskFull):
+    """Injected short write: disk filled up MID-write.  ``keep`` bytes
+    of the payload made it to disk before the failure; the diskio layer
+    writes exactly that prefix (a real torn tail) and re-raises.  Sites
+    not routed through diskio just see the ENOSPC."""
+
+    def __init__(self, site: str, keep: int) -> None:
+        import errno as _errno
+        OSError.__init__(self, _errno.ENOSPC,
+                         f"injected short write at {site} "
+                         f"({keep} bytes landed)")
+        self.keep = keep
 
 
 class InjectedCorruption(ValueError):
@@ -315,6 +356,14 @@ class FaultInjector:
                     raise InjectedCorruption(
                         f"injected corruption at {site}"
                     )
+            elif fs.kind == "enospc":
+                raise InjectedDiskFull(site)
+            elif fs.kind == "short":
+                keep = 0
+                if isinstance(payload, (bytes, bytearray, memoryview)):
+                    n = len(payload)
+                    keep = max(1, n // 2) if n else 0
+                raise InjectedShortWrite(site, keep)
             else:  # ioerror
                 raise InjectedFault(f"injected I/O error at {site}")
         return payload

@@ -1,0 +1,305 @@
+"""Disk-I/O layer tests (cxxnet_tpu/utils/diskio.py).
+
+The recorder + ext4-reorder crash simulator that ``tools/crash_audit.py``
+replays, the ENOSPC acceptance contract (a disk-full checkpoint write
+aborts atomically and the prior round stays loadable; the
+``disk_full_total`` alert series fires), and the torn-commit-sidecar
+regression the audit pinned (a reopening ``FeedbackWriter`` must
+truncate a torn ``.commit`` line before appending, or every later
+commit becomes invisible).
+"""
+
+import errno
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.loop import feedback_log as fl
+from cxxnet_tpu.obs import alerts as obs_alerts
+from cxxnet_tpu.obs.registry import registry
+from cxxnet_tpu.utils import checkpoint as ck
+from cxxnet_tpu.utils import diskio, faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _blob(tag: int) -> bytes:
+    import struct
+
+    hdr = json.dumps({"round": tag}).encode()
+    return ck.MODEL_MAGIC + struct.pack("<I", len(hdr)) + hdr + b"p" * 64
+
+
+def _rec(val: float):
+    return np.full((1, 1, 4), np.float32(val))
+
+
+# ----------------------------------------------------------------------
+# recorder + simulator
+def test_atomic_write_never_tears_the_published_name(tmp_path):
+    """At EVERY crash point of an atomic replace, the published name
+    holds either the old bytes or the new bytes — in every variant."""
+    path = str(tmp_path / "models" / "0001.model")
+    diskio.write_atomic(path, b"OLD-CONTENT")
+    with diskio.recording(str(tmp_path)) as rec:
+        diskio.write_atomic(path, b"NEW-CONTENT")
+    ops = rec.ops
+    assert [op["op"] for op in ops if op["op"] == "rename"]
+    saw_old = saw_new = False
+    for k in range(len(ops) + 1):
+        for variant in diskio.VARIANTS:
+            for keep in ((None,) if variant != "torn" else (1, 5)):
+                tree = diskio.simulate_crash(ops, k, variant,
+                                             torn_keep=keep)
+                if tree is None:
+                    continue
+                got = tree.get("models/0001.model")
+                assert got in (b"OLD-CONTENT", b"NEW-CONTENT"), (
+                    k, variant, got)
+                saw_old |= got == b"OLD-CONTENT"
+                saw_new |= got == b"NEW-CONTENT"
+    assert saw_old and saw_new
+
+
+def test_sync_variant_drops_unsynced_appends(tmp_path):
+    """An append never fsynced is NOT durable (the file itself vanishes
+    when its creation was never made durable either); an fsynced append
+    survives every later crash point."""
+    path = str(tmp_path / "log.bin")
+    with diskio.recording(str(tmp_path)) as rec:
+        diskio.append_bytes(path, b"unsynced", fsync=False)
+    tree = diskio.simulate_crash(rec.ops, len(rec.ops), "sync")
+    assert "log.bin" not in tree
+    os.unlink(path)
+    with diskio.recording(str(tmp_path)) as rec:
+        diskio.append_bytes(path, b"synced!!", fsync=True)
+    tree = diskio.simulate_crash(rec.ops, len(rec.ops), "sync")
+    assert tree["log.bin"] == b"synced!!"
+
+
+def test_torn_variant_cuts_only_the_unsynced_tail(tmp_path):
+    path = str(tmp_path / "log.bin")
+    with diskio.recording(str(tmp_path)) as rec:
+        h = diskio.open_append(path)
+        h.write(b"AAAA")
+        h.fsync()
+        h.write(b"BBBB")
+        h.flush()
+        h.close()
+    ops = rec.ops
+    k = len(ops)
+    tree = diskio.simulate_crash(ops, k, "torn", torn_keep=2)
+    assert tree["log.bin"] == b"AAAABB"
+    # an fsync-covered write can never tear: crash right after the
+    # first fsync has no unsynced tail -> no distinct torn state
+    k_fsync = next(i for i, op in enumerate(ops)
+                   if op["op"] == "fsync") + 1
+    assert diskio.simulate_crash(ops, k_fsync, "torn", torn_keep=2) is None
+
+
+def test_fid_follows_rename_and_unsynced_rename_rolls_back(tmp_path):
+    """The fsynced temp bytes belong to the same fid after the rename;
+    in the sync variant a rename without a later dir/file fsync rolls
+    back to the temp name."""
+    path = str(tmp_path / "f.json")
+    with diskio.recording(str(tmp_path)) as rec:
+        diskio.write_atomic(path, b"DATA", fsync=True)
+    ops = rec.ops
+    ridx = next(i for i, op in enumerate(ops) if op["op"] == "rename")
+    # crash right after the rename, before the directory fsync
+    tree = diskio.simulate_crash(ops, ridx + 1, "sync")
+    assert "f.json" not in tree
+    assert any(p.startswith(".f.json.tmp.") and data == b"DATA"
+               for p, data in tree.items())
+    # after the dir fsync the published name is durable
+    tree = diskio.simulate_crash(ops, len(ops), "sync")
+    assert tree["f.json"] == b"DATA"
+
+
+def test_preexisting_files_survive_every_crash_state(tmp_path):
+    keep = tmp_path / "keep.txt"
+    keep.write_bytes(b"precious")
+    with diskio.recording(str(tmp_path)) as rec:
+        diskio.unlink(str(tmp_path / "keep.txt"))
+    ops = rec.ops
+    # before the unlink op every variant still holds the snapshot
+    k = next(i for i, op in enumerate(ops) if op["op"] == "unlink")
+    for variant in ("flush", "sync"):
+        assert diskio.simulate_crash(ops, k, variant)["keep.txt"] \
+            == b"precious"
+    # the unlink was never made durable (no dir fsync): sync resurrects
+    assert diskio.simulate_crash(
+        ops, len(ops), "sync")["keep.txt"] == b"precious"
+    assert "keep.txt" not in diskio.simulate_crash(
+        ops, len(ops), "flush")
+
+
+def test_marks_ride_the_journal(tmp_path):
+    with diskio.recording(str(tmp_path)) as rec:
+        diskio.append_bytes(str(tmp_path / "a"), b"x", fsync=True)
+        diskio.mark("committed", seqs=[1, 2])
+        diskio.append_bytes(str(tmp_path / "b"), b"y", fsync=True)
+    ops = rec.ops
+    midx = next(i for i, op in enumerate(ops) if op["op"] == "mark")
+    assert diskio.marks_before(ops, midx) == []
+    after = diskio.marks_before(ops, len(ops))
+    assert after == [{"op": "mark", "name": "committed", "seqs": [1, 2]}]
+    # marks never materialize as files
+    assert set(diskio.simulate_crash(ops, len(ops), "flush")) == {"a", "b"}
+
+
+def test_one_recording_per_process(tmp_path):
+    with diskio.recording(str(tmp_path)):
+        with pytest.raises(RuntimeError, match="already active"):
+            with diskio.recording(str(tmp_path)):
+                pass
+    assert diskio.recorder() is None
+
+
+def test_kill_hook_sigkills_before_the_matching_op(tmp_path):
+    """CXXNET_DISKIO_KILL_AT lands SIGKILL before the nth matching
+    durable op (subprocess: the hook kills the whole process)."""
+    script = (
+        "import sys; sys.path.insert(0, sys.argv[1])\n"
+        "from cxxnet_tpu.utils import diskio\n"
+        "diskio.write_atomic(sys.argv[2] + '/one.model', b'1')\n"
+        "diskio.write_atomic(sys.argv[2] + '/two.model', b'2')\n"
+        "print('SURVIVED')\n"
+    )
+    env = dict(os.environ, CXXNET_DISKIO_KILL_AT="two.model",
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", script, REPO, str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == -signal.SIGKILL
+    assert "SURVIVED" not in out.stdout
+    # the op BEFORE the matching one completed; the matching one never
+    # published (the kill fires before the temp write)
+    assert (tmp_path / "one.model").read_bytes() == b"1"
+    assert not (tmp_path / "two.model").exists()
+
+
+# ----------------------------------------------------------------------
+# ENOSPC acceptance: abort atomically, stay loadable, page the operator
+def _disk_full_count(site: str) -> float:
+    return registry().counter(
+        "disk_full_total", "", labelnames=("site",)
+    ).labels(site=site).value
+
+
+@pytest.mark.parametrize("kind", ["enospc", "short"])
+def test_checkpoint_disk_full_aborts_atomically(tmp_path, kind):
+    mdir = str(tmp_path / "models")
+    ck.write_checkpoint(ck.publish_path(mdir, 1), _blob(1), round_=1)
+    before = _disk_full_count("checkpoint.write")
+    faults.install(f"checkpoint.write:{kind}:1")
+    try:
+        with pytest.raises(OSError) as ei:
+            ck.write_checkpoint(ck.publish_path(mdir, 2), _blob(2),
+                                round_=2)
+        assert ei.value.errno == errno.ENOSPC
+    finally:
+        faults.reset()
+    assert _disk_full_count("checkpoint.write") > before
+    # atomic abort: no round-2 artifact, no temp litter, round 1 loads
+    assert not os.path.exists(ck.publish_path(mdir, 2))
+    assert not [n for n in os.listdir(mdir) if ".tmp." in n]
+    latest = ck.find_latest_valid(mdir, silent=True)
+    assert latest is not None and latest[0] == 1
+    assert ck.validate_checkpoint(latest[1]) is None
+
+
+def test_disk_full_alert_fires_on_rate():
+    """The operator contract: any ENOSPC hit moves ``disk_full_rate``
+    off zero, and a ``:>:0`` rule on it fires on the next evaluation."""
+    ev = obs_alerts.AlertEvaluator()
+    ev.add_rule(obs_alerts.parse_rule("disk_full:disk_full_rate:>:0"))
+    ev.evaluate_once(now=100.0)
+    diskio.count_disk_full("checkpoint.write", "/models/0001.model")
+    emitted = ev.evaluate_once(now=102.0)
+    assert any(e["kind"] == "alert.firing" and e["name"] == "disk_full"
+               for e in emitted)
+    assert ev.firing() == ["disk_full"]
+
+
+def test_feedback_append_survives_disk_full(tmp_path):
+    """Serving contract: ENOSPC on the feedback path drops the page,
+    counts it, and keeps accepting appends — it never raises into the
+    predict handler."""
+    w = fl.FeedbackWriter(str(tmp_path), page_bytes=1 << 20,
+                          rotate_bytes=1 << 20, fsync=True)
+    before = _disk_full_count("loop.commit")
+    assert w.append(_rec(1.0), [1.0]) == 1
+    faults.install("loop.commit:enospc:1:1")
+    try:
+        assert w.flush() == 0  # page dropped, no raise
+    finally:
+        faults.reset()
+    assert w.dropped == 1
+    assert _disk_full_count("loop.commit") > before
+    # the writer keeps working once the disk clears
+    assert w.append(_rec(2.0), [2.0]) == 1
+    assert w.flush() == 1
+    w.close()
+    recs, _ = fl.FeedbackReader(str(tmp_path)).read_since()
+    assert [float(r.labels[0]) for r in recs] == [2.0]
+
+
+# ----------------------------------------------------------------------
+# the torn-commit-sidecar regression (crash-audit corpus, pinned)
+def test_reopen_truncates_torn_commit_sidecar(tmp_path):
+    d = str(tmp_path)
+    w = fl.FeedbackWriter(d, page_bytes=1 << 20, rotate_bytes=1 << 20,
+                          fsync=True, drop_on_error=False)
+    s1 = w.append_seq(_rec(1.0), [1.0])
+    w.flush()
+    s2 = w.append_seq(_rec(2.0), [2.0])
+    w.flush()
+    w.close()
+    cpath = os.path.join(d, "feedback-000000.bin" + fl.COMMIT_SUFFIX)
+    with open(cpath, "rb") as f:
+        raw = f.read()
+    first_end = raw.index(b"\n") + 1
+    torn = raw[: first_end + (len(raw) - first_end) // 2]
+    with open(cpath, "wb") as f:
+        f.write(torn)  # second commit line torn mid-record, no newline
+    # parsing stops at the clean length: one commit, page 2 uncommitted
+    ents, clean_len = fl._read_commits_full(
+        os.path.join(d, "feedback-000000.bin"))
+    assert len(ents) == 1 and clean_len == first_end
+    # reopen MUST truncate the torn line before appending: without it
+    # the next entry fuses onto the partial line and every later commit
+    # is unparseable (committed records silently lost)
+    w = fl.FeedbackWriter(d, page_bytes=1 << 20, rotate_bytes=1 << 20,
+                          fsync=True, drop_on_error=False)
+    assert os.path.getsize(cpath) == first_end
+    s3 = w.append_seq(_rec(3.0), [3.0])
+    w.flush()
+    w.close()
+    got = {r.seq: float(r.labels[0])
+           for r in fl.FeedbackReader(d).read_since()[0]}
+    assert got[s1] == 1.0
+    assert s2 not in got  # torn page stays uncommitted
+    assert got[s3] == 3.0  # the new commit is visible
+    # lineage: the torn page's id is burned, never reused
+    assert s3 > s2
+
+
+# ----------------------------------------------------------------------
+# the auditor itself stays green (fast single-workload pass)
+def test_crash_audit_checkpoint_workload_clean(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import crash_audit
+    finally:
+        sys.path.pop(0)
+    out = str(tmp_path / "verdict.json")
+    assert crash_audit.main(["--only", "checkpoint", "--out", out]) == 0
+    doc = json.load(open(out))
+    assert doc["violations"] == []
+    assert doc["workloads"]["checkpoint"]["distinct"] > 50

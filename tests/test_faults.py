@@ -524,8 +524,34 @@ def _scn_prefetch(kind, tmp_path):
 
 
 def _scn_checkpoint(site, kind, tmp_path):
+    from cxxnet_tpu.obs.registry import registry as obs_registry
     from cxxnet_tpu.utils import checkpoint as ckpt
 
+    if site == "checkpoint.write" and kind in ("enospc", "short"):
+        # the disk-full contract: abort ATOMICALLY — no torn target, no
+        # stray temp — with the prior round still loadable, and the
+        # disk_full_total alert counter bumped
+        ckpt.write_checkpoint(str(tmp_path / "0001.model"), b"blob1",
+                              round_=1, silent=True)
+        faults.install(f"checkpoint.write:{kind}:1")
+        disk_full = obs_registry().counter(
+            "disk_full_total", "", labelnames=("site",)
+        ).labels(site="checkpoint.write")
+        before = disk_full.value
+        with pytest.raises(OSError):
+            ckpt.write_checkpoint(str(tmp_path / "0002.model"), b"blob2",
+                                  round_=2, silent=True)
+        assert disk_full.value > before
+        assert not (tmp_path / "0002.model").exists()
+        assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+        found = ckpt.find_latest_valid(str(tmp_path), silent=True)
+        assert found is not None and found[0] == 1
+        # disk space back → the retried write lands clean
+        faults.reset()
+        ckpt.write_checkpoint(str(tmp_path / "0002.model"), b"blob2",
+                              round_=2, silent=True)
+        assert ckpt.validate_checkpoint(str(tmp_path / "0002.model")) is None
+        return
     if site == "checkpoint.write":
         faults.install(f"checkpoint.write:{kind}:1:2")
         path = str(tmp_path / "0001.model")
@@ -671,9 +697,14 @@ def _scn_loop_append(kind, tmp_path):
             assert w.append_batch(x, y) == 1  # slow, not lost
             assert w.dropped == 0
             return
-        faults.install("loop.append:ioerror:1:3")
+        faults.install(f"loop.append:{kind}:1:3")
         assert w.append_batch(x, y) == 0  # dropped, no raise
         assert w.dropped == 1
+        if kind == "enospc":
+            from cxxnet_tpu.obs.registry import registry as obs_registry
+            assert obs_registry().counter(
+                "disk_full_total", "", labelnames=("site",)
+            ).labels(site="loop.append").value >= 1
         faults.reset()
         assert w.append_batch(x, y) == 1  # fault cleared: accepted
         w.flush()
@@ -681,6 +712,81 @@ def _scn_loop_append(kind, tmp_path):
         assert len(recs) == 1  # exactly the accepted record survived
     finally:
         w.close()
+
+
+def _scn_loop_commit(kind, tmp_path):
+    """A fault on the page/sidecar COMMIT path (the durable writes
+    themselves) must degrade exactly like an append fault: the buffered
+    page drops and is counted, nothing raises, and after recovery —
+    including truncating any torn tail the short-write left — the log
+    commits and reads back clean."""
+    import numpy as np
+
+    from cxxnet_tpu.loop import FeedbackReader, FeedbackWriter
+
+    w = FeedbackWriter(str(tmp_path / "log"))
+    x = np.ones((1, 16), np.float32)
+    y = np.zeros((1, 1), np.float32)
+    try:
+        assert w.append_batch(x, y) == 1  # buffered fine
+        faults.install(f"loop.commit:{kind}:1:1")
+        assert w.flush() == 0  # commit failed → page dropped, no raise
+        assert w.dropped == 1
+        faults.reset()
+        assert w.append_batch(x, y) == 1
+        assert w.flush() == 1  # recovered: clean offset, clean sidecar
+        recs, _ = FeedbackReader(w.dir).read_since(None)
+        assert len(recs) == 1  # only the post-recovery page is visible
+    finally:
+        w.close()
+
+
+def _scn_obs_append(kind, tmp_path):
+    """The observability file sink under a sick/full disk: emit never
+    raises, the drop is bounded (holdoff skips the I/O attempt instead
+    of hammering rotation+open per event) and counted in
+    events_dropped_total{sink,reason}; the in-memory ring keeps
+    recording throughout."""
+    from cxxnet_tpu.obs import events as obs_events
+    from cxxnet_tpu.obs.registry import registry as obs_registry
+
+    log = obs_events.event_log()
+    log.reset()
+    log.path = str(tmp_path / "events.jsonl")
+    try:
+        faults.install(f"obs.append:{kind}:1")
+        fired_before = faults.injector().fire_counts().get(
+            f"obs.append:{kind}", 0)
+        log.emit("chaos.probe", n=1)  # must not raise
+        assert log.dropped == 1
+        reason = "disk" if kind == "enospc" else "io"
+        dropped = obs_registry().counter(
+            "events_dropped_total", "", labelnames=("sink", "reason")
+        ).labels(sink="events", reason=reason)
+        assert dropped.value >= 1
+        # bounded drop: within the holdoff the sink is skipped entirely
+        # (no second fault firing), but the drop is still counted and
+        # the ring still records
+        log.emit("chaos.probe", n=2)
+        assert log.dropped == 2
+        assert faults.injector().fire_counts()[f"obs.append:{kind}"] \
+            == fired_before + 1
+        # the ring kept recording (nested bookkeeping events — e.g.
+        # fault.injected, diskio.disk_full — land in the ring too)
+        assert len(log.recent(50, kind="chaos.probe")) == 2
+        if kind == "enospc":
+            assert obs_registry().counter(
+                "disk_full_total", "", labelnames=("site",)
+            ).labels(site="obs.append").value >= 1
+        # disk recovers: holdoff over + fault cleared → the sink works
+        faults.reset()
+        log.holdoff_s = 0.0
+        log._skip_until = 0.0
+        log.emit("chaos.after", n=3)
+        text = (tmp_path / "events.jsonl").read_text()
+        assert "chaos.after" in text
+    finally:
+        log.reset()
 
 
 class _StubMember:
@@ -997,6 +1103,10 @@ def test_fault_matrix(site, kind, tmp_path):
         _scn_serve_batch(kind, tmp_path)
     elif site == "loop.append":
         _scn_loop_append(kind, tmp_path)
+    elif site == "loop.commit":
+        _scn_loop_commit(kind, tmp_path)
+    elif site == "obs.append":
+        _scn_obs_append(kind, tmp_path)
     elif site == "mesh.replica":
         _scn_mesh_replica(kind, tmp_path)
     elif site == "serve.replica":

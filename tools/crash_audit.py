@@ -1,0 +1,640 @@
+#!/usr/bin/env python3
+"""Crash-consistency auditor: replay every crash point, run real recovery.
+
+The CRASH=1 tier-1 lane (doc/robustness.md "Crash-consistency
+contract").  Four recorded workloads exercise every durable writer —
+checkpoint + manifest, publish pointer, feedback log + ``.commit``
+sidecars + cursor, retention compaction — under
+``cxxnet_tpu.utils.diskio.recording``.  For every prefix of the
+recorded op journal the simulator computes the post-crash filesystem
+under the ext4-reorder model (``flush`` / ``sync`` / ``torn`` variants,
+torn tails cut at several byte counts), materializes it into a fresh
+directory, runs the REAL recovery paths (``find_latest_valid``,
+``read_publish_pointer``, ``FeedbackWriter`` reopen + append,
+``FeedbackReader.read_since``, ``Sweeper.sweep``), and asserts the
+invariants the marks in the journal acknowledged before the crash:
+
+* the publish pointer never names a missing or CRC-invalid round;
+* a feedback record acknowledged as committed is never lost, an
+  acknowledged lineage id is never reused, and a torn page never
+  surfaces;
+* the retention boundary never strands a live cursor, and consumed
+  records never reappear behind it;
+* checkpoint resume is monotonic — never backward past a torn file —
+  and every ``NNNN.model`` that surfaces validates.
+
+A named regression corpus pins previously-found bugs as hand-built
+states (e.g. ``torn-commit-sidecar-append`` — a torn sidecar line that
+would fuse with the next commit entry and hide every later commit).
+
+Exit 0 with verdict "ok" only when every explored state passes and at
+least ``--min-states`` distinct states were covered.  ``--out`` writes
+the verdict JSON that ``tools/perf_guard.py --bench crash_audit``
+tracks (states_explored, violations, wall_s).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import struct
+import sys
+import tempfile
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from cxxnet_tpu.loop import feedback_log as fl  # noqa: E402
+from cxxnet_tpu.loop import retention as rt  # noqa: E402
+from cxxnet_tpu.utils import checkpoint as ck  # noqa: E402
+from cxxnet_tpu.utils import diskio, faults  # noqa: E402
+
+REC_SHAPE = (1, 1, 4)  # tiny but real (H, W, C) feedback payload
+
+
+def _rec_data(val: float) -> np.ndarray:
+    return np.full(REC_SHAPE, np.float32(val))
+
+
+def _model_blob(round_: int) -> bytes:
+    """A structurally valid model payload (magic + header), so a crash
+    state that kept the checkpoint but lost its manifest still passes
+    ``validate_checkpoint``'s structural fallback — exactly like a real
+    legacy checkpoint would."""
+    hdr = json.dumps({"round": round_, "audit": True}).encode("utf-8")
+    payload = hashlib.sha256(b"payload-%d" % round_).digest() * 8
+    return ck.MODEL_MAGIC + struct.pack("<I", len(hdr)) + hdr + payload
+
+
+# ----------------------------------------------------------------------
+# workloads: recorded op journals with invariant marks
+
+
+def wl_checkpoint(root: str) -> dict:
+    """Six checkpoint rounds with a mid-stream and a final retention
+    pass (keep_latest=3)."""
+    mdir = os.path.join(root, "models")
+    for r in range(1, 7):
+        ck.write_checkpoint(ck.publish_path(mdir, r), _model_blob(r),
+                            round_=r)
+        diskio.mark("ckpt_durable", round=r)
+        if r == 5:
+            removed = ck.apply_retention(mdir, keep_latest=3)
+            diskio.mark("ckpt_retention", keep=3, removed=len(removed))
+    removed = ck.apply_retention(mdir, keep_latest=3)
+    diskio.mark("ckpt_retention", keep=3, removed=len(removed))
+    return {}
+
+
+def wl_publish(root: str) -> dict:
+    """Checkpoint rounds + three publish-pointer flips + retention that
+    prunes superseded rounds (keeps every round the pointer could still
+    name)."""
+    mdir = os.path.join(root, "models")
+    for r in range(1, 5):
+        ck.write_checkpoint(ck.publish_path(mdir, r), _model_blob(r),
+                            round_=r)
+        diskio.mark("ckpt_durable", round=r)
+    prev = None
+    for r in (2, 3, 4):
+        # the pointer stores the round-relative name so the audited
+        # state stays relocatable (the real publisher stores the path
+        # it wrote, which is equivalent inside one model dir)
+        ck.write_publish_pointer(mdir, r, f"{r:04d}.model",
+                                 prev_round=prev)
+        diskio.mark("published", round=r)
+        prev = r
+    ck.apply_retention(mdir, keep_latest=3)
+    diskio.mark("ckpt_retention", keep=3, removed=1)
+    return {}
+
+
+def wl_feedback(root: str) -> dict:
+    """Feedback appends with explicit page commits, a rotation, and a
+    mid-workload clean close + reopen."""
+    fdir = os.path.join(root, "fb")
+    val = [1000.0]
+
+    def _append(w, n):
+        seqs, vals = [], []
+        for _ in range(n):
+            val[0] += 1.0
+            s = w.append_seq(_rec_data(val[0]), [val[0]])
+            diskio.mark("acked", seq=s, val=val[0])
+            seqs.append(s)
+            vals.append(val[0])
+        return seqs, vals
+
+    w = fl.FeedbackWriter(fdir, page_bytes=1 << 20, rotate_bytes=200,
+                          fsync=True, drop_on_error=False)
+    for _ in range(2):
+        seqs, vals = _append(w, 3)
+        w.flush()
+        diskio.mark("committed", seqs=seqs, vals=vals)
+    w.close()
+    # clean reopen mid-history: resume must continue the lineage
+    w = fl.FeedbackWriter(fdir, page_bytes=1 << 20, rotate_bytes=200,
+                          fsync=True, drop_on_error=False)
+    seqs, vals = _append(w, 2)
+    w.flush()
+    diskio.mark("committed", seqs=seqs, vals=vals)
+    w.close()
+    return {}
+
+
+def wl_retention(root: str) -> dict:
+    """Append / consume / sweep cycles: every flush rotates the shard
+    (rotate_bytes=1), the cursor is persisted after each consume, and an
+    aggressive sweep (retain_shards=0) compacts consumed shards."""
+    fdir = os.path.join(root, "feedback")
+    cpath = os.path.join(root, "state", "cursor.json")
+    cf = fl.CursorFile(cpath)
+    rdr = fl.FeedbackReader(fdir)
+    sw = rt.Sweeper(fdir, rt.RetentionOptions(retain_shards=0))
+    cursor_history: List[dict] = []
+    val = [2000.0]
+    w = fl.FeedbackWriter(fdir, page_bytes=1 << 20, rotate_bytes=1,
+                          fsync=True, drop_on_error=False)
+    for _cycle in range(3):
+        for _page in range(2):
+            seqs, vals = [], []
+            for _ in range(2):
+                val[0] += 1.0
+                s = w.append_seq(_rec_data(val[0]), [val[0]])
+                diskio.mark("acked", seq=s, val=val[0])
+                seqs.append(s)
+                vals.append(val[0])
+            w.flush()
+            diskio.mark("committed", seqs=seqs, vals=vals)
+        recs, cur = rdr.read_since(cf.load())
+        consumed = max((r.seq for r in recs if r.seq is not None),
+                       default=-1)
+        cf.store(cur)
+        hist = {"shard": int(cur["shard"]), "off": int(cur["off"]),
+                "consumed_through": int(consumed)}
+        cursor_history.append(hist)
+        diskio.mark("cursor", **hist)
+        out = sw.sweep(cur)
+        diskio.mark("swept", below=out["compacted_below"])
+    w.close()
+    return {"cursor_history": cursor_history}
+
+
+# ----------------------------------------------------------------------
+# invariant checkers: run REAL recovery code against a recovered tree
+
+
+def _marked(marks: List[dict], name: str) -> List[dict]:
+    return [m for m in marks if m["name"] == name]
+
+
+def check_checkpoint(out_dir: str, marks: List[dict], ctx: dict,
+                     sub: str = "models") -> List[str]:
+    mdir = os.path.join(out_dir, sub)
+    vio: List[str] = []
+    for r, path in ck.list_checkpoints(mdir):
+        reason = ck.validate_checkpoint(path)
+        if reason is not None:
+            vio.append(f"checkpoint {r:04d}.model surfaced invalid: "
+                       f"{reason}")
+    durable = [m["round"] for m in _marked(marks, "ckpt_durable")]
+    if durable:
+        latest = ck.find_latest_valid(mdir, silent=True)
+        if latest is None:
+            vio.append(f"no valid checkpoint recoverable though round "
+                       f"{max(durable)} was acknowledged durable")
+        elif latest[0] < max(durable):
+            vio.append(f"resume went backward: latest valid round "
+                       f"{latest[0]} < acknowledged {max(durable)}")
+    return vio
+
+
+def check_publish(out_dir: str, marks: List[dict], ctx: dict) -> List[str]:
+    mdir = os.path.join(out_dir, "models")
+    vio = check_checkpoint(out_dir, marks, ctx)
+    ptr = ck.read_publish_pointer(mdir)
+    published = [m["round"] for m in _marked(marks, "published")]
+    if published:
+        if ptr is None:
+            vio.append(f"publish pointer lost though round "
+                       f"{max(published)} was acknowledged published")
+        elif int(ptr["round"]) < max(published):
+            vio.append(f"publish pointer rolled back: names round "
+                       f"{ptr['round']} < acknowledged {max(published)}")
+    if ptr is not None:
+        path = ptr["path"]
+        full = path if os.path.isabs(path) else os.path.join(mdir, path)
+        if not os.path.exists(full):
+            vio.append(f"publish pointer names missing checkpoint "
+                       f"{ptr['path']} (round {ptr['round']})")
+        else:
+            reason = ck.validate_checkpoint(full)
+            if reason is not None:
+                vio.append(f"publish pointer names invalid checkpoint "
+                           f"round {ptr['round']}: {reason}")
+    return vio
+
+
+def _committed_map(marks: List[dict]) -> Dict[int, float]:
+    out: Dict[int, float] = {}
+    for m in _marked(marks, "committed"):
+        for s, v in zip(m["seqs"], m["vals"]):
+            out[int(s)] = float(v)
+    return out
+
+
+def _read_all(fdir: str, cursor: Optional[dict] = None):
+    recs, cur = fl.FeedbackReader(fdir).read_since(cursor)
+    return {int(r.seq): float(r.labels[0])
+            for r in recs if r.seq is not None}, cur
+
+
+def check_feedback(out_dir: str, marks: List[dict], ctx: dict) -> List[str]:
+    fdir = os.path.join(out_dir, "fb")
+    vio: List[str] = []
+    committed = _committed_map(marks)
+    acked = {int(m["seq"]) for m in _marked(marks, "acked")
+             if m["seq"] is not None}
+    # real recovery: reopen the writer (torn-tail + torn-sidecar
+    # truncation), then prove the log still accepts and commits
+    w = fl.FeedbackWriter(fdir, page_bytes=1 << 20, rotate_bytes=200,
+                          fsync=True, drop_on_error=False)
+    new_seqs = []
+    for i in range(2):
+        s = w.append_seq(_rec_data(-1.0), [-1.0])
+        if s is None:
+            vio.append("post-recovery append was dropped")
+        else:
+            new_seqs.append(int(s))
+    w.flush()
+    w.close()
+    if set(new_seqs) & acked:
+        vio.append(f"acknowledged lineage ids reused after crash: "
+                   f"{sorted(set(new_seqs) & acked)}")
+    got, _cur = _read_all(fdir)
+    for s in sorted(committed):
+        if s not in got:
+            vio.append(f"committed seq {s} lost after recovery")
+        elif got[s] != committed[s]:
+            vio.append(f"committed seq {s} content mismatch: "
+                       f"{got[s]} != {committed[s]} (torn page surfaced)")
+    for s in new_seqs:
+        if s not in got:
+            vio.append(f"post-recovery commit invisible (seq {s}): "
+                       "torn sidecar fused with the new entry")
+    return vio
+
+
+def check_retention(out_dir: str, marks: List[dict], ctx: dict) -> List[str]:
+    fdir = os.path.join(out_dir, "feedback")
+    cpath = os.path.join(out_dir, "state", "cursor.json")
+    vio: List[str] = []
+    committed = _committed_map(marks)
+    cur = fl.CursorFile(cpath).load()
+    try:
+        got, _ = _read_all(fdir, dict(cur))
+    except fl.StaleCursorError as e:
+        return [f"retention stranded a live cursor: {e}"]
+    # which consume the recovered cursor corresponds to: the durable
+    # cursor is always one the workload actually stored (atomic write),
+    # or the {0,0} default when no store survived
+    consumed_through = -1
+    for h in ctx.get("cursor_history", []):
+        if h["shard"] == cur["shard"] and h["off"] == cur["off"]:
+            consumed_through = h["consumed_through"]
+    if (cur["shard"], cur["off"]) != (0, 0) and consumed_through < 0 \
+            and ctx.get("cursor_history"):
+        vio.append(f"recovered cursor {cur} matches no acknowledged "
+                   "store (torn cursor file)")
+    required = {s for s in committed if s > consumed_through}
+    for s in sorted(required):
+        if s not in got:
+            vio.append(f"unconsumed committed seq {s} unreadable "
+                       f"past cursor {cur}")
+    stale = {s for s in got if s <= consumed_through}
+    if stale:
+        vio.append(f"consumed records reappeared past the cursor: "
+                   f"{sorted(stale)}")
+    # a re-sweep over the recovered state must be idempotent: orphans
+    # below the boundary go, nothing the cursor still needs does
+    try:
+        rt.Sweeper(fdir, rt.RetentionOptions(retain_shards=0)).sweep(cur)
+    except Exception as e:  # noqa: BLE001 - any raise is a violation
+        return vio + [f"re-sweep after crash raised "
+                      f"{type(e).__name__}: {e}"]
+    got2, _ = _read_all(fdir, dict(cur))
+    for s in sorted(required):
+        if s not in got2:
+            vio.append(f"re-sweep deleted unconsumed committed seq {s}")
+    return vio
+
+
+WORKLOADS: List[Tuple[str, Callable, Callable]] = [
+    ("checkpoint", wl_checkpoint, check_checkpoint),
+    ("publish", wl_publish, check_publish),
+    ("feedback", wl_feedback, check_feedback),
+    ("retention", wl_retention, check_retention),
+]
+
+
+# ----------------------------------------------------------------------
+# enumeration
+
+
+def _unsynced_tail_len(ops: List[dict], k: int) -> Optional[int]:
+    """Length of the write the ``torn`` variant would cut at crash
+    point ``k`` (None when every write is covered by a later fsync —
+    an fsync-acknowledged write can never tear)."""
+    for i in range(k - 1, -1, -1):
+        op = ops[i]
+        if op["op"] != "write" or op.get("snap"):
+            continue
+        for j in range(i + 1, k):
+            oj = ops[j]
+            if oj["op"] == "fsync" and oj.get("fid") == op["fid"]:
+                return None
+        return len(op["data"])
+    return None
+
+
+def _marks_digest(marks: List[dict]) -> str:
+    return hashlib.sha1(
+        json.dumps(marks, sort_keys=True).encode("utf-8")).hexdigest()
+
+
+def audit_workload(name: str, workload: Callable, checker: Callable,
+                   scratch: str, stride: int,
+                   torn_keeps: int) -> dict:
+    rec_root = tempfile.mkdtemp(prefix=f"rec-{name}-", dir=scratch)
+    with diskio.recording(rec_root) as rec:
+        ctx = workload(rec_root) or {}
+    ops = list(rec.ops)
+    shutil.rmtree(rec_root, ignore_errors=True)
+
+    seen: Dict[str, Tuple[int, str]] = {}
+    explored = 0
+    violations: List[dict] = []
+
+    def _state(k: int, variant: str, keep: Optional[int]) -> None:
+        nonlocal explored
+        tree = diskio.simulate_crash(ops, k, variant, torn_keep=keep)
+        if tree is None:
+            return
+        explored += 1
+        marks = diskio.marks_before(ops, k)
+        key = diskio.tree_fingerprint(tree) + _marks_digest(marks)
+        if key in seen:
+            return
+        seen[key] = (k, variant)
+        out_dir = tempfile.mkdtemp(prefix=f"state-{name}-", dir=scratch)
+        try:
+            diskio.write_tree(tree, out_dir)
+            try:
+                msgs = checker(out_dir, marks, ctx)
+            except Exception as e:  # noqa: BLE001 - recovery must not raise
+                msgs = [f"recovery raised {type(e).__name__}: {e}"]
+            for msg in msgs:
+                violations.append({"workload": name, "k": k,
+                                   "variant": variant, "keep": keep,
+                                   "msg": msg})
+        finally:
+            shutil.rmtree(out_dir, ignore_errors=True)
+
+    for k in range(0, len(ops) + 1, max(1, stride)):
+        for variant in ("flush", "sync"):
+            _state(k, variant, None)
+        tail = _unsynced_tail_len(ops, k)
+        if tail is not None and tail > 1:
+            keeps = {1, tail - 1}
+            if torn_keeps >= 3:
+                keeps.add(tail // 2)
+            for keep in sorted(keeps):
+                if 0 < keep < tail:
+                    _state(k, "torn", keep)
+
+    return {"ops": len(ops), "explored": explored,
+            "distinct": len(seen), "violations": violations}
+
+
+# ----------------------------------------------------------------------
+# named regression corpus: hand-built states pinning found bugs
+
+
+def reg_torn_commit_sidecar_append(scratch: str) -> List[str]:
+    """A torn trailing ``.commit`` line must be truncated on reopen —
+    otherwise the next commit entry fuses onto it, parsing stops at the
+    fused line, and every commit after it silently vanishes (the
+    satellite-6 bug this audit found)."""
+    d = tempfile.mkdtemp(prefix="reg-sidecar-", dir=scratch)
+    try:
+        w = fl.FeedbackWriter(d, page_bytes=1 << 20, rotate_bytes=1 << 20,
+                              fsync=True, drop_on_error=False)
+        s1 = w.append_seq(_rec_data(1.0), [1.0])
+        w.flush()
+        s2 = w.append_seq(_rec_data(2.0), [2.0])
+        w.flush()
+        w.close()
+        cpath = os.path.join(d, "feedback-000000.bin" + fl.COMMIT_SUFFIX)
+        with open(cpath, "rb") as f:
+            raw = f.read()
+        first_end = raw.index(b"\n") + 1
+        # tear the second commit line mid-record (no trailing newline)
+        with open(cpath, "wb") as f:
+            f.write(raw[: first_end + (len(raw) - first_end) // 2])
+        w = fl.FeedbackWriter(d, page_bytes=1 << 20, rotate_bytes=1 << 20,
+                              fsync=True, drop_on_error=False)
+        s3 = w.append_seq(_rec_data(3.0), [3.0])
+        w.flush()
+        w.close()
+        got, _ = _read_all(d)
+        vio = []
+        if int(s1) not in got:
+            vio.append(f"first committed seq {s1} lost")
+        if int(s2) in got:
+            vio.append(f"torn-sidecar page surfaced (seq {s2})")
+        if s3 is None or int(s3) not in got:
+            vio.append("post-recovery commit hidden by torn sidecar line")
+        return vio
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def reg_orphan_shard_below_boundary(scratch: str) -> List[str]:
+    """A crash between the boundary fsync and the unlinks leaves orphan
+    shards below ``compacted_below``; readers must ignore them and a
+    cursor at the boundary must not be declared stale."""
+    d = tempfile.mkdtemp(prefix="reg-orphan-", dir=scratch)
+    try:
+        w = fl.FeedbackWriter(d, page_bytes=1 << 20, rotate_bytes=1,
+                              fsync=True, drop_on_error=False)
+        w.append_seq(_rec_data(1.0), [1.0])
+        w.flush()  # shard 0 (rotates)
+        s2 = w.append_seq(_rec_data(2.0), [2.0])
+        w.flush()  # shard 1
+        w.close()
+        # boundary says shard 0 is gone, but its files survived
+        with open(os.path.join(d, fl.RETENTION_FILE), "w",
+                  encoding="utf-8") as f:
+            json.dump({"compacted_below": 1}, f)
+        got, _ = _read_all(d, {"shard": 1, "off": 0})
+        vio = []
+        if int(s2) not in got:
+            vio.append(f"live seq {s2} unreadable next to orphans")
+        if any(v == 1.0 for v in got.values()):
+            vio.append("orphan shard below the boundary was served")
+        return vio
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def reg_manifest_without_model(scratch: str) -> List[str]:
+    """An orphan manifest (model unlinked, manifest unlink not yet
+    durable) must not confuse discovery or resume."""
+    d = tempfile.mkdtemp(prefix="reg-manifest-", dir=scratch)
+    try:
+        ck.write_checkpoint(ck.publish_path(d, 1), _model_blob(1),
+                            round_=1)
+        ck.write_checkpoint(ck.publish_path(d, 2), _model_blob(2),
+                            round_=2)
+        os.unlink(ck.publish_path(d, 2))  # manifest 2 survives
+        latest = ck.find_latest_valid(d, silent=True)
+        if latest is None or latest[0] != 1:
+            return [f"orphan manifest broke resume: {latest}"]
+        return []
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def reg_tmp_orphan_ignored(scratch: str) -> List[str]:
+    """A torn atomic-write temp file must be invisible to checkpoint
+    discovery (the ``.*.tmp.*`` naming contract)."""
+    d = tempfile.mkdtemp(prefix="reg-tmp-", dir=scratch)
+    try:
+        ck.write_checkpoint(ck.publish_path(d, 1), _model_blob(1),
+                            round_=1)
+        with open(os.path.join(d, ".0002.model.tmp.999"), "wb") as f:
+            f.write(b"torn half-written checkpoint bytes")
+        names = [p for _r, p in ck.list_checkpoints(d)]
+        if any(".tmp." in os.path.basename(p) for p in names):
+            return ["atomic-write temp file surfaced in discovery"]
+        latest = ck.find_latest_valid(d, silent=True)
+        if latest is None or latest[0] != 1:
+            return [f"torn temp file broke resume: {latest}"]
+        return []
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def reg_garbage_publish_pointer(scratch: str) -> List[str]:
+    """A torn/garbage PUBLISHED.json must read as absent, never raise
+    (can only happen if the pointer was written non-atomically)."""
+    d = tempfile.mkdtemp(prefix="reg-pointer-", dir=scratch)
+    try:
+        os.makedirs(d, exist_ok=True)
+        with open(ck.pointer_path(d), "wb") as f:
+            f.write(b'{"round": 3, "pa')  # torn mid-key
+        if ck.read_publish_pointer(d) is not None:
+            return ["garbage publish pointer parsed as valid"]
+        return []
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+REGRESSIONS: List[Tuple[str, Callable]] = [
+    ("torn-commit-sidecar-append", reg_torn_commit_sidecar_append),
+    ("orphan-shard-below-boundary", reg_orphan_shard_below_boundary),
+    ("manifest-without-model", reg_manifest_without_model),
+    ("tmp-orphan-ignored", reg_tmp_orphan_ignored),
+    ("garbage-publish-pointer", reg_garbage_publish_pointer),
+]
+
+
+# ----------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 budget: drop the mid-cut torn states "
+                    "(still >= --min-states distinct)")
+    ap.add_argument("--stride", type=int, default=0,
+                    help="explicit crash-point stride (overrides --smoke)")
+    ap.add_argument("--only", choices=[n for n, _w, _c in WORKLOADS],
+                    help="run a single workload (debugging)")
+    ap.add_argument("--min-states", type=int, default=300,
+                    help="fail the verdict below this many distinct "
+                    "states (default 300)")
+    ap.add_argument("--out", help="write the verdict JSON here")
+    args = ap.parse_args(argv)
+
+    faults.reset()
+    stride = args.stride or 1
+    torn_keeps = 2 if args.smoke else 3
+    t0 = time.time()
+    scratch = tempfile.mkdtemp(prefix="crash-audit-")
+    workloads: Dict[str, dict] = {}
+    violations: List[dict] = []
+    try:
+        for name, workload, checker in WORKLOADS:
+            if args.only and name != args.only:
+                continue
+            res = audit_workload(name, workload, checker, scratch,
+                                 stride, torn_keeps)
+            violations.extend(res.pop("violations"))
+            workloads[name] = res
+            print(f"crash_audit: {name}: {res['ops']} ops, "
+                  f"{res['explored']} states ({res['distinct']} distinct)",
+                  flush=True)
+        if not args.only:
+            for rname, fn in REGRESSIONS:
+                try:
+                    msgs = fn(scratch)
+                except Exception as e:  # noqa: BLE001
+                    msgs = [f"regression raised {type(e).__name__}: {e}"]
+                for msg in msgs:
+                    violations.append({"workload": f"regression:{rname}",
+                                       "k": None, "variant": None,
+                                       "keep": None, "msg": msg})
+                print(f"crash_audit: regression {rname}: "
+                      f"{'FAIL' if msgs else 'ok'}", flush=True)
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+    distinct = sum(w["distinct"] for w in workloads.values())
+    explored = sum(w["explored"] for w in workloads.values())
+    verdict = "ok"
+    if violations:
+        verdict = "violations"
+    elif not args.only and distinct < args.min_states:
+        verdict = f"too few states ({distinct} < {args.min_states})"
+    doc = {
+        "bench": "crash_audit",
+        "workloads": workloads,
+        "states_explored": explored,
+        "distinct_states": distinct,
+        "violations": violations,
+        "violations_count": len(violations),
+        "wall_s": round(time.time() - t0, 3),
+        "verdict": verdict,
+    }
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+    for v in violations[:50]:
+        print(f"crash_audit: VIOLATION [{v['workload']} k={v['k']} "
+              f"{v['variant']}/{v['keep']}]: {v['msg']}", flush=True)
+    print(f"crash_audit: {explored} states explored, {distinct} distinct, "
+          f"{len(violations)} violation(s), "
+          f"{doc['wall_s']}s -> {verdict}", flush=True)
+    return 0 if verdict == "ok" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

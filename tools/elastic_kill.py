@@ -55,6 +55,10 @@ N_HIDDEN = 256             # enough per-round work to kill mid-round
 KILL_AFTER_CKPT = 3        # SIGKILL once 0003.model is durable
 JOIN_AT = 7                # grow boundary (start_counter units)
 KILL_RANK = 3              # never rank 0 (it hosts both coordinators)
+# --kill-checkpoint mode: shrink 3 -> 2 at this boundary, then SIGKILL
+# rank 0 INSIDE the first post-rebuild consensus checkpoint write
+CKPT_DROP_AT = 4
+KILL_CKPT_ROUND = 5
 
 
 def _free_port() -> int:
@@ -123,7 +127,7 @@ collective_timeout_s = 30
 
 def launch_rank(conf: str, workdir: str, model_dir: str, rank: int,
                 nproc: int, jax_port: int, elastic_port: int,
-                extra=()):
+                extra=(), extra_env=None):
     d = os.path.join(workdir, f"p{rank}")
     os.makedirs(d, exist_ok=True)
     env = {
@@ -132,6 +136,8 @@ def launch_rank(conf: str, workdir: str, model_dir: str, rank: int,
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
     }
+    if extra_env:
+        env.update(extra_env)
     over = [f"model_dir={model_dir}",
             f"elastic_coordinator=localhost:{elastic_port}"]
     if rank >= 0:
@@ -301,6 +307,102 @@ def run_planned(conf: str, workdir: str, model_dir: str, drop_at: int,
     return {"rebuild_wall_s": rebuild_s}
 
 
+def run_kill_checkpoint(conf: str, workdir: str, model_dir: str,
+                        timeout: float, problems) -> dict:
+    """--kill-checkpoint: kill -9 INSIDE the consensus checkpoint write.
+
+    A 3-rank pod shrinks to 2 at ``CKPT_DROP_AT`` (planned departure);
+    rank 0 carries ``CXXNET_DISKIO_KILL_AT=<round>.model:2``, so the
+    SIGKILL lands deterministically between the checkpoint temp file's
+    fsync and its ``os.replace`` — the torn temp is on disk, the
+    published name is not.  The survivors are then killed too (a
+    whole-pod power loss).  A fresh 2-rank pod restarts with
+    ``continue=1`` and must resume from the prior consensus round with
+    every surviving manifest CRC-valid — the crash-audit atomic-publish
+    invariant proven through the real CLI."""
+    from cxxnet_tpu.utils import checkpoint as ckpt
+    from cxxnet_tpu.utils import diskio
+
+    os.makedirs(model_dir, exist_ok=True)
+    jax_port, elastic_port = _free_port(), _free_port()
+    kill_env = {diskio.KILL_ENV: f"{KILL_CKPT_ROUND:04d}.model:2"}
+    procs = [launch_rank(conf, workdir, model_dir, r, 3, jax_port,
+                         elastic_port,
+                         extra=[f"elastic_drop_at={CKPT_DROP_AT}"],
+                         extra_env=kill_env if r == 0 else None)
+             for r in range(3)]
+    t0 = time.time()
+    while procs[0].poll() is None and time.time() - t0 < timeout:
+        time.sleep(0.1)
+    if procs[0].poll() is None:
+        problems.append("kill-checkpoint: rank 0 never hit the staged "
+                        "kill inside the round-"
+                        f"{KILL_CKPT_ROUND} checkpoint write")
+    for p in procs[1:]:
+        p.send_signal(signal.SIGKILL)
+    drain(procs, 60, problems, "kill-checkpoint",
+          expect_fail_ranks={0, 1, 2})
+    if procs[0].returncode != -signal.SIGKILL:
+        problems.append("kill-checkpoint: rank 0 exited "
+                        f"rc={procs[0].returncode}, expected SIGKILL; "
+                        "tail:\n" + rank_log(workdir, 0)[-2000:])
+
+    # crash window: torn temp on disk, published name absent, every
+    # surviving checkpoint CRC-valid, resume target = the prior round
+    target = os.path.join(model_dir, f"{KILL_CKPT_ROUND:04d}.model")
+    tmp_orphan = any(f".{KILL_CKPT_ROUND:04d}.model.tmp." in n
+                     for n in os.listdir(model_dir))
+    if os.path.exists(target):
+        problems.append(f"kill-checkpoint: {os.path.basename(target)} "
+                        "was published despite the mid-write kill")
+    if not tmp_orphan:
+        problems.append("kill-checkpoint: no torn temp file — the kill "
+                        "did not land inside the write")
+    for round_, path in ckpt.list_checkpoints(model_dir):
+        reason = ckpt.validate_checkpoint(path)
+        if reason is not None:
+            problems.append(f"kill-checkpoint: surviving round {round_} "
+                            f"invalid after crash: {reason}")
+    latest = ckpt.find_latest_valid(model_dir, silent=True)
+    if latest is None or latest[0] != KILL_CKPT_ROUND - 1:
+        problems.append("kill-checkpoint: resume target is "
+                        f"{latest and latest[0]}, expected consensus "
+                        f"round {KILL_CKPT_ROUND - 1}")
+
+    # restart: a fresh 2-rank pod continues from the consensus round
+    t1 = time.time()
+    restart_dir = os.path.join(workdir, "restart")
+    jax_port, elastic_port = _free_port(), _free_port()
+    rprocs = [launch_rank(conf, restart_dir, model_dir, r, 2, jax_port,
+                          elastic_port, extra=["continue=1"])
+              for r in range(2)]
+    drain(rprocs, timeout, problems, "kill-checkpoint-restart")
+    restart_s = time.time() - t1
+    log0 = rank_log(restart_dir, 0)
+    resumed = f"Continue training from round {KILL_CKPT_ROUND}" in log0
+    if not resumed:
+        problems.append("kill-checkpoint: restart did not resume from "
+                        f"round {KILL_CKPT_ROUND - 1} (expected 'Continue "
+                        f"training from round {KILL_CKPT_ROUND}'); "
+                        "tail:\n" + log0[-2000:])
+    crcs = read_crcs(model_dir)
+    if len(crcs) != NUM_ROUND + 1:
+        problems.append("kill-checkpoint: restart finished with rounds "
+                        f"{sorted(crcs)}, expected {NUM_ROUND + 1} "
+                        "checkpoints")
+    for round_, path in ckpt.list_checkpoints(model_dir):
+        reason = ckpt.validate_checkpoint(path)
+        if reason is not None:
+            problems.append(f"kill-checkpoint: round {round_} invalid "
+                            f"after restart: {reason}")
+    return {
+        "tmp_orphan": tmp_orphan,
+        "resumed_from": (latest[0] if latest else None),
+        "restart_wall_s": round(restart_s, 3),
+        "rounds_final": len(crcs),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default="/tmp/_elastic",
@@ -309,7 +411,38 @@ def main() -> int:
                     help="per-run wall-clock budget (seconds)")
     ap.add_argument("--json", dest="json_path", default="",
                     help="verdict path (default <out>/elastic.json)")
+    ap.add_argument("--kill-checkpoint", action="store_true",
+                    help="run ONLY the kill-9-inside-the-consensus-"
+                    "checkpoint-write crash window (verdict "
+                    "<out>/elastic_crash.json)")
     args = ap.parse_args()
+
+    if args.kill_checkpoint:
+        os.makedirs(args.out, exist_ok=True)
+        make_data(args.out)
+        conf = make_conf(args.out)
+        problems: list = []
+        t0 = time.time()
+        crash_dir = os.path.join(args.out, "killckpt")
+        res = run_kill_checkpoint(
+            conf, crash_dir, os.path.join(crash_dir, "models"),
+            args.timeout, problems)
+        doc = {
+            "bench": "elastic_crash",
+            "ts": time.time(),
+            "wall_sec": round(time.time() - t0, 3),
+            **res,
+            "problems": problems,
+            "verdict": "ok" if not problems else "fail",
+        }
+        json_path = args.json_path or os.path.join(args.out,
+                                                   "elastic_crash.json")
+        with open(json_path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps(doc, indent=1))
+        for p in problems:
+            print(f"FAIL {p}", file=sys.stderr)
+        return 1 if problems else 0
 
     os.makedirs(args.out, exist_ok=True)
     make_data(args.out)

@@ -51,10 +51,10 @@ VERDICTS = ("baseline", "ok", "regression")
 
 #: substrings marking a metric as lower-is-better (latencies, and the
 #: mesh lane's compile counts — MORE compiles is the re-jit regression)
-_LOWER_MARKERS = ("latency", "_ms", "p50", "p95", "p99", "wall_sec",
+_LOWER_MARKERS = ("latency", "_ms", "p50", "p95", "p99", "wall_s",
                   "compiles", "programs", "rebuild_wall_s",
                   "restart_wall_s", "shed_ratio", "final_err",
-                  "elapsed_s", "disk_bytes_final")
+                  "elapsed_s", "disk_bytes_final", "violations")
 
 
 def lower_is_better(name: str) -> bool:
@@ -258,7 +258,42 @@ def flatten_tenant_bench(doc: dict) -> Dict[str, float]:
     return out
 
 
+def flatten_crash_audit(doc: dict) -> Dict[str, float]:
+    """The CRASH lane's series (``tools/crash_audit.py``): coverage
+    (states explored / distinct — a change that quietly shrinks the
+    audited state space collapses these far outside any band),
+    violations (lower is better; nonzero already hard-fails the lane,
+    the series keeps the zero pinned in history), and the audit wall
+    time."""
+    out: Dict[str, float] = {}
+    for key in ("states_explored", "distinct_states",
+                "violations_count", "wall_s"):
+        v = doc.get(key)
+        if isinstance(v, (int, float)) and math.isfinite(v):
+            out[key.replace("violations_count", "violations")] = float(v)
+    return out
+
+
+def flatten_elastic_crash(doc: dict) -> Dict[str, float]:
+    """The elastic kill -9 crash-window series (``tools/elastic_kill.py
+    --kill-checkpoint``): the torn-tmp sighting (1.0 means the SIGKILL
+    really landed inside the atomic-publish window — losing it means the
+    kill hook drifted off the race), the consensus round resumed from,
+    restart latency (lower is better), the final CRC-valid round count,
+    and the end-to-end wall clock."""
+    out: Dict[str, float] = {}
+    out["tmp_orphan"] = 1.0 if doc.get("tmp_orphan") else 0.0
+    for key in ("resumed_from", "restart_wall_s", "rounds_final",
+                "wall_sec"):
+        v = doc.get(key)
+        if isinstance(v, (int, float)) and math.isfinite(v):
+            out[key] = float(v)
+    return out
+
+
 FLATTENERS = {"io_bench": flatten_io_bench,
+              "crash_audit": flatten_crash_audit,
+              "elastic_crash": flatten_elastic_crash,
               "serve_bench": flatten_serve_bench,
               "mesh_parity": flatten_mesh_parity,
               "quant_bench": flatten_quant_bench,

@@ -48,6 +48,21 @@
 #                                     # f32-vs-int8 closed-loop serve A/B
 #                                     # (quant leg must not regress), and
 #                                     # a quant_bench perf_guard entry
+#        CRASH=1 tools/run_tier1.sh   # also run the crash-consistency
+#                                     # audit: record every durable-write
+#                                     # op sequence (checkpoint, publish
+#                                     # pointer, feedback pages+commits,
+#                                     # retention boundary) and replay
+#                                     # EVERY crash-point prefix under the
+#                                     # ext4-reorder model (flush/sync/
+#                                     # torn variants) into a fresh dir,
+#                                     # running the real recovery path and
+#                                     # asserting the declared invariants
+#                                     # (>=300 distinct states, zero
+#                                     # violations) plus 5 named
+#                                     # regression replays; the verdict
+#                                     # appends to a perf_guard history
+#                                     # (crash_audit flattener)
 #        ELASTIC=1 tools/run_tier1.sh # also run the elastic-pod lane:
 #                                     # a 4-process CPU-mesh CLI train
 #                                     # has one NON-ZERO rank SIGKILLed
@@ -60,7 +75,14 @@
 #                                     # planned-resize run of the same
 #                                     # shrink/grow schedule; rebuild
 #                                     # latency + recovered throughput
-#                                     # append to a perf_guard history
+#                                     # append to a perf_guard history;
+#                                     # also runs the kill -9 crash-
+#                                     # window check: rank 0 SIGKILLed
+#                                     # between the consensus checkpoint
+#                                     # tmp fsync and its rename — the
+#                                     # torn tmp must be ignored and a
+#                                     # continue=1 restart must resume
+#                                     # from the prior CRC-valid round
 #        FLEET=1 tools/run_tier1.sh   # also run the serving-fleet
 #                                     # smoke: a REAL 2-replica
 #                                     # task=serve fleet (CLI child
@@ -175,6 +197,19 @@ if [ "${MESH:-0}" = "1" ]; then
       --history "$mesh_out/bench_history.jsonl" > /dev/null || rc=1
   echo "MESH lane verdict: $mesh_out/mesh_parity.json"
 fi
+if [ "${CRASH:-0}" = "1" ]; then
+  echo "=== opt-in crash-consistency audit (CRASH=1) ==="
+  crash_out=/tmp/_crash_audit
+  rm -rf "$crash_out"; mkdir -p "$crash_out"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python tools/crash_audit.py --smoke \
+      --out "$crash_out/crash_audit.json" || rc=1
+  timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python tools/perf_guard.py --bench crash_audit \
+      --input "$crash_out/crash_audit.json" \
+      --history "$crash_out/bench_history.jsonl" > /dev/null || rc=1
+  echo "CRASH lane verdict: $crash_out/crash_audit.json"
+fi
 if [ "${ELASTIC:-0}" = "1" ]; then
   echo "=== opt-in elastic-pod lane (ELASTIC=1) ==="
   elastic_out=/tmp/_elastic_lane
@@ -187,7 +222,17 @@ if [ "${ELASTIC:-0}" = "1" ]; then
     python tools/perf_guard.py --bench elastic \
       --input "$elastic_out/elastic.json" \
       --history "$elastic_out/bench_history.jsonl" > /dev/null || rc=1
-  echo "ELASTIC lane verdict: $elastic_out/elastic.json"
+  # kill -9 crash-window check: SIGKILL rank 0 between the consensus
+  # checkpoint's tmp fsync and its rename, then restart with continue=1
+  # (full run took ~30 s; budget covers a slow machine)
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python tools/elastic_kill.py --kill-checkpoint \
+      --out "$elastic_out" > /dev/null || rc=1
+  timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python tools/perf_guard.py --bench elastic_crash \
+      --input "$elastic_out/elastic_crash.json" \
+      --history "$elastic_out/bench_history.jsonl" > /dev/null || rc=1
+  echo "ELASTIC lane verdict: $elastic_out/elastic.json $elastic_out/elastic_crash.json"
 fi
 if [ "${QUANT:-0}" = "1" ]; then
   echo "=== opt-in quantized-inference smoke (QUANT=1) ==="
