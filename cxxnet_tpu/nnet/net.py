@@ -80,6 +80,16 @@ class FunctionalNet:
         # default backend: dev=cpu on a TPU host must stay unfused
         self.exec_backend: Optional[str] = None
         self._embed_cache = None
+        # on-chip kernel library (ops/kernels/): auto | off | name list.
+        # `auto` (default) follows the RECORDED per-backend verdicts in
+        # ops/kernels/verdicts.json — a Pallas kernel runs only where a
+        # committed promote from tools/kernel_ab.py says it pays, the
+        # same discipline as conv_branch_embed=-1 above.  A name list
+        # pins those kernels ON (interpret mode off-TPU: exact, slow —
+        # the parity/test spelling).  Inference builds only: the Pallas
+        # calls carry no custom vjp, so the train forward stays stock.
+        self.kernel_lib = "auto"
+        self._kernel_sel = None
         # instantiate layers (shared layers alias the primary instance)
         self.layer_objs: List[Layer] = []
         self.param_key: List[Optional[str]] = []  # params pytree key per layer
@@ -133,6 +143,13 @@ class FunctionalNet:
                 self.fuse_1x1 = int(val)
             elif name == "conv_branch_embed":
                 self.conv_branch_embed = int(val)
+            elif name == "kernel_lib":
+                from ..ops import kernels as _klib
+
+                # canonicalize AND validate: a kernel-name typo must
+                # fail the build, not silently serve the stock path
+                self.kernel_lib = _klib.parse_mode(val)
+                self._kernel_sel = None
             elif name == "compute_dtype":
                 if val in ("bfloat16", "bf16"):
                     self.compute_dtype = jnp.bfloat16
@@ -328,7 +345,8 @@ class FunctionalNet:
         return self._fuse_cache
 
     @staticmethod
-    def _apply_fused_1x1(stride: int, gparams: List[dict], x):
+    def _apply_fused_1x1(stride: int, gparams: List[dict], x,
+                         kernels=None):
         """One conv for the whole sibling group; per-member outputs.
 
         The group kernel is assembled by SCATTERING each member into a
@@ -354,6 +372,27 @@ class FunctionalNet:
         for w in ws:
             wk = wk.at[:, :, :, off:off + w.shape[3]].set(w)
             off += w.shape[3]
+        if kernels is not None and kernels.active("conv_block", x=x,
+                                                  wk=wk):
+            # the fused Pallas GEMM: conv + every member's bias in one
+            # epilogue.  Members without a bias get zeros (x + 0 == x),
+            # so slicing the biased block equals per-member bias adds.
+            from ..ops.kernels import conv_block as _kcb
+
+            bias = (jnp.concatenate([
+                (d["bias"].astype(x.dtype) if "bias" in d
+                 else jnp.zeros((w.shape[3],), x.dtype))
+                for d, w in zip(gparams, ws)])
+                if any("bias" in d for d in gparams) else None)
+            y = _kcb.conv1x1_block(x, wk, bias, stride=stride,
+                                   interpret=kernels.interpret)
+            outs = []
+            off = 0
+            for w in ws:
+                outs.append(lax.slice_in_dim(
+                    y, off, off + w.shape[3], axis=3))
+                off += w.shape[3]
+            return outs
         y = lax.conv_general_dilated(
             x, wk,
             window_strides=(stride, stride), padding=((0, 0), (0, 0)),
@@ -620,6 +659,9 @@ class FunctionalNet:
         fuse_groups, fuse_member = (
             self._sibling_1x1_groups() if self.fuse_1x1 else ({}, {})
         )
+        # Pallas kernel library: inference builds only (no custom vjp on
+        # the kernel calls — the train forward must stay differentiable)
+        kern_lib = None if train else self.bound_kernels()
         embed_items, embed_groups = (
             self._branch_embed_plan() if self.use_branch_embed(train)
             else (None, {})
@@ -655,6 +697,7 @@ class FunctionalNet:
                 fused = functools.partial(
                     self._apply_fused_1x1,
                     self.layer_objs[i].param.stride,
+                    kernels=kern_lib,
                 )
                 run_f = (
                     jax.checkpoint(fused)
@@ -689,7 +732,7 @@ class FunctionalNet:
                     # int8 entry: dequant-free apply (ops/quant.py) —
                     # conv/fullc only, by the exporter's construction
                     nodes[spec.nindex_out[0]] = self._apply_quant_layer(
-                        lay, lparams, inputs
+                        lay, lparams, inputs, kernels=kern_lib
                     )
                     continue
                 # shared stateful layers chain their state: a later
@@ -760,7 +803,26 @@ class FunctionalNet:
                 return False
         return backend != "cpu"
 
-    def _apply_quant_layer(self, lay, lparams, inputs):
+    def bound_kernels(self, backend: Optional[str] = None):
+        """The kernel library's selector bound to this net's execution
+        backend (``ops/kernels/``): what the forward dispatch sites
+        consume.  Resolution mirrors ``use_branch_embed`` — the bound
+        ``exec_backend`` wins, then the process default; ``backend``
+        overrides both (tests)."""
+        from ..ops import kernels as _klib
+
+        if self._kernel_sel is None:
+            self._kernel_sel = _klib.KernelSelector(self.kernel_lib)
+        if backend is None:
+            backend = self.exec_backend
+        if backend is None:
+            try:
+                backend = jax.default_backend()
+            except Exception:  # noqa: BLE001 - no backend: treat as cpu
+                backend = "cpu"
+        return self._kernel_sel.bind(backend)
+
+    def _apply_quant_layer(self, lay, lparams, inputs, kernels=None):
         """Dispatch one int8-quantized layer (doc/performance.md
         "Quantized inference"): the compiled op consumes the RAW codes
         (the weight at rest stays int8) and the per-channel rescale is
@@ -774,9 +836,9 @@ class FunctionalNet:
         if type(lay) is ConvolutionLayer:
             p = lay.param
             return q.conv_apply_q(lparams, x, p.stride, p.pad_y, p.pad_x,
-                                  groups=p.num_group)
+                                  groups=p.num_group, kernels=kernels)
         if type(lay) is FullConnectLayer:
-            return q.fc_apply_q(lparams, x)
+            return q.fc_apply_q(lparams, x, kernels=kernels)
         raise ValueError(
             f"quantized params on unsupported layer "
             f"{type(lay).__name__} — the export plan only covers "

@@ -181,6 +181,13 @@ class NetTrainer:
             # fault-injection harness: treat the loss at this epoch as
             # NaN (one transient blow-up) so recovery paths are testable
             self.inject_nan_step = int(val)
+        elif name == "kernel_lib":
+            # on-chip kernel library selector (ops/kernels/): validate
+            # here so a typo fails at conf parse, then flow the value to
+            # the net via cfg -> graph defcfg like every other key
+            from ..ops import kernels as _klib
+
+            _klib.parse_mode(val)
         elif name == "quant":
             # inference-time weight precision: "" / 0 off, int8 (per-
             # channel scales + bf16 fallback) or bf16 (straight cast).
@@ -435,7 +442,7 @@ class NetTrainer:
 
     @staticmethod
     def _apply_updates(updaters, params, ustates, grads, epoch,
-                       gspec=None):
+                       gspec=None, kernels=None):
         """Per-tensor updater math over the param pytree (trace-time loop).
 
         ``gspec`` (shape → NamedSharding, set for ZeRO runs on a
@@ -462,10 +469,47 @@ class NetTrainer:
                 if gspec is not None:
                     g = jax.lax.with_sharding_constraint(
                         g, gspec(np.shape(w)))
+                if (kernels is not None
+                        and kernels.active("zero_update", w=w,
+                                           updater=up)):
+                    # the fused Pallas update step (ops/kernels/
+                    # update_step.py): one VMEM pass over (w, g, m)
+                    # instead of the op-by-op elementwise chain.  Same
+                    # schedule spelling as SGDUpdater.apply; bit-equal
+                    # to the stock lowering (tests/test_kernels.py).
+                    from ..ops.kernels import update_step as _kup
+
+                    p = up.param
+                    w2, m2 = _kup.sgd_update(
+                        w, g, ustates[key][tag]["m"],
+                        p.learning_rate(epoch).astype(w.dtype),
+                        p.momentum_at(epoch).astype(w.dtype),
+                        wd=p.wd, clip=p.clip_gradient,
+                        interpret=kernels.interpret)
+                    new_p[key][tag] = w2
+                    new_s[key][tag] = {"m": m2}
+                    continue
                 w2, s2 = up.apply(w, g, ustates[key][tag], epoch)
                 new_p[key][tag] = w2
                 new_s[key][tag] = s2
         return new_p, new_s
+
+    def _update_kernels(self):
+        """The kernel library's bound selector for the UPDATE side of
+        the step programs (``zero_update``), or None.  Gated to
+        single-device meshes: a Pallas call inside a multi-device GSPMD
+        program has no partitioning rule in this jaxlib, and the ZeRO
+        sharded-update path relies exactly on those annotations — the
+        stock elementwise chain stays the spelling there."""
+        if self.net is None:
+            return None
+        plan = self.mesh_plan
+        if plan is not None and plan.n_devices > 1:
+            return None
+        kb = self.net.bound_kernels()
+        # bind only when the selector can ever fire (avoids a dead
+        # closure arg re-tracing the step on verdict edits)
+        return kb if kb.selector.mode != "off" else None
 
     def _grad_spec(self):
         """The gradient sharding hook for :meth:`_apply_updates`: the
@@ -683,6 +727,7 @@ class NetTrainer:
             loss_and_out = self._loss_and_out
             apply_updates = self._apply_updates
             gspec = self._grad_spec()
+            ukern = self._update_kernels()
             det_grad = self._det_grad_fn() if self._det_active() else None
 
             def step(params, ustates, aux, data, labels, mask, rng, epoch,
@@ -699,7 +744,8 @@ class NetTrainer:
                         has_aux=True,
                     )(params)
                 new_p, new_s = apply_updates(updaters, params, ustates,
-                                             grads, epoch, gspec=gspec)
+                                             grads, epoch, gspec=gspec,
+                                             kernels=ukern)
                 return new_p, new_s, new_aux, loss, out
 
             self._jit_cache["fused"] = self._jit(
@@ -737,6 +783,7 @@ class NetTrainer:
             loss_and_out = self._loss_and_out
             apply_updates = self._apply_updates
             gspec = self._grad_spec()
+            ukern = self._update_kernels()
             det_grad = self._det_grad_fn() if self._det_active() else None
 
             def one_step(params, ustates, aux, data, labels, rng, epoch):
@@ -753,7 +800,8 @@ class NetTrainer:
                         has_aux=True,
                     )(params)
                 new_p, new_s = apply_updates(
-                    updaters, params, ustates, grads, epoch, gspec=gspec
+                    updaters, params, ustates, grads, epoch, gspec=gspec,
+                    kernels=ukern
                 )
                 return new_p, new_s, new_aux, loss, out
 
@@ -1024,10 +1072,11 @@ class NetTrainer:
             updaters = dict(self.updaters)
             apply_updates = self._apply_updates
             gspec = self._grad_spec()
+            ukern = self._update_kernels()
 
             def f(params, ustates, grads, epoch):
                 return apply_updates(updaters, params, ustates, grads,
-                                     epoch, gspec=gspec)
+                                     epoch, gspec=gspec, kernels=ukern)
 
             rep = self._sh()[0]
             psh, ush = self._param_sh()

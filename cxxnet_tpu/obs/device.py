@@ -55,6 +55,7 @@ __all__ = [
     "install_compile_listener",
     "register_memory_collector",
     "maybe_sample_step",
+    "mark_kernel_selected",
     "set_train_state_bytes",
     "summary",
     "device_metrics",
@@ -450,6 +451,27 @@ def instrument(fn: Callable, kind: str,
     install_compile_listener()
     register_memory_collector()
     return InstrumentedJit(fn, kind, data_arg=data_arg)
+
+
+# ----------------------------------------------------------------------
+# kernel-library selection (ops/kernels) — which Pallas kernels the
+# selector activated, per backend, made scrapeable next to the
+# per-kernel xla_program_*{kind="kernel_<name>"} families the A/B
+# driver's instrumented standalone launches record
+def mark_kernel_selected(name: str, backend: str, active: bool) -> None:
+    """Publish ``kernel_selected{name,backend}`` (1 = the Pallas path
+    runs, 0 = selected-off/rejected).  Called by the kernel selector at
+    every dispatch decision (trace time — cheap)."""
+    try:
+        obs_registry().gauge(
+            "kernel_selected",
+            "Kernel-library selection state: 1 when the named Pallas "
+            "kernel is active on this backend (kernel_lib conf + "
+            "recorded verdicts + capability probe), else 0.",
+            labelnames=("name", "backend"),
+        ).labels(name=name, backend=backend).set(1.0 if active else 0.0)
+    except Exception:  # noqa: BLE001 - telemetry must never raise
+        pass
 
 
 # ----------------------------------------------------------------------

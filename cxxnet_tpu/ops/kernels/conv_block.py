@@ -1,0 +1,126 @@
+"""Fused conv+bias(+relu) block kernel for the sibling-1x1 groups.
+
+The stock lowering of a fused 1x1 sibling group (``nnet/net.py
+_apply_fused_1x1``) is three XLA ops per group: one
+``conv_general_dilated`` over the scatter-assembled block kernel, a
+``slice_in_dim`` per member, and a bias add per member.  A 1x1 conv IS a
+GEMM — output pixel ``(n,y,x)`` is ``x_row @ W`` — so this kernel runs
+the whole group as ONE Pallas GEMM with the bias add (and optionally
+the following relu) in the epilogue: the MXU tile is written back to
+VMEM exactly once, already biased, instead of round-tripping through
+HBM between the conv and the elementwise ops.  Strides subsample the
+input on the host side first (exact for a 1x1/pad-0 conv: output pixel
+``(i,j)`` reads only ``x[i*s, j*s]``).
+
+Parity contract (tests/test_kernels.py): with the default full-array
+blocks the kernel's contraction is ONE ``dot_general`` over the same K
+axis as the stock conv's GEMM lowering — interpret mode on CPU is
+bit-equal to the stock path.  Explicit ``bm``/``bn`` tile the GEMM for
+the MXU (the on-chip shape); the per-element contraction is still one
+full-K dot, and the A/B driver (tools/kernel_ab.py) gates promotion on
+measured parity + throughput per backend.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .._compat import pallas_tpu_compiler_params
+
+
+def _pick_block(t: int, want: int) -> int:
+    b = min(want, t)
+    while t % b:
+        b //= 2
+    return max(b, 1)
+
+
+def _gemm_bias_kernel(x_ref, w_ref, b_ref, o_ref, *, relu, has_bias):
+    # one full-K dot per output tile: same contraction (and, without
+    # preferred_element_type, the same accumulation dtype) as the stock
+    # conv's GEMM — the epilogue is the only difference
+    y = jax.lax.dot_general(
+        x_ref[:], w_ref[:], (((1,), (0,)), ((), ())))
+    if has_bias:
+        y = y + b_ref[:]
+    if relu:
+        y = jnp.maximum(y, jnp.zeros((), y.dtype))
+    o_ref[:] = y.astype(o_ref.dtype)
+
+
+def fused_block_gemm(x2d, w2d, bias=None, *, relu: bool = False,
+                     interpret: bool = False, bm: int = 0, bn: int = 0):
+    """``relu?(x2d @ w2d + bias)`` as one Pallas program.
+
+    ``x2d`` is ``(M, K)``, ``w2d`` ``(K, O)``, ``bias`` ``(O,)`` or
+    None.  ``bm``/``bn`` tile M/O (0 = whole axis — the bit-parity
+    default); K always stays whole so every output element is a single
+    full-K contraction.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, k = x2d.shape
+    k2, o = w2d.shape
+    if k != k2:
+        raise ValueError(f"fused_block_gemm: K mismatch {k} vs {k2}")
+    has_bias = bias is not None
+    b2 = (bias.reshape(1, o).astype(x2d.dtype) if has_bias
+          else jnp.zeros((1, 1), x2d.dtype))
+    bm = _pick_block(m, bm) if bm else m
+    bn = _pick_block(o, bn) if bn else o
+    kern = functools.partial(_gemm_bias_kernel, relu=relu,
+                             has_bias=has_bias)
+    bspec = (pl.BlockSpec((1, bn), lambda i, j: (0, j),
+                          memory_space=pltpu.VMEM) if has_bias
+             else pl.BlockSpec((1, 1), lambda i, j: (0, 0),
+                               memory_space=pltpu.VMEM))
+    return pl.pallas_call(
+        kern,
+        grid=(m // bm, o // bn),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, bn), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            bspec,
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m, o), x2d.dtype),
+        compiler_params=pallas_tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(x2d, w2d, b2)
+
+
+def conv1x1_block(x, wk, bias=None, *, stride: int = 1,
+                  relu: bool = False, interpret: bool = False,
+                  bm: int = 0, bn: int = 0):
+    """The group's 1x1 conv as the fused GEMM: ``x`` NHWC, ``wk``
+    ``(1,1,C,O)`` (or already ``(C,O)``), ``bias`` the concatenated
+    ``(O,)`` member biases.  Returns NHWC with ``O`` channels."""
+    if stride > 1:
+        x = x[:, ::stride, ::stride, :]
+    n, h, w, c = x.shape
+    w2d = wk.reshape(wk.shape[-2], wk.shape[-1])
+    y = fused_block_gemm(x.reshape(-1, c), w2d, bias, relu=relu,
+                         interpret=interpret, bm=bm, bn=bn)
+    return y.reshape(n, h, w, -1)
+
+
+def probe(backend: str, x=None, wk=None, **_kw):
+    """Capability probe: None when launchable, else the reject reason.
+    Shape arguments are optional — a conf-time probe only has the
+    backend; a trace-time probe has the real operands."""
+    if x is not None:
+        if x.ndim != 4:
+            return f"input must be NHWC, got ndim={x.ndim}"
+        if x.dtype not in (jnp.float32, jnp.bfloat16):
+            return f"unsupported activation dtype {x.dtype}"
+    if wk is not None and wk.ndim == 4 and wk.shape[:2] != (1, 1):
+        return f"kernel must be 1x1, got {wk.shape[:2]}"
+    return None

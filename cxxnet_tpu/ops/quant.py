@@ -121,14 +121,23 @@ def _rescale_bias(y, lparams, out_dtype):
     return y.astype(out_dtype)
 
 
-def fc_apply_q(lparams, x):
+def fc_apply_q(lparams, x, kernels=None):
     """Quantized ``fullc``: ``y = (x @ q.T) * scale + bias``.
 
     ``q`` is ``(nout, nin)`` int8; the cast to the activation dtype is
     exact (|codes| <= 127 fit bf16's mantissa) and fuses into the GEMM's
     operand read — the weight argument of the compiled program is the
-    int8 array."""
+    int8 array.  ``kernels`` (a ``ops.kernels.BoundKernels``) may route
+    the whole chain into the fused Pallas epilogue kernel — bit-equal to
+    this stock lowering (tests/test_kernels.py)."""
     q = lparams[QKEY]
+    if (kernels is not None and x.ndim == 2
+            and kernels.active("int8_gemm", x=x, q=q)):
+        from .kernels import int8_gemm as _kq
+
+        return _kq.int8_gemm_rescale(
+            x, q, lparams[SKEY], lparams.get("bias"),
+            interpret=kernels.interpret)
     y = jax.lax.dot_general(
         x, q.astype(x.dtype),
         (((x.ndim - 1,), (1,)), ((), ())),
@@ -138,12 +147,30 @@ def fc_apply_q(lparams, x):
 
 
 def conv_apply_q(lparams, x, stride: int, pad_y: int, pad_x: int,
-                 groups: int = 1):
+                 groups: int = 1, kernels=None):
     """Quantized conv: direct NHWC/HWIO ``conv_general_dilated`` on the
     raw codes, f32 accumulate, per-output-channel rescale folded into
     the bias add (scales are per-O, so they commute out of the HWI
-    contraction — exact)."""
+    contraction — exact).  A 1x1/pad-0/ungrouped conv IS the fullc GEMM
+    over flattened pixels, so ``kernels`` may route it into the fused
+    int8 epilogue kernel; K×K convs stay on the stock lowering."""
     q = lparams[QKEY]
+    if (kernels is not None and groups == 1 and pad_y == 0 and pad_x == 0
+            and q.shape[:2] == (1, 1)
+            and kernels.active("int8_gemm", x=x, q=q)):
+        from .kernels import int8_gemm as _kq
+
+        if stride > 1:
+            # exact for a 1x1/pad-0 conv: output (i, j) reads only
+            # x[i*stride, j*stride]
+            x = x[:, ::stride, ::stride, :]
+        n, h, w, cin = x.shape
+        y = _kq.int8_gemm_rescale(
+            x.reshape(-1, cin),
+            jnp.transpose(q.reshape(cin, -1)),  # HWIO (1,1,I,O) -> (O, I)
+            lparams[SKEY], lparams.get("bias"),
+            interpret=kernels.interpret)
+        return y.reshape(n, h, w, -1)
     y = jax.lax.conv_general_dilated(
         x, q.astype(x.dtype),
         window_strides=(stride, stride),

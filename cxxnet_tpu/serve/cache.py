@@ -91,6 +91,21 @@ class ShapeBucketCache:
 
         return opsq.scheme_of(self._trainer)
 
+    def kernel_fp(self) -> str:
+        """The kernel-library selection fingerprint (cache-key
+        component, ``ops/kernels/``): the '+'-joined kernel names the
+        net's bound selector activates on its backend, ``""`` when none
+        — the stock program's key is unchanged from the pre-kernel era,
+        and a verdict/conf flip lands in a distinct slot so stock and
+        kernel programs of one net serve side by side."""
+        net = self._trainer.net
+        if net is None:
+            return ""
+        try:
+            return net.bound_kernels().fingerprint()
+        except Exception:  # noqa: BLE001 - key must never fail a serve
+            return ""
+
     def _n_data(self) -> int:
         plan = self._trainer.mesh_plan
         return plan.n_data if plan is not None else 1
@@ -122,9 +137,13 @@ class ShapeBucketCache:
         # the quant scheme rides in the key beside dtype: an f32 model
         # and its int8 export share a net fingerprint, and during a
         # rolling comparison both serve from one process — their
-        # compiled programs must occupy distinct slots
+        # compiled programs must occupy distinct slots.  The kernel
+        # selection rides beside it for the same reason (stock and
+        # Pallas-kernel programs of one net coexist; quant scheme stays
+        # the last component)
         key = (self.net_fp(), kind, node_id, bucket,
-               data.shape[1:], str(data.dtype), self.quant_scheme())
+               data.shape[1:], str(data.dtype), self.kernel_fp(),
+               self.quant_scheme())
         with self._lock:
             if key in self._keys:
                 self._keys[key] += 1

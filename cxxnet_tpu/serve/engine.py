@@ -514,7 +514,7 @@ class Engine:
         the old cache served, by running zero batches through it."""
         with self._model_lock:
             keys = self._cache.keys_snapshot()
-        for _fp, kind, node_id, bucket, row_shape, dtype, _q in keys:
+        for _fp, kind, node_id, bucket, row_shape, dtype, _kf, _q in keys:
             zeros = np.zeros((bucket,) + tuple(row_shape), dtype)
             try:
                 cache._run(kind, node_id, zeros)

@@ -74,6 +74,49 @@ def test_dequant_free_fold_matches_dequantized_math():
     np.testing.assert_allclose(folded, ref, rtol=1e-5, atol=1e-5)
 
 
+def test_rescale_commutes_out_of_contraction_exactly():
+    """The PR-10 exactness claim the kernel epilogue builds on, pinned
+    at the bit level: with power-of-two per-channel scales the rescale
+    commutes out of the int8 contraction EXACTLY — ``x @ (q*s).T`` is
+    bitwise ``(x @ q.T) * s`` — because scaling by 2^k only shifts
+    exponents.  The in-kernel spelling (quantize -> MXU -> rescale in
+    the epilogue, ``ops/kernels/int8_gemm.py``) and the stock spelling
+    (rescale folded into the f32 bias add outside) are therefore the
+    same math, and the epilogue kernel is checked bit-equal to the
+    jitted dequant-free ``fc_apply_q`` — its bit-level reference."""
+    import jax
+
+    from cxxnet_tpu.ops.kernels import int8_gemm
+
+    rng = np.random.RandomState(7)
+    q = rng.randint(-127, 128, (6, 10)).astype(np.int8)
+    x = jnp.asarray(rng.randn(4, 10).astype(np.float32))
+
+    # power-of-two scales: commuting is bitwise
+    s2 = (2.0 ** rng.randint(-8, 3, 6)).astype(np.float32)
+    inside = np.asarray(x) @ (q.astype(np.float32) * s2[:, None]).T
+    outside = (np.asarray(x) @ q.astype(np.float32).T) * s2
+    np.testing.assert_array_equal(inside, outside)
+
+    # general (measured) scales: same value up to one final rounding
+    w = rng.randn(6, 10).astype(np.float32)
+    qw, sw = opsq.quantize_weight(w, out_axis=0)
+    inside = np.asarray(x) @ (qw.astype(np.float32) * sw[:, None]).T
+    outside = (np.asarray(x) @ qw.astype(np.float32).T) * sw
+    np.testing.assert_allclose(inside, outside, rtol=1e-6, atol=0)
+
+    # the epilogue kernel vs its bit-level reference (the JITTED stock
+    # lowering — the net's programs are always compiled, and on CPU the
+    # eager spelling differs from its own compiled form via FMA fusion)
+    b = rng.randn(6).astype(np.float32)
+    lp = {opsq.QKEY: jnp.asarray(qw), opsq.SKEY: jnp.asarray(sw),
+          "bias": jnp.asarray(b)}
+    ref = np.asarray(jax.jit(opsq.fc_apply_q)(lp, x))
+    got = np.asarray(int8_gemm.int8_gemm_rescale(
+        x, lp[opsq.QKEY], lp[opsq.SKEY], lp["bias"], interpret=True))
+    np.testing.assert_array_equal(ref, got)
+
+
 def test_conv_apply_q_matches_dequantized_conv():
     from jax import lax
 

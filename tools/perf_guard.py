@@ -291,7 +291,27 @@ def flatten_elastic_crash(doc: dict) -> Dict[str, float]:
     return out
 
 
+def flatten_kernel_bench(doc: dict) -> Dict[str, float]:
+    """The KERNEL lane's series (``tools/kernel_ab.py``): per kernel,
+    the parity bit (1.0 must stay pinned — a drop below baseline is the
+    loudest possible regression), both timed legs (lower is better via
+    the ``_ms`` marker) and the kernel/stock throughput ratio the
+    promotion band reads."""
+    out: Dict[str, float] = {}
+    for k in doc.get("kernels") or []:
+        name = k.get("name")
+        if not name:
+            continue
+        out[f"{name}_parity"] = 1.0 if k.get("parity") else 0.0
+        for key in ("stock_ms", "kernel_ms", "ratio"):
+            v = k.get(key)
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                out[f"{name}_{key}"] = float(v)
+    return out
+
+
 FLATTENERS = {"io_bench": flatten_io_bench,
+              "kernel_bench": flatten_kernel_bench,
               "crash_audit": flatten_crash_audit,
               "elastic_crash": flatten_elastic_crash,
               "serve_bench": flatten_serve_bench,

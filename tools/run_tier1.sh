@@ -131,6 +131,21 @@
 #                                     # kill -9 crash-window CRC check;
 #                                     # verdict JSON appends to a
 #                                     # perf_guard history (tenant_bench)
+#        KERNEL=1 tools/run_tier1.sh  # also run the Pallas kernel-
+#                                     # library lane: the interpret-mode
+#                                     # parity suite (tests/
+#                                     # test_kernels.py — all three
+#                                     # kernels bit-equal to the jitted
+#                                     # stock lowering on CPU) plus
+#                                     # tools/kernel_ab.py --smoke (the
+#                                     # bisect A/B end to end: parity
+#                                     # gate, timed legs, schema-valid
+#                                     # verdict JSON appended to a
+#                                     # kernel_bench perf_guard history);
+#                                     # the full-size CPU measurement +
+#                                     # --record writes ops/kernels/
+#                                     # verdicts.json, and the TPU legs
+#                                     # stay queued in tpu_queue.sh
 #        OBS=1 tools/run_tier1.sh     # also run the observability smoke:
 #                                     # short telemetry=1 train + serve
 #                                     # scrape of /metricsz + /alertz
@@ -157,6 +172,19 @@ if [ "${PERF:-0}" = "1" ]; then
   echo "=== opt-in perf smoke (PERF=1) ==="
   timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python tools/io_bench.py --smoke || rc=1
+fi
+if [ "${KERNEL:-0}" = "1" ]; then
+  echo "=== opt-in Pallas kernel-library lane (KERNEL=1) ==="
+  kernel_out=/tmp/_kernel_ab
+  rm -rf "$kernel_out"; mkdir -p "$kernel_out"
+  timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_kernels.py -q -m 'not slow' \
+      -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python tools/kernel_ab.py --smoke \
+      --history "$kernel_out/bench_history.jsonl" \
+      --json "$kernel_out/kernel_ab.json" > /dev/null || rc=1
+  echo "KERNEL lane verdict: $kernel_out/kernel_ab.json"
 fi
 if [ "${LOOP:-0}" = "1" ]; then
   echo "=== opt-in closed-loop smoke (LOOP=1) ==="

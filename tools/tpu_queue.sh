@@ -119,6 +119,19 @@ sweep() {
   # show dispatch overhead, the chip shows exchange/compute overlap
   run 900 python tools/async_ab.py --overlap-bench --dev tpu \
     --steps 100 --hidden 4096
+  # Pallas kernel-library A/B (ISSUE 17 / ops/kernels/): the on-chip
+  # half of the measured-verdict promotion — parity gate (compiled
+  # Mosaic vs stock lowering) + timed legs per kernel; --record
+  # commits the tpu-backend verdicts kernel_lib=auto follows
+  # (doc/performance.md "Kernel library").  CPU verdicts are already
+  # recorded (conv_block/zero_update reject under interpret emulation,
+  # int8_gemm tie-promote); these are the first real MXU numbers
+  run 900 python tools/kernel_ab.py --kernel conv_block --record \
+    --history /tmp/tpu_kernel_bench.jsonl --json /tmp/kernel_ab_conv_block.json
+  run 900 python tools/kernel_ab.py --kernel int8_gemm --record \
+    --history /tmp/tpu_kernel_bench.jsonl --json /tmp/kernel_ab_int8_gemm.json
+  run 900 python tools/kernel_ab.py --kernel zero_update --record \
+    --history /tmp/tpu_kernel_bench.jsonl --json /tmp/kernel_ab_zero_update.json
   # TPU-backend HLO fusion audit (compile-only; doc/performance.md)
   run 900 python tools/hlo_inspect.py googlenet 128
   run 900 python tools/hlo_inspect.py googlenet 128 conv_branch_embed=1
