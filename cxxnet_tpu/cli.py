@@ -49,6 +49,14 @@ class LearnTask:
         self.divergence_policy = ""  # "" off | abort | rollback
         self.divergence_lr_backoff = 0.5
         self.divergence_max_retries = 3
+        # integrity plane (cxxnet_tpu/integrity/, doc/robustness.md
+        # "Integrity plane"): fingerprint-vote cadence, shadow-step
+        # audit, serve golden-canary probe committed at save
+        self.integrity_every = 0     # rounds between votes; 0 = off
+        self.integrity_shadow = 0    # 1: shadow-step audit at cadence
+        self.integrity_probe = 0     # 1: commit probe block at save
+        self._integrity = None       # IntegrityPlane, built in run()
+        self._integrity_rollback_before = None  # quarantine bound
         self.name_model_in = "NULL"
         self.name_pred = "pred.txt"
         self.print_step = 100
@@ -151,6 +159,12 @@ class LearnTask:
             self.divergence_lr_backoff = float(val)
         elif name == "divergence_max_retries":
             self.divergence_max_retries = int(val)
+        elif name == "integrity_every":
+            self.integrity_every = int(val)
+        elif name == "integrity_shadow":
+            self.integrity_shadow = int(val)
+        elif name == "integrity_probe":
+            self.integrity_probe = int(val)
         elif name == "start_counter":
             self.start_counter = int(val)
         elif name == "model_in":
@@ -489,10 +503,19 @@ class LearnTask:
         manifest (CRC32 + size + net fingerprint), and falls back past
         corrupt/truncated ones — a kill mid-write never bricks resume.
         Multi-process runs agree on the newest round EVERY process can
-        see before anyone loads."""
+        see before anyone loads.
+
+        An integrity quarantine sets ``_integrity_rollback_before``
+        (exclusive bound): the newest checkpoints may carry state the
+        corrupt rank's gradients already poisoned, so survivors must
+        resume from the last FINGERPRINT-VERIFIED round, not the newest
+        round on disk — the poisoned rounds are re-trained and their
+        checkpoints overwritten."""
         from .utils import checkpoint as ckpt
 
-        round_, path, reason = self._locate_agreed_checkpoint()
+        bound, self._integrity_rollback_before = (
+            self._integrity_rollback_before, None)
+        round_, path, reason = self._locate_agreed_checkpoint(before=bound)
         if round_ < 0:
             return False
         if reason is not None:
@@ -521,6 +544,31 @@ class LearnTask:
         self.net_trainer.load_model(self.name_model_in)
         self.start_counter += 1
 
+    def _probe_block(self) -> Optional[dict]:
+        """The golden-canary ``probe`` manifest block
+        (``integrity_probe = 1``, doc/robustness.md "Integrity plane"):
+        the deterministic probe-batch spec, plus — on single-process
+        runs — the CRC of this trainer's scores for it.  Multi-process
+        runs commit the spec only (scoring is a different SPMD program
+        per mesh; the engine records its own golden at load)."""
+        if not self.integrity_probe or self.net_trainer is None:
+            return None
+        import jax
+
+        from .integrity import canary
+
+        tr = self.net_trainer
+        rows = max(1, min(int(tr.batch_size) or 8, 8))
+        shape = tuple(tr.net.input_node_shape(tr.batch_size))[1:]
+        seed = 0xC0FFEE ^ int(tr.seed or 0)
+        crc = None
+        if jax.process_count() == 1 and not tr.quant_scheme:
+            probe = canary.probe_batch(seed, rows, shape)
+            scores = tr._run_sharded(tr._eval_fn(), probe)
+            crc = canary.scores_crc(scores)
+        return canary.make_probe_block(seed, rows, shape, crc,
+                                       jax.default_backend())
+
     def _save_model(self, force: bool = False) -> bool:
         """Checkpoint the current state as ``NNNN.model`` + manifest.
 
@@ -544,6 +592,7 @@ class LearnTask:
                 not force and self.start_counter % self.save_period != 0):
             return False
         blob = self.net_trainer.checkpoint_bytes()
+        probe = self._probe_block()
         err = None
         if is_primary():
             try:
@@ -554,6 +603,7 @@ class LearnTask:
                     save_ustate=self.net_trainer.save_ustate,
                     retry=True, silent=bool(self.silent),
                     mesh=self.net_trainer.mesh_manifest(),
+                    probe=probe,
                 )
                 if self.keep_latest > 0:
                     ckpt.apply_retention(
@@ -1090,6 +1140,7 @@ class LearnTask:
             time.sleep(2.0)
 
     def task_train(self) -> None:
+        from .integrity.plane import IntegrityError
         from .parallel.distributed import any_process_flag, process_info
         from .utils.checkpoint import DivergenceError, PreemptionHandler
 
@@ -1131,6 +1182,15 @@ class LearnTask:
         self._global_step = 0
         self._divergence_retries = 0
         self._lr_scale = 1.0
+        # integrity plane (doc/robustness.md "Integrity plane"): one
+        # driver per task, surviving trainer rebuilds; the state loaded
+        # or initialized before the loop is taken as the clean baseline
+        if self.integrity_every > 0 and self._integrity is None:
+            from .integrity import IntegrityPlane
+
+            self._integrity = IntegrityPlane(
+                self.integrity_every, self.integrity_shadow)
+            self._integrity.last_clean_round = self.start_counter - 1
         # SIGTERM/SIGINT → finish the current step, snapshot, exit clean.
         # Single-process runs stop at the next BATCH boundary; multi-
         # process runs stop at the next ROUND boundary (the per-batch
@@ -1170,6 +1230,29 @@ class LearnTask:
                     snapshotted = self._save_model(force=True)
                     preempted = True
                     break
+                # integrity plane: fingerprint vote (+ shadow audit) at
+                # the round boundary, BEFORE the consensus checkpoint —
+                # state that failed the vote is never made durable, so
+                # with integrity_every=1 no poisoned round is ever
+                # resumable
+                if (self._integrity is not None
+                        and self._integrity.due(self.start_counter - 1)):
+                    try:
+                        self._elastic_guard(
+                            lambda: self._integrity.check_round(
+                                self.net_trainer,
+                                self.start_counter - 1),
+                            what="integrity check")
+                    except IntegrityError as e:
+                        if self._handle_integrity(e):
+                            cc += 1  # the re-run rounds keep the budget
+                            continue
+                        tracer.close()
+                        raise
+                    except Exception as e:  # noqa: BLE001 - replica loss?
+                        if self._elastic_recover(e):
+                            continue  # the round completed; only re-sync
+                        raise
                 # boundary preemption check (collective in multi-process
                 # runs): force the snapshot past the save_model period
                 # gate so the preempted state is never lost
@@ -1269,7 +1352,8 @@ class LearnTask:
             return False
         # the injected fault (fault-injection harness) is one-shot: drop
         # it from the cfg so the rebuilt trainer doesn't re-arm it
-        self.cfg = [(n, v) for n, v in self.cfg if n != "inject_nan_step"]
+        self.cfg = [(n, v) for n, v in self.cfg
+                    if n not in ("inject_nan_step", "inject_spike_step")]
         bound = None  # exclusive upper round bound while falling back
         while True:
             round_, path, reason = self._locate_agreed_checkpoint(
@@ -1317,6 +1401,64 @@ class LearnTask:
             f"{self.divergence_max_retries})",
             flush=True,
         )
+        return True
+
+    def _handle_integrity(self, e) -> bool:
+        """Quarantine response to an integrity verdict
+        (doc/robustness.md "Integrity plane").  The vote ran on the
+        full allgathered digest matrix, so every rank holds the
+        IDENTICAL verdict without another collective: the corrupt rank
+        self-quarantines (``integrity.quarantine`` event, hard exit 41
+        — it must never contribute another gradient), the survivors
+        evict it through the elastic coordinator (idempotent per
+        (rank, round) verdict) and rebuild onto the last
+        fingerprint-VERIFIED round — state the corrupt rank's
+        gradients touched after the flip is discarded with it.
+        Returns True when this surviving rank rebuilt and the round
+        loop should continue; False aborts the run (no elastic mesh
+        to quarantine within, or no rank was named)."""
+        from .obs import emit as obs_emit
+        from .parallel.distributed import process_info
+
+        rank, num = process_info()
+        round_ = self.start_counter - 1
+        print(f"INTEGRITY: {e}", flush=True)
+        if e.rank is None or num == 1 or self.elastic_member is None:
+            # ambiguous vote (2-way tie / 2-replica group), a
+            # single-process run, or no elastic membership: there is
+            # no healthy majority to rebuild onto — stopping beats
+            # training on silently corrupt state
+            return False
+        last_clean = self._integrity.last_clean_round
+        obs_emit("integrity.quarantine", kind=e.kind, rank=e.rank,
+                 tensor=e.tensor, round=round_,
+                 last_clean_round=last_clean, self_evict=e.rank == rank)
+        if e.rank == rank:
+            # self-quarantine: leave the coordination plane quietly and
+            # hard-exit with the distinct quarantine code (41) — the
+            # supervisor must not relaunch onto the same device, and a
+            # plain exit would let resilient-client destructors abort
+            # with a misleading status (_hard_exit_if_resilient)
+            print(f"integrity: this rank ({rank}) was named corrupt — "
+                  "self-quarantining (exit 41)", flush=True)
+            self._elastic_quiet_teardown()
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(41)
+        # survivor: rebuild rolls back PAST every unverified round —
+        # _sync_latest_model consumes the bound (exclusive)
+        self._integrity_rollback_before = (
+            None if last_clean is None else last_clean + 1)
+        try:
+            plan = self.elastic_member.plan_evict(e.rank, round_)
+        except (OSError, ValueError, RuntimeError) as err:
+            print(f"integrity: evict RPC failed: {err}", flush=True)
+            return False
+        if plan.rank is None:
+            print("integrity: eviction plan dropped this rank too — "
+                  "aborting", flush=True)
+            return False
+        self._elastic_rebuild("integrity_evict", plan=plan)
         return True
 
     def _train_one_round(self, timer, tracer) -> bool:
@@ -1664,6 +1806,10 @@ class LearnTask:
                                if self.elastic_member is not None
                                else None),
             }
+        if self._integrity is not None:
+            # integrity plane: check cadence/count and the newest
+            # fingerprint-verified round (the quarantine rollback bound)
+            record["integrity"] = self._integrity.snapshot()
         try:
             line = json.dumps(record, separators=(",", ":")) + "\n"
             diskio.append_bytes(self.telemetry_path,

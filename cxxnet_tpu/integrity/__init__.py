@@ -1,0 +1,29 @@
+"""Integrity plane: silent-data-corruption detection for live state.
+
+PR 15 made every byte that reaches DISK crash-consistent and
+CRC-audited; this package guards the bytes that live in device/host
+memory and the compute that produces them, between checkpoints
+(doc/robustness.md "Integrity plane"):
+
+* :mod:`.fingerprint` — an order-independent per-tensor digest that is
+  bitwise-identical across mesh layouts, with a jitted on-device
+  reduction and a pure-numpy oracle.
+* :mod:`.plane` — replica voting over allgathered fingerprints
+  (majority names the corrupt minority rank → :class:`IntegrityError`
+  → elastic quarantine), plus the shadow-step audit that re-executes a
+  sampled grad program through an independently traced executable.
+* :mod:`.canary` — the serve golden canary: a manifest-committed probe
+  batch whose score CRC must stay stable for the lifetime of a loaded
+  model (mismatch degrades ``/healthz`` with ``integrity_failed``).
+"""
+
+from .fingerprint import combine_digests, digest_array, digest_device_array
+from .plane import IntegrityError, IntegrityPlane
+
+__all__ = [
+    "IntegrityError",
+    "IntegrityPlane",
+    "combine_digests",
+    "digest_array",
+    "digest_device_array",
+]

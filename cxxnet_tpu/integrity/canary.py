@@ -1,0 +1,70 @@
+"""Serve golden canary: a manifest-committed probe batch whose score
+CRC must stay stable for the lifetime of a loaded model.
+
+The serving analog of the trainer's state fingerprints: the engine
+cannot vote with replicas it does not know about, but it CAN hold its
+own compute to a golden answer.  At model load the engine scores the
+probe batch committed in the checkpoint manifest (``probe`` block:
+deterministic seed + row count, plus the CRC the trainer recorded at
+save time) and records the CRC of the scores; from then on a periodic
+re-score must reproduce that CRC bit-for-bit — the model bytes and the
+predict program are frozen between reloads, so ANY drift is memory or
+compute corruption, and ``/healthz`` degrades with the
+``integrity_failed`` reason token (the fleet supervisor ejects the
+replica from rotation, without killing it, and readmits it when a
+later canary comes back clean — see serve/fleet.py).
+
+The trainer-recorded golden is only binding when the engine scores
+through the same program class (same backend, no quantized sibling
+preferred): a legitimate pipeline difference (int8 weights, another
+backend's FMA contraction) re-bases the golden at load with an
+``integrity.golden_rebased`` event instead of a false alarm.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def probe_batch(seed: int, rows: int, shape: Tuple[int, ...]) -> np.ndarray:
+    """The deterministic probe: ``rows`` samples of per-example
+    ``shape``, uniform [0, 1) f32 from a fixed PCG — reproducible from
+    the (seed, rows, shape) triple alone, which is all the manifest
+    commits."""
+    rng = np.random.RandomState(int(seed) & 0x7FFFFFFF)
+    return rng.random_sample((int(rows),) + tuple(shape)).astype(np.float32)
+
+
+def scores_crc(scores: np.ndarray) -> int:
+    """CRC32 over the canonical encoding of the score tensor (shape
+    header + little-endian f32 bytes): bit-exact, shape-sensitive."""
+    a = np.ascontiguousarray(np.asarray(scores, np.float32))
+    head = ("x".join(str(int(d)) for d in a.shape) + ":").encode()
+    return zlib.crc32(a.tobytes(), zlib.crc32(head)) & 0xFFFFFFFF
+
+
+def make_probe_block(seed: int, rows: int, shape: Tuple[int, ...],
+                     crc: Optional[int], backend: str) -> dict:
+    """The manifest ``probe`` block (written by the trainer at save
+    when ``integrity_probe = 1``)."""
+    block = {
+        "seed": int(seed),
+        "rows": int(rows),
+        "shape": [int(d) for d in shape],
+        "backend": backend,
+    }
+    if crc is not None:
+        block["crc32"] = int(crc) & 0xFFFFFFFF
+    return block
+
+
+def block_matches_pipeline(block: dict, *, backend: str,
+                           quant: bool) -> bool:
+    """Is the trainer-recorded golden binding for THIS engine's scoring
+    pipeline?  Different backend or a quantized sibling legitimately
+    changes the scores — rebase instead of alarm."""
+    return (not quant) and block.get("backend") == backend \
+        and block.get("crc32") is not None

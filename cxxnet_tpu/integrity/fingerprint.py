@@ -1,0 +1,195 @@
+"""Order-independent per-tensor digests for corruption detection.
+
+The digest must satisfy three properties at once:
+
+1. **Sensitivity**: any single flipped bit changes it.  ``s1`` is a
+   plain modular word sum, so a one-bit flip shifts it by exactly
+   ``±2^b mod 2^32 != 0`` — no single-bit flip can cancel.
+2. **Layout invariance**: the same logical tensor sharded over ANY mesh
+   (1-process, 4-process zero=1, 8-way zero=3) digests to the same
+   value.  Modular sums commute, and ``s2`` weights each word by its
+   *global* flat index — a property of the logical tensor, not of the
+   shard that happens to hold it — so per-shard partial digests combine
+   by plain modular addition regardless of how the mesh carved it up.
+3. **Cheapness**: the reduction is jitted and runs on the device that
+   holds the shard; only two u32 words cross the host boundary per
+   (leaf, device).
+
+Definition (little-endian canonical element encoding, C order):
+
+* ``word[i]`` = the ``i``-th machine word of the tensor, widened to
+  u32: the u32 bit pattern for 4-byte dtypes, the u16 pattern for
+  2-byte dtypes, the byte for 1-byte dtypes, and an (lo, hi) u32 pair
+  for 8-byte dtypes (words-per-element ``wpe = max(1, itemsize//4)``
+  for >=4-byte dtypes).
+* global word index ``g(i) = element_global_flat_index * wpe + k``.
+* ``s1 = sum_i word[i] mod 2^32``
+* ``s2 = sum_i word[i] * (g(i) + 1 mod 2^32) mod 2^32``
+
+All arithmetic is u32 wraparound, which the jitted path gets for free
+from XLA's two's-complement ops and the numpy oracle reproduces via
+u64 intermediates reduced mod 2^32 (identical by ring homomorphism).
+Tensors above 2^32 words alias their index weights; ``s1`` keeps full
+single-flip sensitivity regardless.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, Sequence, Tuple
+
+import numpy as np
+
+Digest = Tuple[int, int]
+
+_M32 = np.uint64(0xFFFFFFFF)
+
+
+def _as_words(a: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Flat u64-widened machine words of ``a`` plus words-per-element."""
+    a = np.ascontiguousarray(a)
+    itemsize = a.dtype.itemsize
+    if itemsize == 4:
+        w, wpe = a.view(np.uint32), 1
+    elif itemsize == 2:
+        w, wpe = a.view(np.uint16), 1
+    elif itemsize == 1:
+        w, wpe = a.view(np.uint8), 1
+    elif itemsize == 8:
+        w, wpe = a.view(np.uint32), 2  # little-endian (lo, hi) pairs
+    else:
+        raise TypeError(f"digest: unsupported itemsize {itemsize} "
+                        f"(dtype {a.dtype})")
+    return w.reshape(-1).astype(np.uint64), wpe
+
+
+def _global_word_index(shape: Sequence[int], index, wpe: int) -> np.ndarray:
+    """u64 global word indices for the local block ``index`` (a tuple of
+    slices into an array of logical ``shape``), C order."""
+    shape = tuple(int(s) for s in shape)
+    strides = np.ones(len(shape), np.uint64)
+    for d in range(len(shape) - 2, -1, -1):
+        strides[d] = strides[d + 1] * np.uint64(shape[d + 1])
+    gi = np.zeros((), np.uint64)
+    for d, sl in enumerate(index):
+        start = np.uint64(sl.start or 0)
+        stop = sl.stop if sl.stop is not None else shape[d]
+        n = int(stop) - int(sl.start or 0)
+        offs = (start + np.arange(n, dtype=np.uint64)) * strides[d]
+        gi = gi[..., None] + offs.reshape((1,) * d + (n,))
+    if wpe == 1:
+        return gi.reshape(-1)
+    gi = gi.reshape(-1, 1) * np.uint64(wpe) + np.arange(wpe, dtype=np.uint64)
+    return gi.reshape(-1)
+
+
+def digest_array(a: np.ndarray, index=None, shape=None) -> Digest:
+    """Numpy oracle: digest of ``a``, or of the local block ``a`` sitting
+    at slice ``index`` of a logical tensor of ``shape``."""
+    a = np.asarray(a)
+    words, wpe = _as_words(a)
+    if index is None:
+        gi = np.arange(words.size, dtype=np.uint64)
+    else:
+        gi = _global_word_index(shape if shape is not None else a.shape,
+                                index, wpe)
+        if gi.size != words.size:
+            raise ValueError(
+                f"digest: block {a.shape} does not match slice {index} "
+                f"of {shape}")
+    s1 = int(words.sum() & _M32)
+    s2 = int((words * ((gi & _M32) + np.uint64(1) & _M32) & _M32).sum()
+             & _M32)
+    return (s1, s2)
+
+
+def combine_digests(parts: Iterable[Digest]) -> Digest:
+    """Combine per-shard partial digests of ONE tensor (each computed
+    with its own global offsets) into the full-tensor digest."""
+    s1 = s2 = 0
+    for p1, p2 in parts:
+        s1 = (s1 + p1) & 0xFFFFFFFF
+        s2 = (s2 + p2) & 0xFFFFFFFF
+    return (s1, s2)
+
+
+# ----------------------------------------------------------------------
+# jitted on-device digest
+
+
+@functools.lru_cache(maxsize=None)
+def _digest_program(shape: tuple, dtype_str: str, logical_shape: tuple):
+    """Compiled per-(local shape, dtype, logical shape) digest kernel;
+    slice ``starts`` ride as a traced vector so every shard of a leaf —
+    and every round — reuses one executable."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    dtype = np.dtype(dtype_str)
+    itemsize = dtype.itemsize
+    wpe = 2 if itemsize == 8 else 1
+    strides = np.ones(max(len(logical_shape), 1), np.uint32)
+    for d in range(len(logical_shape) - 2, -1, -1):
+        strides[d] = strides[d + 1] * np.uint32(logical_shape[d + 1])
+
+    def fn(x, starts):
+        if itemsize == 4:
+            w = lax.bitcast_convert_type(x, jnp.uint32)
+        elif itemsize == 2:
+            w = lax.bitcast_convert_type(x, jnp.uint16).astype(jnp.uint32)
+        elif itemsize == 1:
+            w = lax.bitcast_convert_type(x, jnp.uint8).astype(jnp.uint32)
+        else:  # itemsize 8 -> trailing (lo, hi) u32 axis
+            w = lax.bitcast_convert_type(x, jnp.uint32)
+        # global element index of every local element (u32 wraparound
+        # matches the oracle's u64-mod-2^32 by ring homomorphism)
+        gi = jnp.zeros(shape, jnp.uint32)
+        for d in range(len(shape)):
+            offs = starts[d] + lax.iota(jnp.uint32, shape[d])
+            gi = gi + jnp.expand_dims(
+                offs * jnp.uint32(strides[d]),
+                axis=tuple(i for i in range(len(shape)) if i != d))
+        if wpe == 2:
+            gi = gi[..., None] * jnp.uint32(2) + lax.iota(
+                jnp.uint32, 2)
+        w = w.reshape(-1)
+        gi = gi.reshape(-1)
+        s1 = jnp.sum(w, dtype=jnp.uint32)
+        s2 = jnp.sum(w * (gi + jnp.uint32(1)), dtype=jnp.uint32)
+        return jnp.stack([s1, s2])
+
+    return jax.jit(fn)
+
+
+def digest_device_array(x, index=None, shape=None) -> Digest:
+    """Digest a single-device jax array (one shard's ``.data``) on the
+    device that holds it.  ``index``/``shape`` place the block inside
+    its logical tensor (omit for a full replica)."""
+    lshape = tuple(int(s) for s in (shape if shape is not None else x.shape))
+    starts = np.zeros(max(len(x.shape), 1), np.uint32)
+    if index is not None:
+        for d, sl in enumerate(index):
+            starts[d] = np.uint32(sl.start or 0)
+    prog = _digest_program(tuple(int(s) for s in x.shape),
+                           np.dtype(x.dtype).str, lshape)
+    out = np.asarray(prog(x, starts[:max(len(x.shape), 1)]))
+    return (int(out[0]), int(out[1]))
+
+
+def digest_global(arr) -> Digest:
+    """Full-tensor digest of a (possibly sharded) jax array, combined
+    from one addressable replica of every distinct slice.  Requires all
+    slices addressable (single-process meshes / gathered arrays); the
+    cross-process path votes on per-shard digests instead."""
+    sh = getattr(arr, "sharding", None)
+    shards = getattr(arr, "addressable_shards", None)
+    if sh is None or not shards:
+        return digest_array(np.asarray(arr))
+    seen = {}
+    for s in sorted(shards, key=lambda s: s.device.id):
+        key = tuple((sl.start, sl.stop, sl.step) for sl in s.index)
+        if key not in seen:
+            seen[key] = digest_device_array(
+                s.data, index=s.index, shape=arr.shape)
+    return combine_digests(seen.values())

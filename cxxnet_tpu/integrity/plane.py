@@ -1,0 +1,306 @@
+"""Replica voting over state fingerprints + the shadow-step audit.
+
+The detection side of the integrity plane (doc/robustness.md
+"Integrity plane").  Every ``integrity_every`` rounds the trainer's
+live state — params and (when present) updater state — is digested
+per (leaf, device) with :mod:`.fingerprint` and the digests are voted:
+
+* **intra-process**: every local device holding a replica of the same
+  logical slice must agree bitwise;
+* **cross-process**: the per-rank digest blocks are allgathered (u32
+  words — no float transport, nothing to truncate) and every replica
+  of the same (leaf, slice) group must agree bitwise.
+
+Under ``det_reduce`` the train step is bitwise deterministic, so any
+disagreement IS corruption — there is no tolerance knob.  A strict
+majority names the corrupt minority replica and the verdict is a typed
+:class:`IntegrityError{rank, tensor, kind}`; the CLI turns that into
+elastic quarantine (the named rank is evicted, survivors reload the
+last *fingerprint-verified* checkpoint, so state poisoned by a corrupt
+rank's gradient contributions after the flip is discarded too).
+
+The **shadow-step audit** guards compute rather than state: the
+sampled round's grad program is re-traced into an independent second
+executable and both are executed on identical probe inputs; loss and
+every gradient leaf must match bitwise.  A deterministic miscompile
+that lowers the two traces differently (the PR-9 GSPMD concat class),
+or a flaky core that computes the same program differently twice,
+surfaces as ``kind="shadow"``.  (Two executables that miscompile
+*identically* are outside the threat model — that failure needs
+cross-hardware voting, which the state fingerprints provide at the
+next round boundary once the wrong values land in params.)
+
+The vote is computed from the full allgathered matrix on EVERY rank,
+so all ranks reach the identical verdict without an extra collective —
+the corrupt rank learns its own name and self-quarantines while the
+survivors evict it.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..obs import events as obs_events
+from ..obs.registry import registry as obs_registry
+from .fingerprint import Digest, digest_device_array, digest_array
+
+
+class IntegrityError(RuntimeError):
+    """Silent-data-corruption verdict.
+
+    ``rank`` is the corrupt process index when the vote named one
+    (None = ambiguous or local-only), ``tensor`` the first disagreeing
+    leaf, ``kind`` one of ``state`` (fingerprint vote), ``shadow``
+    (grad-program re-execution mismatch), ``canary`` (serve golden
+    probe mismatch)."""
+
+    def __init__(self, message: str, *, kind: str = "state",
+                 rank: Optional[int] = None,
+                 tensor: Optional[str] = None) -> None:
+        super().__init__(message)
+        self.kind = kind
+        self.rank = rank
+        self.tensor = tensor
+
+
+def _slice_key(index) -> tuple:
+    return tuple(
+        (s.start, s.stop, s.step) if isinstance(s, slice) else s
+        for s in index
+    )
+
+
+def _leaves(trainer):
+    """(name, array) over params + updater state, in the sorted order
+    every rank reproduces independently (the allgather contract)."""
+    for key in sorted(trainer.params):
+        for tag in sorted(trainer.params[key]):
+            yield f"{key}/{tag}", trainer.params[key][tag]
+    if trainer.save_ustate and trainer.ustates:
+        for key in sorted(trainer.ustates):
+            for tag in sorted(trainer.ustates[key]):
+                slots = trainer.ustates[key][tag]
+                for slot in sorted(slots):
+                    yield f"ust:{key}/{tag}@{slot}", slots[slot]
+
+
+def local_fingerprints(trainer) -> Tuple[List[Digest], List[tuple]]:
+    """Digest every (leaf, local device) shard.  Returns (rows, keys)
+    where ``keys[i] = (leaf_name, slice_key)`` — replicated leaves get
+    the full-extent slice, so one uniform group-by covers both the
+    replicated and the ZeRO-sharded layouts."""
+    rows: List[Digest] = []
+    keys: List[tuple] = []
+    for name, arr in _leaves(trainer):
+        shards = getattr(arr, "addressable_shards", None)
+        if not shards:
+            a = np.asarray(arr)
+            rows.append(digest_array(a))
+            keys.append((name, _slice_key(tuple(
+                slice(0, s, None) for s in a.shape))))
+            continue
+        for s in sorted(shards, key=lambda s: s.device.id):
+            rows.append(digest_device_array(
+                s.data, index=s.index, shape=arr.shape))
+            keys.append((name, _slice_key(s.index)))
+    return rows, keys
+
+
+def _peer_keys(trainer) -> List[tuple]:
+    """Recompute every process's (leaf, slice) key sequence from the
+    shardings' global device->slice maps — deterministic and identical
+    on every rank, so the allgathered digest block needs no key
+    transport."""
+    import jax
+
+    out: List[tuple] = []
+    per_leaf = []
+    for name, arr in _leaves(trainer):
+        sh = getattr(arr, "sharding", None)
+        shape = tuple(int(d) for d in np.shape(arr))
+        per_leaf.append((name, sh, shape))
+    for p in range(jax.process_count()):
+        for name, sh, shape in per_leaf:
+            if sh is None:
+                out.append((name, _slice_key(tuple(
+                    slice(0, s, None) for s in shape))))
+                continue
+            imap = sh.devices_indices_map(shape)
+            for d in sorted((d for d in imap if d.process_index == p),
+                            key=lambda d: d.id):
+                out.append((name, _slice_key(imap[d])))
+    return out
+
+
+def vote(groups: Dict[tuple, List[Tuple[int, Digest]]]) -> List[dict]:
+    """Majority vote within every (leaf, slice) replica group.
+
+    ``groups[key]`` is ``[(rank, digest), ...]``.  Returns findings:
+    one dict per disagreeing group with the named corrupt ``rank``
+    (the strict-minority holder) or ``rank=None`` when the group is
+    too small or too split to name one (2-way ties, 2-replica groups).
+    Single-replica groups are unvotable and always clean."""
+    findings: List[dict] = []
+    for (name, _sk), members in sorted(groups.items()):
+        if len(members) < 2:
+            continue
+        counts = collections.Counter(d for _r, d in members)
+        if len(counts) == 1:
+            continue
+        top, top_n = counts.most_common(1)[0]
+        if top_n * 2 > len(members):
+            bad = sorted({r for r, d in members if d != top})
+            findings.append({
+                "tensor": name,
+                "ranks": bad,
+                "rank": bad[0] if len(bad) == 1 else None,
+                "replicas": len(members),
+            })
+        else:
+            findings.append({
+                "tensor": name,
+                "ranks": sorted({r for r, _d in members}),
+                "rank": None,
+                "replicas": len(members),
+            })
+    return findings
+
+
+def check_state(trainer) -> dict:
+    """One fingerprint sweep + vote.  Returns the verdict dict
+    ``{"clean": bool, "findings": [...], "replicas": int,
+    "elapsed_s": float}``; identical on every rank (the vote runs on
+    the full allgathered matrix)."""
+    import jax
+
+    t0 = time.perf_counter()
+    rows, keys = local_fingerprints(trainer)
+    my_rank = jax.process_index()
+    groups: Dict[tuple, List[Tuple[int, Digest]]] = {}
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        block = np.asarray(rows, np.uint32).reshape(-1)
+        all_blocks = np.asarray(
+            multihost_utils.process_allgather(block)
+        ).reshape(jax.process_count(), -1, 2)
+        all_keys = _peer_keys(trainer)
+        if len(all_keys) != all_blocks.shape[0] * all_blocks.shape[1]:
+            raise IntegrityError(
+                "fingerprint/key count mismatch across processes "
+                f"({len(all_keys)} keys vs {all_blocks.shape} digests) "
+                "— ranks disagree on the state tree itself",
+                kind="state")
+        i = 0
+        for p in range(all_blocks.shape[0]):
+            for j in range(all_blocks.shape[1]):
+                d = (int(all_blocks[p, j, 0]), int(all_blocks[p, j, 1]))
+                groups.setdefault(all_keys[i], []).append((p, d))
+                i += 1
+    else:
+        for k, d in zip(keys, rows):
+            groups.setdefault(k, []).append((my_rank, d))
+    findings = vote(groups)
+    return {
+        "clean": not findings,
+        "findings": findings,
+        "replicas": max((len(g) for g in groups.values()), default=1),
+        "elapsed_s": time.perf_counter() - t0,
+    }
+
+
+class IntegrityPlane:
+    """Round-boundary integrity driver: cadence, metrics, events, and
+    the typed verdict.  One instance per LearnTask; survives trainer
+    rebuilds (the trainer is passed per call)."""
+
+    def __init__(self, every: int = 0, shadow: int = 0) -> None:
+        self.every = int(every)
+        self.shadow = int(shadow)
+        #: newest round whose post-round state passed the vote — the
+        #: quarantine rollback bound (survivors must NOT resume from a
+        #: checkpoint the corrupt rank's gradients already poisoned)
+        self.last_clean_round: Optional[int] = None
+        self.checks = 0
+        self.last_elapsed_s = 0.0
+
+    def due(self, round_: int) -> bool:
+        return self.every > 0 and (round_ + 1) % self.every == 0
+
+    # ------------------------------------------------------------------
+    def _count(self, kind: str, verdict: str) -> None:
+        obs_registry().counter(
+            "integrity_checks_total",
+            "Integrity-plane checks by kind and verdict.",
+            labelnames=("kind", "verdict"),
+        ).labels(kind=kind, verdict=verdict).inc()
+        if verdict != "clean":
+            obs_registry().counter(
+                "integrity_failures_total",
+                "Integrity-plane corruption verdicts.",
+                labelnames=("kind",),
+            ).labels(kind=kind).inc()
+
+    def _fail(self, kind: str, round_: int, *, rank=None, tensor=None,
+              detail: str = "") -> IntegrityError:
+        self._count(kind, "corrupt")
+        obs_registry().gauge(
+            "integrity_corrupt_rank",
+            "Process index named corrupt by the last vote (-1 none).",
+        ).set(-1 if rank is None else rank)
+        obs_events.emit("integrity.detect", kind=kind, round=round_,
+                        rank=rank, tensor=tensor, detail=detail)
+        return IntegrityError(
+            f"integrity {kind} check failed at round {round_}: "
+            f"{detail or 'replica digests disagree'}"
+            + (f" (corrupt rank {rank})" if rank is not None else "")
+            + (f" tensor {tensor}" if tensor else ""),
+            kind=kind, rank=rank, tensor=tensor)
+
+    # ------------------------------------------------------------------
+    def check_round(self, trainer, round_: int) -> Optional[dict]:
+        """Run the due checks for ``round_``; raises
+        :class:`IntegrityError` on a corruption verdict, updates
+        ``last_clean_round`` and emits ``integrity.clean`` otherwise."""
+        if not self.due(round_):
+            return None
+        self.checks += 1
+        verdict = check_state(trainer)
+        self.last_elapsed_s = verdict["elapsed_s"]
+        if not verdict["clean"]:
+            f = verdict["findings"][0]
+            raise self._fail(
+                "state", round_, rank=f["rank"], tensor=f["tensor"],
+                detail=(f"{len(verdict['findings'])} tensor(s) disagree, "
+                        f"first {f['tensor']} ranks {f['ranks']} "
+                        f"({f['replicas']} replicas)"))
+        self._count("state", "clean")
+        if self.shadow:
+            mismatch = trainer.shadow_step(round_)
+            if mismatch is not None:
+                raise self._fail("shadow", round_,
+                                 tensor=mismatch.get("tensor"),
+                                 detail=mismatch.get("detail", ""))
+            self._count("shadow", "clean")
+        self.last_clean_round = round_
+        obs_registry().gauge(
+            "integrity_corrupt_rank",
+            "Process index named corrupt by the last vote (-1 none).",
+        ).set(-1)
+        obs_events.emit("integrity.clean", round=round_,
+                        elapsed_s=round(verdict["elapsed_s"], 6),
+                        replicas=verdict["replicas"])
+        return verdict
+
+    def snapshot(self) -> dict:
+        """Telemetry block for the round record."""
+        return {
+            "every": self.every,
+            "checks": self.checks,
+            "last_clean_round": self.last_clean_round,
+            "last_elapsed_s": round(self.last_elapsed_s, 6),
+        }

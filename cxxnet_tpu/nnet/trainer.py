@@ -83,6 +83,12 @@ class NetTrainer:
         self.save_ustate = 0
         self.divergence_policy = ""  # "" off | "abort" | "rollback"
         self.inject_nan_step = -1  # fault-injection hook (tests only)
+        # finite loss-spike gate (integrity plane, doc/robustness.md):
+        # a finite loss > ratio * rolling-median trips DivergenceError
+        self.divergence_loss_ratio = 0.0   # 0 = off; else must be > 1
+        self.inject_spike_step = -1   # fault-injection hook (tests only)
+        self.inject_shadow_mismatch = 0  # one-shot shadow-audit hook
+        self._loss_window: List[float] = []  # recent finite losses
         # quantized inference (doc/performance.md "Quantized inference"):
         # quant_scheme is set when the params pytree holds reduced-
         # precision kernels (int8 codes + scales, or bf16 casts) — the
@@ -181,6 +187,26 @@ class NetTrainer:
             # fault-injection harness: treat the loss at this epoch as
             # NaN (one transient blow-up) so recovery paths are testable
             self.inject_nan_step = int(val)
+        elif name == "divergence_loss_ratio":
+            # finite loss-spike gate (doc/robustness.md): with
+            # divergence_policy set, a FINITE loss exceeding
+            # ratio * rolling-median of recent losses trips the same
+            # DivergenceError path NaN does — the PR-13 lesson that a
+            # blow-up can stay finite for many rounds.  0 disables.
+            r = float(val)
+            if r and r <= 1.0:
+                raise ValueError(
+                    f"divergence_loss_ratio={val}: must be > 1 "
+                    "(or 0 to disable)")
+            self.divergence_loss_ratio = r
+        elif name == "inject_spike_step":
+            # fault-injection harness: scale the loss at this epoch to
+            # a finite spike (one-shot), testing the loss-ratio gate
+            self.inject_spike_step = int(val)
+        elif name == "inject_shadow_mismatch":
+            # fault-injection harness: perturb the shadow executable's
+            # next comparison (one-shot), testing the shadow-audit path
+            self.inject_shadow_mismatch = int(val)
         elif name == "kernel_lib":
             # on-chip kernel library selector (ops/kernels/): validate
             # here so a typo fails at conf parse, then flow the value to
@@ -1105,17 +1131,57 @@ class NetTrainer:
             self.inject_nan_step = -1  # one-shot: a transient fault
             arr = arr.copy()
             arr[min(inj - first_epoch, max(arr.size - 1, 0))] = np.nan
+        inj = self.inject_spike_step
+        if inj >= 0 and first_epoch <= inj < first_epoch + n_steps:
+            self.inject_spike_step = -1  # one-shot: a transient spike
+            arr = arr.copy()
+            i = min(inj - first_epoch, max(arr.size - 1, 0))
+            # finite but far beyond any plausible ratio gate
+            arr[i] = max(abs(arr[i]), 1.0) * 1e6
         finite = np.isfinite(arr)
-        if finite.all():
+        if not finite.all():
+            bad = int(np.flatnonzero(~finite)[0])
+            epoch = first_epoch + min(bad, n_steps - 1)
+            raise DivergenceError(
+                f"divergence guard: non-finite loss {arr[bad]!r} at update "
+                f"{epoch} (round {self.round}, policy "
+                f"{self.divergence_policy or 'abort'})",
+                loss=arr, epoch=epoch,
+            )
+        self._guard_loss_ratio(arr, first_epoch)
+
+    _SPIKE_WINDOW = 32   # rolling finite-loss history length
+    _SPIKE_MIN_SAMPLES = 8   # gate stays disarmed until this many
+
+    def _guard_loss_ratio(self, arr: np.ndarray, first_epoch: int) -> None:
+        """Finite loss-spike gate (``divergence_loss_ratio``): a loss
+        exceeding ratio x the rolling median of recent finite losses is
+        a divergence verdict even though every value is finite — the
+        PR-13 staleness blow-up stayed finite for whole rounds.  The
+        spike itself is NOT admitted into the history (a genuine
+        blow-up must not drag the median up and re-legitimize itself);
+        the window rides the trainer, so a divergence rollback (which
+        rebuilds the trainer) restarts it cleanly disarmed."""
+        ratio = self.divergence_loss_ratio
+        if not ratio:
             return
-        bad = int(np.flatnonzero(~finite)[0])
-        epoch = first_epoch + min(bad, n_steps - 1)
-        raise DivergenceError(
-            f"divergence guard: non-finite loss {arr[bad]!r} at update "
-            f"{epoch} (round {self.round}, policy "
-            f"{self.divergence_policy or 'abort'})",
-            loss=arr, epoch=epoch,
-        )
+        hist = self._loss_window
+        for i, v in enumerate(arr):
+            v = float(v)
+            if len(hist) >= self._SPIKE_MIN_SAMPLES:
+                med = float(np.median(hist))
+                if abs(v) > ratio * max(abs(med), 1e-12):
+                    epoch = first_epoch + i
+                    raise DivergenceError(
+                        f"divergence guard: finite loss spike {v:g} > "
+                        f"{ratio:g} x rolling median {med:g} at update "
+                        f"{epoch} (round {self.round}, policy "
+                        f"{self.divergence_policy or 'abort'})",
+                        loss=arr, epoch=epoch,
+                    )
+            hist.append(v)
+            if len(hist) > self._SPIKE_WINDOW:
+                del hist[0]
 
     def weights_finite(self) -> bool:
         """True when every parameter tensor is free of NaN/Inf — the
@@ -1181,6 +1247,86 @@ class NetTrainer:
 
     def start_round(self, round_: int) -> None:
         self.round = round_
+        # integrity-plane chaos site (doc/robustness.md "Integrity
+        # plane"): a `bitflip` armed here flips a real bit in a live
+        # train-state tensor on THIS process — the injected silent data
+        # corruption the fingerprint vote must catch and quarantine
+        from ..utils.faults import fault_point
+
+        fault_point("device.state", self)
+
+    def inject_bitflip(self, rng) -> dict:
+        """Flip one bit of one element of one live parameter tensor —
+        the ``device.state:bitflip`` fault payload hook.  Deterministic
+        in ``rng`` (the spec's ``fault_seed``-derived stream): leaf
+        choice over the sorted param tree, then element, then bit, so a
+        chaos schedule replays to the same flipped bit.  The flip is
+        applied to exactly ONE addressable replica copy of the chosen
+        element (an rng-chosen local device — a single device-memory
+        fault), via per-device rewrite + reassembly under the original
+        sharding — a real in-memory corruption, not a simulated
+        verdict, and a strict minority the replica vote can name."""
+        assert self.params is not None, "init_model/load_model first"
+        leaves = [(f"{k}/{t}", k, t)
+                  for k in sorted(self.params)
+                  for t in sorted(self.params[k])]
+        name, key, tag = leaves[rng.randrange(len(leaves))]
+        arr = self.params[key][tag]
+        shape = tuple(int(d) for d in arr.shape)
+        n = int(np.prod(shape)) if shape else 1
+        elem = rng.randrange(n)
+        itembits = np.dtype(arr.dtype).itemsize * 8
+        bit = rng.randrange(min(itembits, 32))
+        shards = getattr(arr, "addressable_shards", None)
+        if not shards:
+            flat = np.asarray(arr).reshape(-1)
+            word = flat[elem:elem + 1].copy().view(
+                f"u{flat.dtype.itemsize}")
+            word ^= word.dtype.type(1 << bit)
+            flat = flat.copy()
+            flat[elem] = word.view(flat.dtype)[0]
+            self.params[key][tag] = jnp.asarray(flat.reshape(shape))
+        else:
+            coord = np.unravel_index(elem, shape) if shape else ()
+            ordered = sorted(shards, key=lambda s: s.device.id)
+            holders = []  # (position, local coordinate) of replicas
+            for pos, s in enumerate(ordered):
+                inside = True
+                lcoord = []
+                for d, sl in enumerate(s.index):
+                    start = sl.start or 0
+                    stop = sl.stop if sl.stop is not None else shape[d]
+                    if not (start <= coord[d] < stop):
+                        inside = False
+                        break
+                    lcoord.append(coord[d] - start)
+                if inside:
+                    holders.append((pos, tuple(lcoord)))
+            hit_pos, hit_coord = holders[rng.randrange(len(holders))]
+            pieces = []
+            hit_device = ordered[hit_pos].device
+            for pos, s in enumerate(ordered):
+                local = np.asarray(s.data)
+                if pos == hit_pos:
+                    local = local.copy()
+                    word = local[hit_coord].reshape(1).view(
+                        f"u{local.dtype.itemsize}")
+                    word ^= word.dtype.type(1 << bit)
+                    local[hit_coord] = word.view(local.dtype)[0]
+                pieces.append(jax.device_put(local, s.device))
+            self.params[key][tag] = (
+                jax.make_array_from_single_device_arrays(
+                    shape, arr.sharding, pieces))
+        detail = {
+            "tensor": name, "elem": int(elem), "bit": int(bit),
+            "process": jax.process_index(),
+            "device": (hit_device.id if shards else None),
+        }
+        if not self.silent:
+            print(f"[faults] bitflip injected: tensor={name} "
+                  f"elem={elem} bit={bit} device={detail['device']} "
+                  f"process={detail['process']}", flush=True)
+        return detail
 
     def sync(self) -> None:
         """Block until all dispatched device work is done (step timing).
@@ -1350,6 +1496,105 @@ class NetTrainer:
                 f"(tol {tol:g}) — replicated weights have diverged"
             )
         return max(dev, dev_sharded)
+
+    # ------------------------------------------------------------------
+    # shadow-step audit (integrity plane, doc/robustness.md)
+    def _shadow_fn(self, which: str):
+        """One of the TWO independently traced grad executables: same
+        python function, two separate ``jax.jit`` objects, so jax
+        traces and XLA compiles each from scratch.  A deterministic
+        miscompile that lowers the traces differently (the PR-9 GSPMD
+        concat class), or a core that computes the same executable
+        differently across runs, breaks the bitwise A/B compare."""
+        key = ("shadow", which)
+        if key not in self._jit_cache:
+            loss_and_out = self._loss_and_out
+
+            def f(params, aux, data, labels, mask, rng, step, extras):
+                (loss, (_out, _new_aux)), grads = jax.value_and_grad(
+                    lambda p: loss_and_out(
+                        p, aux, data, labels, mask, rng, step, extras
+                    ),
+                    has_aux=True,
+                )(params)
+                return loss, grads
+
+            rep, dsh, ex = self._sh()
+            psh, _ = self._param_sh()
+            self._jit_cache[key] = self._jit(
+                f, (psh, rep, dsh, dsh, dsh, rep, rep, ex), (rep, psh),
+                kind=f"shadow_{which}", data_arg=2,
+            )
+        return self._jit_cache[key]
+
+    @staticmethod
+    def _local_bytes(x) -> bytes:
+        """Concatenated bytes of the locally addressable data of ``x``
+        in device-id order — the unit of the bitwise A/B compare (works
+        for replicated, ZeRO-sharded, and host arrays alike)."""
+        shards = getattr(x, "addressable_shards", None)
+        if not shards:
+            return np.ascontiguousarray(np.asarray(x)).tobytes()
+        return b"".join(
+            np.ascontiguousarray(np.asarray(s.data)).tobytes()
+            for s in sorted(shards, key=lambda s: s.device.id))
+
+    def shadow_step(self, round_: int):
+        """Re-execute a sampled grad step through two independently
+        traced executables on identical probe inputs and compare loss +
+        every gradient leaf bitwise.  COLLECTIVE on a multi-process
+        mesh (both executions are SPMD programs; every rank must call
+        at the same round).  Returns None when the executions agree, a
+        ``{"tensor", "detail"}`` mismatch record otherwise.  Skipped
+        (returns None) for nets with extra input nodes — the probe
+        generator only commits the primary input."""
+        assert self.net is not None, "init_model/load_model first"
+        if self._n_extras():
+            return None
+        in_shape = self.net.input_node_shape(self.batch_size)
+        local_rows = self.batch_size // max(jax.process_count(), 1)
+        rng_np = np.random.RandomState(
+            (0x5AD0 ^ (round_ * 2654435761)) & 0x7FFFFFFF)
+        data_np = rng_np.random_sample(
+            (local_rows,) + tuple(in_shape[1:])).astype(np.float32)
+        label_np = np.zeros((local_rows, 1), np.float32)
+        mask_np = np.ones(local_rows, np.float32)
+        data, labels, mask, extras = self._transfer_batch(
+            data_np, label_np, mask_np, ())
+        rng = jax.random.PRNGKey(round_ & 0x7FFFFFFF)
+        step = jnp.asarray(self.epoch_counter, jnp.int32)
+        args_a = (self.params, self.aux, data, labels, mask, rng, step,
+                  extras)
+        loss_a, grads_a = self._shadow_fn("a")(*args_a)
+        # the second executable runs on a DIFFERENT device where one is
+        # free (trivial mesh + >1 local device): a per-core fault then
+        # shows up as A-vs-B instead of reproducing on both legs
+        dev_b = None
+        plan = self.mesh_plan
+        if ((plan is None or plan.n_devices == 1)
+                and len(jax.local_devices()) > 1):
+            dev_b = jax.local_devices()[1]
+        if dev_b is not None:
+            args_b = jax.device_put(args_a, dev_b)
+        else:
+            args_b = args_a
+        loss_b, grads_b = self._shadow_fn("b")(*args_b)
+        la, lb = self._local_bytes(loss_a), self._local_bytes(loss_b)
+        if self.inject_shadow_mismatch:
+            self.inject_shadow_mismatch = 0  # one-shot
+            lb = bytes([lb[0] ^ 0x10]) + lb[1:]
+        if la != lb:
+            return {"tensor": "loss",
+                    "detail": (f"shadow loss mismatch at round {round_}: "
+                               f"{la.hex()} vs {lb.hex()}")}
+        for key in sorted(grads_a):
+            for tag in sorted(grads_a[key]):
+                if (self._local_bytes(grads_a[key][tag])
+                        != self._local_bytes(grads_b[key][tag])):
+                    return {"tensor": f"{key}/{tag}",
+                            "detail": ("shadow grad mismatch at round "
+                                       f"{round_}: {key}/{tag}")}
+        return None
 
     def _next_rng(self) -> jax.Array:
         self._rng_key, sub = jax.random.split(self._rng_key)

@@ -507,6 +507,36 @@ class ElasticCoordinator:
                     self._plan_keys[key] = plan["gen"]
                 plan = self._plans[self._plan_keys[key]]
                 return {"ok": True, "plan": self._plan_for(plan, mid)}
+        if op == "evict":
+            # integrity quarantine: every survivor reports the SAME
+            # (rank, round) verdict, so the request is idempotent — the
+            # first caller mints the generation, the rest receive it
+            rank = int(req["rank"])
+            round_ = int(req["round"])
+            with self._lock:
+                key = ("evict", rank, round_)
+                if key not in self._plan_keys:
+                    target = [m for m in self._members.values()
+                              if m.rank == rank]
+                    if not target:
+                        # the quarantined rank already exited and the
+                        # monitor (or another trigger) dropped it —
+                        # hand back the current generation
+                        latest = self._plans.get(self._gen)
+                        if latest is None:
+                            raise ValueError(
+                                f"evict: rank {rank} unknown and no "
+                                "generation plan exists")
+                        self._plan_keys[key] = latest["gen"]
+                    else:
+                        obs_emit("mesh.integrity_evict", rank=rank,
+                                 round=round_, generation=self._gen)
+                        plan = self._bump_generation_locked(
+                            reason="integrity_evict", at_round=round_,
+                            drop_ranks=[rank])
+                        self._plan_keys[key] = plan["gen"]
+                plan = self._plans[self._plan_keys[key]]
+                return {"ok": True, "plan": self._plan_for(plan, mid)}
         if op == "ack":
             with self._lock:
                 m = self._members.get(mid)
@@ -686,6 +716,15 @@ class ElasticMember:
 
     def plan_shrink(self, round_: int) -> GenerationPlan:
         resp = self._rpc({"op": "plan_shrink", "round": int(round_)})
+        return GenerationPlan.from_wire(resp["plan"])
+
+    def plan_evict(self, rank: int, round_: int) -> GenerationPlan:
+        """Quarantine plan: drop ``rank`` (named corrupt by the
+        integrity vote at ``round_``) from the mesh.  Idempotent — all
+        survivors call this with the identical verdict and receive the
+        same generation."""
+        resp = self._rpc({"op": "evict", "rank": int(rank),
+                          "round": int(round_)})
         return GenerationPlan.from_wire(resp["plan"])
 
     def plan_grow(self, round_: int) -> Optional[GenerationPlan]:

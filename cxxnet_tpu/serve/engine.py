@@ -98,10 +98,20 @@ class Engine:
         # trainer quantizes on load (ungated — the trainer emits the
         # event).  Validation of the value happens in the trainer.
         self.quant = ""
+        # serve golden canary (doc/robustness.md "Integrity plane"):
+        # integrity_probe = 1 records the probe-score CRC at model load
+        # and periodically re-scores it — any drift on a frozen model
+        # is memory/compute corruption and degrades /healthz
+        self.integrity_probe = 0
         for _n, _v in self._cfg:
             if _n == "quant":
                 self.quant = ("" if _v in ("", "0", "off", "none")
                               else _v)
+            elif _n == "integrity_probe":
+                try:
+                    self.integrity_probe = int(_v)
+                except ValueError:
+                    pass
         # persistent XLA compile cache BEFORE the warmup compiles (and
         # before any hot-reload's fresh-trainer warm), so serve restarts
         # and reload warms reuse on-disk programs instead of re-jitting
@@ -217,6 +227,15 @@ class Engine:
         )
         self._closed = False
         self._export_weight_gauges()
+        # golden canary state: the probe batch, the CRC it must keep
+        # reproducing, and the sticky failure latch /healthz reports
+        self.inject_canary_mismatch = 0  # tests: corrupt the next N CRCs
+        self._canary_probe: Optional[np.ndarray] = None
+        self._canary_golden: Optional[int] = None
+        self._canary_src = ""
+        self._canary_failed = False
+        self._canary_runs = 0
+        self._canary_setup()
         from ..tune.controller import set_effective
 
         set_effective("max_batch_size", self.batcher.max_batch_size)
@@ -463,6 +482,9 @@ class Engine:
             self._row_shapes = self._allowed_row_shapes(tr)
             self._set_model(path, round_)
         self._export_weight_gauges()
+        # new model bytes: re-base the golden canary (and clear any
+        # integrity latch — a reload is the operator's recovery path)
+        self._canary_setup()
         obs_events.emit("serve.reload", ok=True, swapped=True,
                         round=round_, old_round=old_round, path=path)
         if not self.silent:
@@ -615,6 +637,126 @@ class Engine:
         return warmed
 
     # ------------------------------------------------------------------
+    # serve golden canary (doc/robustness.md "Integrity plane")
+    def _score_probe(self, probe: np.ndarray) -> int:
+        from ..integrity import canary as integ_canary
+
+        with self._model_lock:
+            cache = self._cache
+        return integ_canary.scores_crc(cache._run("out", None, probe))
+
+    def _canary_setup(self) -> None:
+        """(Re)base the golden at model load.  The manifest's ``probe``
+        block (written by the trainer under ``integrity_probe = 1``)
+        commits the probe batch; its recorded CRC is only binding when
+        this engine scores through the same pipeline class (same
+        backend, unquantized) AND reproduces it — a legitimate
+        pipeline difference (or a distinct predict program) re-bases
+        the golden to the load-time score with an
+        ``integrity.golden_rebased`` event instead of a false alarm.
+        Either way the periodic :meth:`check_canary` holds this frozen
+        model to the load-time answer bit-for-bit."""
+        if not self.integrity_probe:
+            return
+        import jax
+
+        from ..integrity import canary as integ_canary
+
+        self._canary_failed = False
+        block = None
+        if self._model_path is not None:
+            man = ckpt.read_manifest(self._model_path) or {}
+            block = man.get("probe")
+        if not isinstance(block, dict):
+            rows = max(1, min(8, self.max_batch_size))
+            block = integ_canary.make_probe_block(
+                0xC0FFEE, rows, tuple(self._row_shapes[0]), None,
+                jax.default_backend())
+        try:
+            probe = integ_canary.probe_batch(
+                block["seed"], block["rows"], tuple(block["shape"]))
+            crc_now = integ_canary.scores_crc(
+                self._cache._run("out", None, probe))
+        except Exception as e:  # noqa: BLE001 - canary must not block serve
+            obs_events.log_exception_once(
+                "serve.canary_setup", e, kind="integrity.error",
+                model=self._model_path)
+            self._canary_probe = None
+            self._canary_golden = None
+            return
+        binding = integ_canary.block_matches_pipeline(
+            block, backend=jax.default_backend(),
+            quant=bool(self.quant_scheme))
+        if binding and int(block["crc32"]) == crc_now:
+            src = "manifest"
+        else:
+            src = "local" if block.get("crc32") is None else "rebased"
+            if src == "rebased":
+                obs_events.emit(
+                    "integrity.golden_rebased", round=self._round,
+                    model=self._model_path,
+                    manifest_crc32=block.get("crc32"),
+                    local_crc32=crc_now, binding=binding,
+                    backend=jax.default_backend(),
+                    quant=self.quant_scheme or "f32")
+        self._canary_probe = probe
+        self._canary_golden = crc_now
+        self._canary_src = src
+
+    def check_canary(self) -> bool:
+        """One golden comparison: re-score the committed probe batch
+        and compare its CRC against the load-time golden bitwise.  The
+        model bytes and the predict program are frozen between
+        reloads, so ANY drift is memory or compute corruption: the
+        failure latch degrades ``/healthz`` with ``integrity_failed``
+        (the fleet supervisor ejects the replica from rotation without
+        killing it) and clears on the next clean score or model
+        reload.  Returns True when clean or disabled; never raises."""
+        if self._canary_golden is None or self._canary_probe is None:
+            return True
+        from ..obs.registry import registry as obs_registry
+
+        try:
+            crc = self._score_probe(self._canary_probe)
+        except Exception as e:  # noqa: BLE001 - degrade, don't die
+            obs_events.log_exception_once(
+                "serve.canary", e, kind="integrity.error",
+                model=self._model_path)
+            return True
+        if self.inject_canary_mismatch > 0:
+            self.inject_canary_mismatch -= 1
+            crc ^= 0x1
+        self._canary_runs += 1
+        clean = crc == self._canary_golden
+        obs_registry().counter(
+            "integrity_checks_total",
+            "Integrity-plane checks by kind and verdict.",
+            labelnames=("kind", "verdict"),
+        ).labels(kind="canary",
+                 verdict="clean" if clean else "corrupt").inc()
+        if clean:
+            if self._canary_failed:
+                self._canary_failed = False
+                obs_events.emit("integrity.clean", kind="canary",
+                                round=self._round, crc32=crc)
+            return True
+        first = not self._canary_failed
+        self._canary_failed = True
+        obs_registry().counter(
+            "integrity_failures_total",
+            "Integrity-plane corruption verdicts.",
+            labelnames=("kind",),
+        ).labels(kind="canary").inc()
+        obs_events.emit("integrity.detect", kind="canary",
+                        round=self._round, model=self._model_path,
+                        golden_crc32=self._canary_golden, crc32=crc)
+        if first and not self.silent:
+            print(f"serve: integrity canary FAILED (golden "
+                  f"{self._canary_golden:#010x} != {crc:#010x}); "
+                  "/healthz degraded integrity_failed", flush=True)
+        return False
+
+    # ------------------------------------------------------------------
     # introspection
     @property
     def round(self) -> int:
@@ -669,6 +811,12 @@ class Engine:
             reasons.append("reload_breaker_open")
         if rebuilding:
             reasons.append("mesh_rebuilding")
+        if self._canary_failed:
+            # golden canary drift (integrity plane): the replica still
+            # answers, but its compute can no longer be trusted — the
+            # fleet supervisor ejects it from rotation without killing
+            # it and readmits it after a clean canary
+            reasons.append("integrity_failed")
         reasons.extend(f"alert:{name}" for name in firing)
         with self._model_lock:
             status = ("closed" if self._closed
@@ -724,6 +872,14 @@ class Engine:
                 agg[b] = agg.get(b, 0) + c
         out["request_buckets"] = {str(k): v for k, v in sorted(agg.items())}
         out["reload_breaker"] = self.reload_breaker.snapshot()
+        if self.integrity_probe:
+            out["integrity"] = {
+                "probe": 1,
+                "golden_crc32": self._canary_golden,
+                "golden_src": self._canary_src,
+                "runs": self._canary_runs,
+                "failed": self._canary_failed,
+            }
         return out
 
     # ------------------------------------------------------------------

@@ -81,9 +81,12 @@ __all__ = [
 
 #: replica states.  HEALTHY and SLOW are in rotation; everything else
 #: is not.  SLOW = missed probes below the wedge threshold (transient
-#: blips must not empty the front door); WEDGED = ejected + restarting.
-STATES = ("starting", "healthy", "slow", "wedged", "gone", "backoff",
-          "failed", "stopped")
+#: blips must not empty the front door); WEDGED = ejected + restarting;
+#: QUARANTINED = answering but integrity-degraded (golden canary
+#: mismatch) — ejected from rotation, NOT killed, readmitted by the
+#: next clean probe.
+STATES = ("starting", "healthy", "slow", "quarantined", "wedged",
+          "gone", "backoff", "failed", "stopped")
 IN_ROTATION = ("healthy", "slow")
 
 
@@ -473,20 +476,35 @@ class ReplicaSupervisor:
     def _on_probe_ok(self, r: Replica, body: dict) -> None:
         with self._lock:
             was = r.state
-            r.state = "healthy"
+            reasons = [str(x) for x in (body.get("reasons") or ())]
+            # integrity quarantine (doc/robustness.md "Integrity
+            # plane"): a replica whose golden canary failed still
+            # ANSWERS, but its compute cannot be trusted — eject it
+            # from rotation WITHOUT killing it (its canary keeps
+            # running and a later clean score readmits it; a restart
+            # would land on the same possibly-bad device anyway)
+            quarantined = "integrity_failed" in reasons
+            r.state = "quarantined" if quarantined else "healthy"
             r.consecutive_fail = 0
             r.last_status = str(body.get("status", "ok"))
             if body.get("round") is not None:
                 r.last_round = int(body["round"])
             r.last_model = body.get("model")
-            r.reasons = [str(x) for x in (body.get("reasons") or ())]
+            r.reasons = reasons
             came_back = r.down_since is not None
             if came_back:
                 wall = time.monotonic() - r.down_since
                 r.down_since = None
                 self.last_restart_wall_s = wall
             r.backoff_s = self.opts.restart_backoff_s
-        if was != "healthy":
+        if quarantined and was != "quarantined":
+            obs_events.emit("fleet.replica_quarantined", replica=r.idx,
+                            role=r.role, port=r.port,
+                            round=r.last_round, reasons=reasons)
+        elif not quarantined and was == "quarantined":
+            obs_events.emit("fleet.replica_readmitted", replica=r.idx,
+                            role=r.role, port=r.port, round=r.last_round)
+        elif not quarantined and was != "healthy":
             obs_events.emit("fleet.replica_up", replica=r.idx,
                             role=r.role, port=r.port, round=r.last_round,
                             restarts=r.restarts)

@@ -435,14 +435,19 @@ def serve_forever(
     reloader = None
     # the poll covers every routed engine (multi-tenant servers reload
     # each tenant's model_dir), falling back to the identity engine
-    poll_engines = [e for e in (router.engines() if router is not None
-                                else [engine])
-                    if e.model_dir is not None]
-    if reload_period_s > 0 and poll_engines:
+    all_engines = (router.engines() if router is not None else [engine])
+    poll_engines = [e for e in all_engines if e.model_dir is not None]
+    # the integrity canary (doc/robustness.md "Integrity plane") rides
+    # the same cadence: re-score the golden probe between reload polls
+    canary_engines = [e for e in all_engines
+                      if getattr(e, "integrity_probe", 0)]
+    if reload_period_s > 0 and (poll_engines or canary_engines):
         def _poll():
             while not stop.wait(reload_period_s):
                 for e in poll_engines:
                     e.try_reload()  # breaker-gated; never raises
+                for e in canary_engines:
+                    e.check_canary()  # latches /healthz; never raises
 
         reloader = threading.Thread(
             target=_poll, name="cxxnet-serve-reload", daemon=True

@@ -22,6 +22,10 @@ ways the supervisor must survive:
   the probe deadline.
 * ``--round-file`` — ``POST /reloadz`` re-reads the round from this
   file (the rolling-reload rendezvous without a real checkpoint).
+* ``POST /integrity`` — ``{"failed": true|false}`` toggles the golden-
+  canary failure latch: ``/healthz`` degrades with the
+  ``integrity_failed`` reason token (the supervisor quarantines the
+  replica — ejected, not killed — and readmits it once cleared).
 
 Predictions are a pure function of the input row (sum of the row,
 scaled, mod 7, plus the disagree offset) so two healthy stubs always
@@ -58,6 +62,7 @@ def main() -> int:
     state = {
         "round": args.round,
         "wedged": bool(args.wedge),
+        "integrity_failed": False,
         "requests": 0,
         "predicts": 0,
         "reloads": 0,
@@ -101,15 +106,17 @@ def main() -> int:
             self._enter()
             if self.path == "/healthz":
                 with lock:
+                    reasons = (["integrity_failed"]
+                               if state["integrity_failed"] else [])
                     self._reply(200, {
-                        "status": "ok",
+                        "status": "degraded" if reasons else "ok",
                         "round": state["round"],
                         "model": args.model,
                         "model_crc32": 0,
                         "net_fp": "stub",
                         "quant": args.quant,
                         "reload_breaker": "closed",
-                        "reasons": [],
+                        "reasons": reasons,
                     })
             elif self.path == "/statsz":
                 with lock:
@@ -131,6 +138,15 @@ def main() -> int:
                 with lock:
                     state["wedged"] = True
                 self._reply(200, {"ok": True})
+            elif self.path == "/integrity":
+                # fleet tests: toggle the golden-canary failure latch —
+                # /healthz then degrades (or clears) integrity_failed,
+                # the eject-without-kill + readmit path
+                with lock:
+                    state["integrity_failed"] = bool(
+                        obj.get("failed", True))
+                self._reply(200, {"ok": True,
+                                  "failed": state["integrity_failed"]})
             elif self.path == "/reloadz":
                 new = read_round_file()
                 with lock:

@@ -135,6 +135,7 @@ def write_manifest(
     blob: Optional[bytes] = None,
     mesh: Optional[dict] = None,
     quant: Optional[dict] = None,
+    probe: Optional[dict] = None,
 ) -> dict:
     """Write the sidecar manifest for an already-written checkpoint.
 
@@ -150,7 +151,13 @@ def write_manifest(
     this" without loading it.  ``quant`` (``{"scheme", "scales_dtype",
     "int8_layers", "bf16_layers", ...}``) marks a quantized inference
     artifact (``nnet/quant.py``) — absent on ordinary f32 checkpoints,
-    so tooling can tell the two apart without parsing the payload."""
+    so tooling can tell the two apart without parsing the payload.
+    ``probe`` (``{"seed", "rows", "shape", "backend", "crc32"?}``)
+    commits the integrity plane's golden-canary probe batch (a
+    deterministic spec, plus — when the writer scored it — the golden
+    score CRC): the serving engine re-derives the batch from the spec,
+    scores it, and holds its own compute to the recorded answer for
+    the lifetime of the load (doc/robustness.md "Integrity plane")."""
     if blob is not None:
         crc, size = crc32_of(blob), len(blob)
     else:
@@ -168,6 +175,8 @@ def write_manifest(
         man["mesh"] = mesh
     if quant is not None:
         man["quant"] = quant
+    if probe is not None:
+        man["probe"] = probe
     atomic_write_bytes(
         manifest_path(model_path),
         (json.dumps(man, indent=1) + "\n").encode("utf-8"),
@@ -185,6 +194,7 @@ def write_checkpoint(
     silent: bool = True,
     mesh: Optional[dict] = None,
     quant: Optional[dict] = None,
+    probe: Optional[dict] = None,
 ) -> None:
     """THE checkpoint write discipline — atomic payload write, then the
     sidecar manifest — shared by every writer (``NetTrainer.save_model``
@@ -198,7 +208,7 @@ def write_checkpoint(
     def _manifest():
         write_manifest(path, round_=round_, net_fp=net_fp,
                        save_ustate=save_ustate, blob=blob, mesh=mesh,
-                       quant=quant)
+                       quant=quant, probe=probe)
 
     from ..obs import emit as obs_emit
     from ..obs import trace as obs_trace

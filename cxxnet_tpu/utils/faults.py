@@ -121,9 +121,17 @@ SITES: Dict[str, Tuple[str, ...]] = {
     # (the process exits; the supervisor must restart it with backoff)
     # — doc/robustness.md, doc/serving.md "Serving fleet"
     "serve.replica": ("hang", "ioerror"),
+    # live train state (nnet/trainer.py::start_round): bitflip = a real
+    # single-bit flip in a live parameter tensor on THIS process — the
+    # silent data corruption the integrity plane's fingerprint vote
+    # must detect, name, and quarantine (doc/robustness.md "Integrity
+    # plane").  Deterministic by fault_seed: the spec's RNG picks
+    # tensor, element, and bit (trainer.inject_bitflip)
+    "device.state": ("bitflip",),
 }
 
-KINDS = ("ioerror", "corrupt", "latency", "hang", "enospc", "short")
+KINDS = ("ioerror", "corrupt", "latency", "hang", "enospc", "short",
+         "bitflip")
 
 
 class InjectedFault(OSError):
@@ -356,6 +364,16 @@ class FaultInjector:
                     raise InjectedCorruption(
                         f"injected corruption at {site}"
                     )
+            elif fs.kind == "bitflip":
+                # live-state corruption: the payload (a NetTrainer)
+                # flips a real bit in one of its tensors — duck-typed
+                # so the site stays decoupled from nnet internals
+                if payload is None or not hasattr(payload,
+                                                  "inject_bitflip"):
+                    raise InjectedCorruption(
+                        f"bitflip at {site}: payload has no "
+                        "inject_bitflip hook")
+                payload.inject_bitflip(rng)
             elif fs.kind == "enospc":
                 raise InjectedDiskFull(site)
             elif fs.kind == "short":

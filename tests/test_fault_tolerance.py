@@ -202,6 +202,29 @@ def test_divergence_rollback_policy(tmp_path):
     assert len(lines) == 4  # every round reported exactly once
 
 
+def test_loss_spike_gate_rollback(tmp_path):
+    """A FINITE loss explosion (inject_spike_step: x1e6 at update 9)
+    trips the ``divergence_loss_ratio`` rolling-median gate even
+    though every value passes the non-finite check — the staleness
+    blow-up class that stays finite for whole rounds.  The existing
+    rollback + lr-backoff path recovers and the run completes."""
+    conf = make_conf(
+        tmp_path, num_round=4,
+        extra=("divergence_policy = rollback\n"
+               "divergence_lr_backoff = 0.5\n"
+               "divergence_loss_ratio = 50\n"
+               "inject_spike_step = 9"),
+    )
+    r = run_cli([conf], str(tmp_path))
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "DIVERGENCE" in r.stdout
+    assert "finite loss spike" in r.stdout
+    assert "rolled back to round 2" in r.stdout
+    assert "lr scale now 0.5" in r.stdout
+    # training recovered and ran to completion
+    assert "0004.model" in _models(tmp_path)
+
+
 def _poison_weights(path):
     """Rewrite a checkpoint with NaN in its first weight tensor and a
     MATCHING manifest — CRC-valid, numerically poisoned (models the

@@ -1070,6 +1070,70 @@ def _scn_serve_replica(kind, tmp_path):
         fleet.close(drain_timeout_s=0.5)
 
 
+def _scn_device_state(kind, tmp_path):
+    """A real single-bit flip in live parameter state must be DETECTED
+    (replica fingerprint vote), NAMED (tensor + strict-minority
+    replica) and TYPED — never a silent wrong answer.  The flip lands
+    at the ``device.state`` fault point (trainer.start_round) through
+    the trainer's own ``inject_bitflip``; the integrity plane's next
+    check raises ``IntegrityError{kind="state"}``.  The spec RNG is
+    seeded by ``fault_seed``, so the same seed names the same tensor
+    on a fresh trainer (the replayable-corruption contract)."""
+    assert kind == "bitflip"
+    from cxxnet_tpu.integrity import IntegrityError, IntegrityPlane
+    from cxxnet_tpu.integrity.plane import check_state
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    cfg = [
+        ("dev", "tpu:0-3"), ("batch_size", "8"),
+        ("input_shape", "1,1,16"), ("seed", "7"), ("eta", "0.1"),
+        ("eval_train", "0"), ("det_reduce", "1"), ("silent", "1"),
+        ("netconfig", "start"),
+        ("layer[0->1]", "fullc:fc1"), ("nhidden", "16"),
+        ("layer[1->2]", "sigmoid"),
+        ("layer[2->3]", "fullc:fc2"), ("nhidden", "4"),
+        ("layer[3->3]", "softmax"),
+        ("netconfig", "end"),
+    ]
+    rng = np.random.RandomState(3)
+    batch = DataBatch(data=rng.randn(8, 16).astype(np.float32),
+                      label=rng.randint(0, 4, (8, 1)).astype(np.float32))
+
+    def build():
+        tr = NetTrainer()
+        tr.set_params(cfg)
+        tr.init_model()
+        tr.update(batch)
+        tr.sync()
+        return tr
+
+    tr = build()
+    assert check_state(tr)["clean"]  # pre-fault baseline
+    faults.injector().seed = 9
+    spec = faults.install("device.state:bitflip:1:1")
+    tr.start_round(1)  # the armed fault point fires here
+    assert spec.fired == 1
+    verdict = check_state(tr)
+    assert not verdict["clean"]
+    named = [f["tensor"] for f in verdict["findings"]]
+    assert verdict["findings"][0]["replicas"] == 4
+    plane = IntegrityPlane(every=1)
+    with pytest.raises(IntegrityError) as ei:
+        plane.check_round(tr, 0)
+    assert ei.value.kind == "state"
+    assert ei.value.tensor in named
+    faults.reset()
+    # determinism: fresh trainer + same fault_seed → the SAME tensor
+    # is corrupted and named (the corruption schedule is replayable)
+    tr2 = build()
+    faults.injector().seed = 9
+    faults.install("device.state:bitflip:1:1")
+    tr2.start_round(1)
+    v2 = check_state(tr2)
+    assert [f["tensor"] for f in v2["findings"]] == named
+
+
 MATRIX = [
     pytest.param(site, kind, id=f"{site}-{kind}",
                  marks=[pytest.mark.chaos])
@@ -1111,5 +1175,7 @@ def test_fault_matrix(site, kind, tmp_path):
         _scn_mesh_replica(kind, tmp_path)
     elif site == "serve.replica":
         _scn_serve_replica(kind, tmp_path)
+    elif site == "device.state":
+        _scn_device_state(kind, tmp_path)
     else:  # a new site without a scenario must fail the matrix
         pytest.fail(f"no chaos scenario for registered site {site!r}")

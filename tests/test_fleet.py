@@ -314,6 +314,72 @@ def test_kill_one_of_three_zero_nonshed_failures():
 
 
 # ----------------------------------------------------------------------
+# integrity quarantine (eject WITHOUT killing, readmit on clean canary)
+def test_integrity_quarantine_ejects_without_kill_then_readmits():
+    """A replica whose golden canary fails (healthz reason
+    ``integrity_failed``) must leave the rotation but keep its process:
+    a restart would land on the same possibly-bad device, and the
+    still-running canary is what readmits it after a clean score."""
+    from cxxnet_tpu.obs import events as obs_events
+
+    opts = make_opts(replicas=3, probe_period_s=0.1)
+    fleet = start_stub_fleet(opts)
+    try:
+        victim = fleet.supervisor.replicas[1]
+        pid_before = victim.pid
+        restarts_before = victim.restarts
+
+        def stub_post(path, obj):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{victim.port}{path}",
+                data=json.dumps(obj).encode("utf-8"),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read().decode("utf-8"))
+
+        # 1. degrade the replica's canary -> supervisor quarantines it
+        assert stub_post("/integrity", {"failed": True})["failed"]
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            fleet.supervisor.probe_once()
+            if victim.state == "quarantined":
+                break
+            time.sleep(0.05)
+        assert victim.state == "quarantined"
+        assert victim not in fleet.supervisor.rotation()
+        assert "integrity_failed" in victim.reasons
+        # the fleet front door stays up on the two clean replicas
+        s, body = fleet.router.route("/predict", {"data": [[0.2] * 4]})
+        assert s == 200, body
+        # ejected, NOT killed: same process, no restart, still answering
+        assert victim.pid == pid_before
+        assert victim.restarts == restarts_before
+        assert victim.proc.poll() is None
+        assert [e for e in obs_events.recent(
+            200, kind="fleet.replica_quarantined")
+            if e.get("replica") == victim.idx]
+
+        # 2. canary comes back clean -> readmitted, same process
+        assert not stub_post("/integrity", {"failed": False})["failed"]
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            fleet.supervisor.probe_once()
+            if victim.state == "healthy":
+                break
+            time.sleep(0.05)
+        assert victim.state == "healthy"
+        assert victim in fleet.supervisor.rotation()
+        assert victim.pid == pid_before
+        assert victim.restarts == restarts_before
+        assert [e for e in obs_events.recent(
+            200, kind="fleet.replica_readmitted")
+            if e.get("replica") == victim.idx]
+    finally:
+        fleet.close(drain_timeout_s=0.0)
+
+
+# ----------------------------------------------------------------------
 # rolling reload
 def test_rolling_reload_walks_rotation(tmp_path):
     round_file = tmp_path / "round.txt"

@@ -1,0 +1,351 @@
+"""Integrity-plane tests: fingerprints, replica vote, shadow audit,
+serve golden canary.
+
+The cross-process paths (allgather vote, quarantine + elastic rebuild)
+are covered by the SDC=1 tier-1 lane (``tools/sdc_smoke.py``) and the
+chaos matrix (``tests/test_faults.py`` ``device.state:bitflip``); this
+file owns the in-process units: the digest algebra, the vote, the
+IntegrityPlane driver, the trainer's shadow re-execution, and the
+engine's golden-canary lifecycle against real checkpoints.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import config as cfgmod
+from cxxnet_tpu import serve
+from cxxnet_tpu.integrity import canary
+from cxxnet_tpu.integrity.fingerprint import (
+    combine_digests,
+    digest_array,
+    digest_device_array,
+)
+from cxxnet_tpu.integrity.plane import (
+    IntegrityError,
+    IntegrityPlane,
+    check_state,
+    vote,
+)
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.obs import events as obs_events
+from cxxnet_tpu.utils import checkpoint as ckpt
+
+MLP_CFG = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+1:a1] = relu:a1
+layer[a1->out] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 32
+dev = cpu
+eta = 0.1
+"""
+
+
+def make_trainer(seed=0, cfg=MLP_CFG, extra=()):
+    tr = NetTrainer()
+    tr.set_params(cfgmod.parse_pairs(cfg))
+    tr.set_param("seed", str(seed))
+    for n, v in extra:
+        tr.set_param(n, v)
+    tr.init_model()
+    return tr
+
+
+def _flip_bit(a: np.ndarray, elem: int, bit: int) -> np.ndarray:
+    out = a.copy().reshape(-1)
+    w = out[elem:elem + 1].view(f"u{out.dtype.itemsize}")
+    w ^= w.dtype.type(1 << bit)
+    return out.reshape(a.shape)
+
+
+# ----------------------------------------------------------------------
+# digest algebra
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int32",
+                                   "uint8", "float16"])
+def test_digest_detects_every_single_bitflip_smallarray(dtype):
+    """Exhaustive over a small tensor: EVERY single-bit flip changes
+    the digest — the no-false-negative core of the SDC sentinel."""
+    rng = np.random.RandomState(7)
+    a = (rng.randn(3, 5) * 8).astype(dtype)
+    base = digest_array(a)
+    itembits = a.dtype.itemsize * 8
+    for elem in range(a.size):
+        for bit in range(itembits):
+            assert digest_array(_flip_bit(a, elem, bit)) != base, (
+                f"{dtype}: flip elem={elem} bit={bit} went undetected")
+
+
+def test_digest_combine_of_slices_equals_whole():
+    rng = np.random.RandomState(3)
+    a = rng.randn(8, 6).astype(np.float32)
+    whole = digest_array(a)
+    parts = [
+        digest_array(a[0:3], index=(slice(0, 3), slice(0, 6)),
+                     shape=a.shape),
+        digest_array(a[3:8], index=(slice(3, 8), slice(0, 6)),
+                     shape=a.shape),
+    ]
+    assert combine_digests(parts) == whole
+    # order-invariant (modular sums): any shard arrival order agrees
+    assert combine_digests(reversed(parts)) == whole
+    # column split too (non-contiguous blocks, strided global indices)
+    cols = [
+        digest_array(a[:, 0:2], index=(slice(0, 8), slice(0, 2)),
+                     shape=a.shape),
+        digest_array(a[:, 2:6], index=(slice(0, 8), slice(2, 6)),
+                     shape=a.shape),
+    ]
+    assert combine_digests(cols) == whole
+
+
+def test_digest_is_position_sensitive():
+    """s2's index weighting catches element swaps that a plain modular
+    sum (s1) cannot."""
+    a = np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)
+    b = np.asarray([2.0, 1.0, 3.0, 4.0], np.float32)
+    da, db = digest_array(a), digest_array(b)
+    assert da[0] == db[0]  # same multiset of words
+    assert da[1] != db[1]  # different placement
+
+
+def test_digest_device_array_matches_numpy_oracle():
+    import jax.numpy as jnp
+
+    a = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+    assert digest_device_array(jnp.asarray(a)) == digest_array(a)
+
+
+def test_digest_rejects_mismatched_block():
+    a = np.zeros((2, 2), np.float32)
+    with pytest.raises(ValueError, match="does not match"):
+        digest_array(a, index=(slice(0, 3), slice(0, 2)), shape=(4, 2))
+
+
+# ----------------------------------------------------------------------
+# the vote
+def _grp(name, members):
+    return {(name, ((0, 4, None),)): members}
+
+
+def test_vote_names_strict_minority_rank():
+    good, bad = (11, 22), (11, 23)
+    findings = vote(_grp("w", [(0, good), (1, good), (2, bad), (3, good)]))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f["tensor"] == "w" and f["rank"] == 2 and f["ranks"] == [2]
+    assert f["replicas"] == 4
+
+
+def test_vote_two_way_tie_names_no_rank():
+    findings = vote(_grp("w", [(0, (1, 1)), (1, (2, 2))]))
+    assert len(findings) == 1
+    assert findings[0]["rank"] is None
+    assert findings[0]["ranks"] == [0, 1]
+    # 2-2 split on four replicas: corrupt, but unattributable
+    findings = vote(_grp("w", [(0, (1, 1)), (1, (1, 1)),
+                               (2, (2, 2)), (3, (2, 2))]))
+    assert len(findings) == 1 and findings[0]["rank"] is None
+
+
+def test_vote_unanimous_and_singleton_are_clean():
+    assert vote(_grp("w", [(0, (5, 5)), (1, (5, 5)), (2, (5, 5))])) == []
+    assert vote(_grp("w", [(0, (5, 5))])) == []
+
+
+def test_vote_multiple_bad_replicas_unnamed():
+    """Two corrupt minority holders with DIFFERENT digests: the group
+    is flagged but no single rank can be named."""
+    findings = vote(_grp("w", [(0, (1, 1)), (1, (1, 1)), (2, (1, 1)),
+                               (3, (7, 7)), (4, (8, 8))]))
+    assert len(findings) == 1
+    assert findings[0]["rank"] is None and findings[0]["ranks"] == [3, 4]
+
+
+# ----------------------------------------------------------------------
+# trainer state sweep + IntegrityPlane driver
+def test_check_state_clean_then_bitflip_caught_on_mesh():
+    """Replicated params on a 4-device trivial mesh: a single injected
+    bit flip on ONE device copy turns the sweep's verdict and the
+    plane raises the typed error naming the tensor."""
+    import random
+
+    tr = make_trainer(extra=(("dev", "tpu:0-3"),))
+    assert check_state(tr)["clean"]
+    plane = IntegrityPlane(every=2)
+    assert not plane.due(0) and plane.due(1)
+    assert plane.check_round(tr, 0) is None  # off-cadence: no sweep
+    v = plane.check_round(tr, 1)
+    assert v is not None and v["clean"] and v["replicas"] == 4
+    assert plane.last_clean_round == 1
+    flipped = tr.inject_bitflip(random.Random(5))
+    verdict = check_state(tr)
+    assert not verdict["clean"]
+    assert any(f["tensor"] == flipped["tensor"]
+               for f in verdict["findings"])
+    with pytest.raises(IntegrityError) as ei:
+        plane.check_round(tr, 3)
+    assert ei.value.kind == "state"
+    assert ei.value.tensor == flipped["tensor"]
+    assert plane.last_clean_round == 1  # the poisoned round never counts
+    assert plane.snapshot()["checks"] == 2  # off-cadence sweeps don't count
+
+
+def test_shadow_step_clean_and_injected_mismatch():
+    tr = make_trainer()
+    assert tr.shadow_step(4) is None  # two traces, bitwise-equal grads
+    plane = IntegrityPlane(every=1, shadow=1)
+    assert plane.check_round(tr, 0)["clean"]
+    tr.set_param("inject_shadow_mismatch", "1")
+    with pytest.raises(IntegrityError) as ei:
+        plane.check_round(tr, 1)
+    assert ei.value.kind == "shadow" and ei.value.tensor == "loss"
+    assert tr.inject_shadow_mismatch == 0  # one-shot: next check clean
+    assert plane.check_round(tr, 2)["clean"]
+
+
+# ----------------------------------------------------------------------
+# canary primitives
+def test_probe_batch_deterministic():
+    a = canary.probe_batch(0xC0FFEE, 4, (1, 1, 16))
+    b = canary.probe_batch(0xC0FFEE, 4, (1, 1, 16))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (4, 1, 1, 16) and a.dtype == np.float32
+    assert not np.array_equal(a, canary.probe_batch(0xC0FFED, 4, (1, 1, 16)))
+
+
+def test_scores_crc_is_bit_and_shape_sensitive():
+    s = np.arange(12, dtype=np.float32)
+    assert canary.scores_crc(s) == canary.scores_crc(s.copy())
+    assert canary.scores_crc(s) != canary.scores_crc(_flip_bit(s, 3, 0))
+    # same bytes, different shape: still distinguished (shape header)
+    assert (canary.scores_crc(s.reshape(3, 4))
+            != canary.scores_crc(s.reshape(4, 3)))
+
+
+def test_block_matches_pipeline_gates():
+    blk = canary.make_probe_block(1, 4, (16,), 0xABCD, "cpu")
+    assert canary.block_matches_pipeline(blk, backend="cpu", quant=False)
+    assert not canary.block_matches_pipeline(blk, backend="tpu", quant=False)
+    assert not canary.block_matches_pipeline(blk, backend="cpu", quant=True)
+    no_crc = canary.make_probe_block(1, 4, (16,), None, "cpu")
+    assert "crc32" not in no_crc
+    assert not canary.block_matches_pipeline(no_crc, backend="cpu",
+                                             quant=False)
+
+
+# ----------------------------------------------------------------------
+# engine golden canary end to end
+def _save_round(tr, model_dir, round_):
+    os.makedirs(model_dir, exist_ok=True)
+    tr.round = round_
+    tr.save_model(os.path.join(model_dir, f"{round_:04d}.model"))
+
+
+def _canary_engine(mdir):
+    return serve.Engine(cfg=MLP_CFG + "integrity_probe = 1\n",
+                        model_dir=mdir, max_batch_size=8,
+                        batch_timeout_ms=0, silent=True)
+
+
+def test_engine_canary_detects_and_recovers(tmp_path):
+    mdir = str(tmp_path / "models")
+    _save_round(make_trainer(seed=1), mdir, 1)
+    eng = _canary_engine(mdir)
+    try:
+        snap = eng.snapshot_stats()["integrity"]
+        assert snap["probe"] == 1 and snap["golden_src"] == "local"
+        assert eng.check_canary()  # frozen model reproduces its golden
+        assert eng.healthz()["status"] == "ok"
+        # injected CRC drift: degrade WITHOUT dying, keep predicting
+        eng.inject_canary_mismatch = 1
+        assert not eng.check_canary()
+        h = eng.healthz()
+        assert h["status"] == "degraded"
+        assert "integrity_failed" in h["reasons"]
+        assert eng.predict(np.zeros((2, 16), np.float32)).shape == (2,)
+        assert [e for e in obs_events.recent(100, kind="integrity.detect")
+                if e.get("kind_") == "canary"]
+        # one-shot fault: the next sweep is clean and clears the latch
+        assert eng.check_canary()
+        assert eng.healthz()["status"] == "ok"
+        assert eng.snapshot_stats()["integrity"]["runs"] == 3
+    finally:
+        eng.close()
+
+
+def test_engine_canary_manifest_binding_and_rebase(tmp_path):
+    """A manifest probe block whose CRC this engine reproduces is
+    binding (src=manifest); a stale/foreign CRC re-bases the golden
+    with an event instead of a false alarm."""
+    mdir = str(tmp_path / "models")
+    _save_round(make_trainer(seed=1), mdir, 1)
+    probe_eng = _canary_engine(mdir)
+    golden = probe_eng.snapshot_stats()["integrity"]["golden_crc32"]
+    rows = max(1, min(8, probe_eng.max_batch_size))
+    shape = tuple(probe_eng._row_shapes[0])
+    probe_eng.close()
+
+    import jax
+
+    path = os.path.join(mdir, "0001.model")
+    man = ckpt.read_manifest(path)
+
+    def rewrite(crc):
+        ckpt.write_manifest(
+            path, round_=man["round"], net_fp=man["net_fingerprint"],
+            save_ustate=man["save_ustate"],
+            probe=canary.make_probe_block(0xC0FFEE, rows, shape, crc,
+                                          jax.default_backend()))
+
+    rewrite(golden)
+    eng = _canary_engine(mdir)
+    try:
+        snap = eng.snapshot_stats()["integrity"]
+        assert snap["golden_src"] == "manifest"
+        assert snap["golden_crc32"] == golden
+        assert eng.check_canary()
+    finally:
+        eng.close()
+
+    rewrite(golden ^ 0xDEAD)  # foreign pipeline's answer: rebase
+    eng = _canary_engine(mdir)
+    try:
+        snap = eng.snapshot_stats()["integrity"]
+        assert snap["golden_src"] == "rebased"
+        assert snap["golden_crc32"] == golden  # re-based to OWN score
+        assert eng.check_canary()  # and it is NOT a false alarm
+        assert [e for e in obs_events.recent(
+            100, kind="integrity.golden_rebased")
+            if e.get("manifest_crc32") == (golden ^ 0xDEAD)]
+    finally:
+        eng.close()
+
+
+def test_trainer_commits_probe_block_at_save(tmp_path):
+    """task=train with integrity_probe=1 writes the probe block (spec +
+    single-process golden CRC) into every checkpoint manifest."""
+    from conftest import run_cli
+    from test_cli import make_conf
+
+    conf = make_conf(tmp_path, num_round=2,
+                     extra="integrity_probe = 1\n")
+    r = run_cli([conf], str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    man = ckpt.read_manifest(str(tmp_path / "models" / "0002.model"))
+    blk = man.get("probe")
+    assert isinstance(blk, dict)
+    assert blk["rows"] >= 1 and isinstance(blk["shape"], list)
+    assert blk.get("crc32") is not None  # single-process: scored golden
+    assert blk["backend"] == "cpu"
+    # the committed spec regenerates the batch bit-for-bit
+    p = canary.probe_batch(blk["seed"], blk["rows"], tuple(blk["shape"]))
+    assert p.shape == (blk["rows"],) + tuple(blk["shape"])

@@ -333,6 +333,28 @@ def validate_alertz(obj) -> List[str]:
 
 _HEALTH_STATUSES = ("ok", "degraded", "down", "closed")
 
+#: stable engine degrade-reason tokens (doc/serving.md;
+#: ``integrity_failed`` = golden-canary drift, doc/robustness.md
+#: "Integrity plane").  ``alert:<rule>`` rides alongside for firing
+#: alert rules; fleet aggregates prefix every token ``replica<i>:``
+#: and additionally emit out-of-rotation replica STATES.
+_HEALTH_REASON_TOKENS = ("reload_breaker_open", "mesh_rebuilding",
+                         "integrity_failed")
+_HEALTH_REPLICA_STATES = ("starting", "slow", "quarantined", "wedged",
+                          "gone", "backoff", "failed")
+
+
+def _reason_token_ok(tok: str) -> bool:
+    base = tok
+    m = re.match(r"^replica\d+:(.+)$", tok)
+    if m:
+        base = m.group(1)
+        if base in _HEALTH_REPLICA_STATES:
+            return True
+    if base in _HEALTH_REASON_TOKENS:
+        return True
+    return base.startswith("alert:") and len(base) > len("alert:")
+
 
 def validate_healthz(obj) -> List[str]:
     """Schema-check a ``GET /healthz`` body — single engine or fleet
@@ -359,6 +381,13 @@ def validate_healthz(obj) -> List[str]:
                 "condition must carry a machine-readable token)")
         if status == "ok" and reasons:
             problems.append(f"ok but reasons non-empty: {reasons}")
+        unknown = [t for t in reasons if not _reason_token_ok(t)]
+        if unknown:
+            problems.append(
+                f"unknown reason token(s) {unknown} (want "
+                f"{'/'.join(_HEALTH_REASON_TOKENS)}, alert:<rule>, or "
+                "a replica<i>:-prefixed engine token / out-of-rotation "
+                "state)")
     if not isinstance(obj.get("round"), int):
         problems.append("round missing or not an integer")
     if obj.get("fleet"):

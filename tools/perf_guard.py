@@ -54,7 +54,8 @@ VERDICTS = ("baseline", "ok", "regression")
 _LOWER_MARKERS = ("latency", "_ms", "p50", "p95", "p99", "wall_s",
                   "compiles", "programs", "rebuild_wall_s",
                   "restart_wall_s", "shed_ratio", "final_err",
-                  "elapsed_s", "disk_bytes_final", "violations")
+                  "elapsed_s", "disk_bytes_final", "violations",
+                  "overhead_ratio", "detect_rounds")
 
 
 def lower_is_better(name: str) -> bool:
@@ -258,6 +259,27 @@ def flatten_tenant_bench(doc: dict) -> Dict[str, float]:
     return out
 
 
+def flatten_integrity_bench(doc: dict) -> Dict[str, float]:
+    """The SDC lane's series (``tools/sdc_smoke.py``): detection
+    latency in rounds (lower is better — with ``integrity_every = 1``
+    it must stay at 1; a cadence or vote regression drifts it up), the
+    fingerprint sweep's share of the round wall clock (lower is
+    better, bounded at 2% by the lane itself), the quarantine rebuild
+    wall time, the bitwise-parity and canary bits as 0/1 (a run that
+    stops being bit-equal, or a canary that stops detecting/
+    readmitting, collapses far outside any noise band), and the
+    end-to-end wall clocks."""
+    out: Dict[str, float] = {}
+    for key in ("detect_rounds", "overhead_ratio", "rebuild_wall_s",
+                "flip_wall_sec", "clean_wall_sec"):
+        v = doc.get(key)
+        if isinstance(v, (int, float)) and math.isfinite(v):
+            out[key] = float(v)
+    for key in ("crc_equal", "canary_detected", "canary_readmitted"):
+        out[key] = 1.0 if doc.get(key) else 0.0
+    return out
+
+
 def flatten_crash_audit(doc: dict) -> Dict[str, float]:
     """The CRASH lane's series (``tools/crash_audit.py``): coverage
     (states explored / distinct — a change that quietly shrinks the
@@ -320,7 +342,8 @@ FLATTENERS = {"io_bench": flatten_io_bench,
               "elastic": flatten_elastic,
               "fleet_bench": flatten_fleet_bench,
               "async_bench": flatten_async_bench,
-              "tenant_bench": flatten_tenant_bench}
+              "tenant_bench": flatten_tenant_bench,
+              "integrity_bench": flatten_integrity_bench}
 
 
 # ----------------------------------------------------------------------

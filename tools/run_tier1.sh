@@ -146,6 +146,24 @@
 #                                     # --record writes ops/kernels/
 #                                     # verdicts.json, and the TPU legs
 #                                     # stay queued in tpu_queue.sh
+#        SDC=1 tools/run_tier1.sh     # also run the silent-data-
+#                                     # corruption lane: a 4-process
+#                                     # CPU-mesh CLI train has one real
+#                                     # bit flipped in a live parameter
+#                                     # tensor on rank 3; the fingerprint
+#                                     # vote must detect it within
+#                                     # integrity_every rounds, name the
+#                                     # rank, quarantine it (exit 41) and
+#                                     # rebuild in-process, and the
+#                                     # finished run's checkpoint CRCs
+#                                     # must be BITWISE equal to a clean
+#                                     # run that never contained the
+#                                     # corrupt rank; plus the serve
+#                                     # golden-canary degrade/readmit
+#                                     # walk and the <=2% fingerprint
+#                                     # overhead bound; verdict JSON
+#                                     # appends to a perf_guard history
+#                                     # (integrity_bench flattener)
 #        OBS=1 tools/run_tier1.sh     # also run the observability smoke:
 #                                     # short telemetry=1 train + serve
 #                                     # scrape of /metricsz + /alertz
@@ -325,6 +343,20 @@ if [ "${TENANT:-0}" = "1" ]; then
       --input "$tenant_out/verdict.json" \
       --history "$tenant_out/bench_history.jsonl" > /dev/null || rc=1
   echo "TENANT lane verdict: $tenant_out/verdict.json"
+fi
+if [ "${SDC:-0}" = "1" ]; then
+  echo "=== opt-in silent-data-corruption lane (SDC=1) ==="
+  sdc_out=/tmp/_sdc_lane
+  rm -rf "$sdc_out"; mkdir -p "$sdc_out"
+  # outer budget > 2x the tool's per-run --timeout (420 s) plus the
+  # overhead run and canary walk
+  timeout -k 10 1000 env JAX_PLATFORMS=cpu \
+    python tools/sdc_smoke.py --out "$sdc_out" > /dev/null || rc=1
+  timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python tools/perf_guard.py --bench integrity_bench \
+      --input "$sdc_out/sdc.json" \
+      --history "$sdc_out/bench_history.jsonl" > /dev/null || rc=1
+  echo "SDC lane verdict: $sdc_out/sdc.json"
 fi
 if [ "${OBS:-0}" = "1" ]; then
   echo "=== opt-in observability smoke (OBS=1) ==="

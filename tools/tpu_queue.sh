@@ -132,6 +132,14 @@ sweep() {
     --history /tmp/tpu_kernel_bench.jsonl --json /tmp/kernel_ab_int8_gemm.json
   run 900 python tools/kernel_ab.py --kernel zero_update --record \
     --history /tmp/tpu_kernel_bench.jsonl --json /tmp/kernel_ab_zero_update.json
+  # integrity-plane overhead at full size (ISSUE 18 / doc/
+  # robustness.md "Integrity plane"): the fingerprint sweep's share of
+  # the round wall on-chip at a real model width — the CPU lane (SDC=1
+  # tier-1) proves detection/quarantine mechanics at 256 hidden; this
+  # is the <=2% bound measured where digest bandwidth actually costs
+  run 900 python tools/sdc_smoke.py --overhead-only --dev tpu \
+    --hidden 4096 --out /tmp/_sdc_tpu \
+    --json /tmp/sdc_overhead_tpu.json
   # TPU-backend HLO fusion audit (compile-only; doc/performance.md)
   run 900 python tools/hlo_inspect.py googlenet 128
   run 900 python tools/hlo_inspect.py googlenet 128 conv_branch_embed=1
