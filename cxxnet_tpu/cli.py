@@ -82,6 +82,7 @@ class LearnTask:
         self.queue_limit = 128
         self.serve_reload_period = 0.0  # seconds; 0 disables hot reload
         self.serve_deadline_ms = 0.0  # default per-request deadline
+        self.wire = "binary"  # accept binary x-cxb frames (json = refuse)
         self.drain_timeout_s = 5.0  # SIGTERM: flush in-flight this long
         self.reload_breaker_threshold = 3
         self.reload_breaker_cooldown_s = 30.0
@@ -221,6 +222,13 @@ class LearnTask:
             self.serve_reload_period = float(val)
         elif name == "serve_deadline_ms":
             self.serve_deadline_ms = float(val)
+        elif name == "wire":
+            # data-plane wire formats the engine accepts (the raw key
+            # also reaches serve.Engine through self.cfg)
+            if val not in ("binary", "json"):
+                raise ValueError(
+                    f"wire must be binary or json, got {val!r}")
+            self.wire = val
         elif name == "drain_timeout_s":
             self.drain_timeout_s = float(val)
         elif name == "reload_breaker_threshold":

@@ -33,6 +33,8 @@ from .fleet import (  # noqa: F401
 from .metrics import ServingStats  # noqa: F401
 from .router import FleetRouter  # noqa: F401
 from .server import make_server, serve_forever  # noqa: F401
+from . import wire  # noqa: F401
+from .wire import WireError, WireRequest  # noqa: F401
 
 __all__ = [
     "Engine",
@@ -52,4 +54,7 @@ __all__ = [
     "CanaryController",
     "ServingFleet",
     "FleetRouter",
+    "wire",
+    "WireError",
+    "WireRequest",
 ]

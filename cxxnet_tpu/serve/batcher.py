@@ -118,6 +118,14 @@ class MicroBatcher:
         self._closed = False
         if stats is not None:
             stats.bind_queue_depth(self.pending_count)
+        # batch-assembly staging buffers, one per (row shape, dtype):
+        # coalesced requests are sliced into a preallocated buffer
+        # instead of np.concatenate allocating a fresh batch array per
+        # dispatch.  Owned exclusively by the worker thread; reuse
+        # across batches is safe because the runner (the engine's
+        # bucket cache) blocks on device_get before returning, so the
+        # device has consumed the rows before the next batch assembles.
+        self._staging: dict = {}
         self._worker = threading.Thread(
             target=self._loop, name="cxxnet-serve-batcher", daemon=True
         )
@@ -244,6 +252,25 @@ class MicroBatcher:
                 self._nonempty.wait(timeout=remain)
         return batch
 
+    def _assemble(self, batch: List[_Request]) -> np.ndarray:
+        """Copy each request's rows into the per-shape staging buffer
+        (worker-thread only).  A single-request batch never reaches
+        here — it passes its array through untouched."""
+        first = batch[0].data
+        rows = sum(r.data.shape[0] for r in batch)
+        key = (first.shape[1:], first.dtype.str)
+        buf = self._staging.get(key)
+        if buf is None or buf.shape[0] < rows:
+            cap = max(rows, self.max_batch_size)
+            buf = np.empty((cap,) + first.shape[1:], dtype=first.dtype)
+            self._staging[key] = buf
+        ofs = 0
+        for r in batch:
+            n = r.data.shape[0]
+            buf[ofs:ofs + n] = r.data
+            ofs += n
+        return buf[:rows]
+
     def _loop(self) -> None:
         while True:
             with self._lock:
@@ -255,7 +282,7 @@ class MicroBatcher:
             self.watchdog.beat()
             try:
                 data = (batch[0].data if len(batch) == 1
-                        else np.concatenate([r.data for r in batch], axis=0))
+                        else self._assemble(batch))
                 out = self._runner(batch[0].kind, batch[0].node, data)
             except BaseException as e:  # noqa: BLE001 - relayed per request
                 for r in batch:

@@ -103,10 +103,21 @@ class Engine:
         # and periodically re-scores it — any drift on a frozen model
         # is memory/compute corruption and degrades /healthz
         self.integrity_probe = 0
+        # binary wire protocol (doc/serving.md "Binary wire protocol"):
+        # `wire = json` turns the application/x-cxb request path off —
+        # binary frames get 400 reason=wire_disabled; `binary` (the
+        # default) negotiates per request by Content-Type, with JSON
+        # always accepted
+        self.wire = "binary"
         for _n, _v in self._cfg:
             if _n == "quant":
                 self.quant = ("" if _v in ("", "0", "off", "none")
                               else _v)
+            elif _n == "wire":
+                if _v not in ("binary", "json"):
+                    raise ValueError(
+                        f"wire must be binary or json, got {_v!r}")
+                self.wire = _v
             elif _n == "integrity_probe":
                 try:
                     self.integrity_probe = int(_v)

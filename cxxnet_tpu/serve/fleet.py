@@ -108,6 +108,7 @@ class FleetOptions:
     batch_shed_ratio: float = 0.5  # batch sheds above this capacity fraction
     dispatch_retries: int = 2      # failovers per request beyond the first try
     dispatch_timeout_s: float = 60.0
+    pool_size: int = 8             # idle keep-alive connections kept per replica
     log_dir: str = ""              # replica stdout/stderr logs
     reload_timeout_s: float = 120.0
     reload_breaker_threshold: int = 3
@@ -147,6 +148,8 @@ class FleetOptions:
                 o.dispatch_retries = int(val)
             elif name == "fleet_dispatch_timeout_s":
                 o.dispatch_timeout_s = float(val)
+            elif name == "fleet_pool_size":
+                o.pool_size = int(val)
             elif name == "fleet_log_dir":
                 o.log_dir = val
             elif name == "fleet_reload_timeout_s":
@@ -173,6 +176,8 @@ class FleetOptions:
             raise ValueError("fleet_slow_probes must be >= 1")
         if o.replica_inflight < 1:
             raise ValueError("fleet_replica_inflight must be >= 1")
+        if o.pool_size < 1:
+            raise ValueError("fleet_pool_size must be >= 1")
         if not 0.0 < o.batch_shed_ratio <= 1.0:
             raise ValueError("fleet_batch_shed_ratio must be in (0, 1]")
         if o.canary:
@@ -245,6 +250,19 @@ class _FleetMetrics:
             "Canary traffic by leg: slice (live) / mirror (shadow "
             "comparison).",
             labelnames=("leg",))
+        # router→replica persistent-connection pool (doc/serving.md
+        # "Pooled dispatch"): a connects rate far below the dispatch
+        # rate is the pool doing its job
+        self.pool_connects = reg.counter(
+            "fleet_pool_connects_total",
+            "New router-to-replica keep-alive connections opened.")
+        self.pool_retired = reg.counter(
+            "fleet_pool_retired_total",
+            "Pooled connections retired (error / replica eject / "
+            "reload / server-requested close).")
+        self.pool_idle = reg.gauge(
+            "fleet_pool_idle_connections",
+            "Idle keep-alive connections parked at the router.")
 
 
 _METRICS: Optional[_FleetMetrics] = None
@@ -342,6 +360,9 @@ class ReplicaSupervisor:
         self.replicas: List[Replica] = []
         self.last_restart_wall_s = 0.0
         self.restarts_total = 0
+        # eject notification (the router binds this to retire its
+        # keep-alive pool, so no request rides a socket into a corpse)
+        self.on_down: Optional[Callable[[Replica], None]] = None
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -551,6 +572,11 @@ class ReplicaSupervisor:
             "fleet.replica_wedged" if reason == "wedged"
             else "fleet.replica_gone",
             replica=r.idx, role=r.role, port=r.port, detail=detail)
+        if self.on_down is not None:
+            try:
+                self.on_down(r)
+            except Exception:  # noqa: BLE001 - eject must never wedge
+                pass
         self._kill(r)
         with self._lock:
             if self.spawn_fn is None:
@@ -1028,6 +1054,8 @@ class ServingFleet:
             if opts.canary else None)
         self.router = FleetRouter(self, default_deadline_ms=
                                   default_deadline_ms)
+        self.supervisor.on_down = (
+            lambda r: self.router.retire_replica_pool(r.address))
         self.reload_breaker = CircuitBreaker(
             failure_threshold=opts.reload_breaker_threshold,
             cooldown_s=60.0)
@@ -1099,6 +1127,9 @@ class ServingFleet:
                                 breaker=self.reload_breaker.state)
                 break
             ok, swapped, round_, err = self._reload_one(r, target_round)
+            # the swapped engine invalidates any parked connection's
+            # implicit model identity — start the replica's pool fresh
+            self.router.retire_replica_pool(r.address)
             results.append({"replica": r.idx, "ok": ok,
                             "swapped": swapped, "round": round_,
                             "error": err})

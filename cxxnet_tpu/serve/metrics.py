@@ -118,6 +118,20 @@ class _ServeMetrics:
             "Requests by pow2 row-count bucket (shape histogram).",
             labelnames=("bucket",),
         )
+        # wire-format split (doc/serving.md "Binary wire protocol"):
+        # which codec the data plane actually speaks, and how many
+        # binary-frame bytes move each way — the denominator for the
+        # codec-share story the zero-copy path exists to shrink
+        self.wire_requests = reg.counter(
+            "serve_wire_requests_total",
+            "Data-plane requests by wire format.",
+            labelnames=("wire",),
+        )
+        self.wire_bytes = reg.counter(
+            "serve_wire_bytes_total",
+            "Binary-frame bytes moved, by direction (in / out).",
+            labelnames=("dir",),
+        )
 
 
 _METRICS: Optional[_ServeMetrics] = None

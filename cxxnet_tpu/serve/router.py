@@ -37,21 +37,42 @@ latency leg), and a ``canary_sample`` fraction of baseline responses
 is mirrored to it in the background for row-level agreement — the
 measurement the promote/rollback decision reads
 (``serve/fleet.py::CanaryController``).
+
+Transport: dispatch runs over a bounded per-replica pool of
+persistent HTTP/1.1 connections (:class:`_ReplicaPool`) instead of a
+fresh TCP handshake per request — at data-plane rates the 3-packet
+setup cost per hop was a measurable share of p50.  A pooled
+connection that went stale while idle (the replica closed it) gets
+exactly one fresh-connection retry against the SAME replica — except
+``/feedback``, whose send may already have landed and is therefore
+never replayed, stale socket or not.  Pools are retired wholesale
+when a replica is ejected or reloaded (``serve/fleet.py`` hooks
+:meth:`FleetRouter.retire_replica_pool`).
+
+Binary wire frames (doc/serving.md "Binary wire protocol") take the
+same front door: ``Content-Type: application/x-cxb`` requests are
+classified from the frame header (``wire.peek_header``), pass the
+identical admission/priority/deadline machinery, and relay OPAQUELY —
+the router patches the remaining deadline budget in place
+(``wire.patch_deadline``) per dispatch attempt and never decodes the
+payload.  Canary mirroring compares raw response payloads row-wise.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import queue
 import random
 import threading
 import time
-import urllib.error
-import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
 
 from ..obs import events as obs_events
+from . import wire
 from .fleet import Replica, fleet_metrics
 
 __all__ = ["FleetRouter", "FleetStats", "ModelRouter",
@@ -137,8 +158,68 @@ class ModelRouter:
 
 #: network-layer dispatch failures that trigger failover (a replica
 #: HTTP error response is NOT one of these — it relays)
-_DISPATCH_ERRORS = (urllib.error.URLError, ConnectionError, OSError,
+_DISPATCH_ERRORS = (http.client.HTTPException, ConnectionError, OSError,
                     TimeoutError)
+
+
+def _jbody(obj) -> bytes:
+    return json.dumps(obj).encode("utf-8")
+
+
+class _ReplicaPool:
+    """Bounded idle-connection pool to ONE replica address.
+
+    ``acquire`` hands back an idle keep-alive connection when one is
+    parked (``reused=True``) or a fresh unconnected one otherwise;
+    ``release`` parks it again up to ``size`` idle connections (beyond
+    that the connection closes — the bound is on PARKED sockets, not
+    concurrency, which the admission layer already caps).  All methods
+    are thread-safe; the connections themselves are owned by exactly
+    one dispatch between acquire and release."""
+
+    def __init__(self, address: str, size: int) -> None:
+        self.address = address
+        host, _, port = address.rpartition(":")
+        self.host = host
+        self.port = int(port)
+        self.size = max(1, int(size))
+        self._lock = threading.Lock()
+        self._idle: List[http.client.HTTPConnection] = []
+
+    def acquire(self, timeout_s: float
+                ) -> Tuple[http.client.HTTPConnection, bool]:
+        with self._lock:
+            conn = self._idle.pop() if self._idle else None
+        if conn is not None:
+            # per-request timeout on a long-lived socket
+            conn.timeout = timeout_s
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout_s)
+            return conn, True
+        return http.client.HTTPConnection(
+            self.host, self.port, timeout=timeout_s), False
+
+    def release(self, conn: http.client.HTTPConnection) -> bool:
+        """Park ``conn`` for reuse; False when the pool is full (the
+        connection is closed instead)."""
+        with self._lock:
+            if len(self._idle) < self.size:
+                self._idle.append(conn)
+                return True
+        conn.close()
+        return False
+
+    def retire_all(self) -> int:
+        """Close every parked connection (replica ejected/reloaded)."""
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for c in idle:
+            c.close()
+        return len(idle)
+
+    def idle_count(self) -> int:
+        with self._lock:
+            return len(self._idle)
 
 
 class FleetStats:
@@ -225,6 +306,15 @@ class FleetRouter:
         self._lock = threading.Lock()       # replica inflight counters
         self._rng = random.Random(0xF1EE7)  # slice/sample draws
         self._rng_lock = threading.Lock()
+        # persistent-connection pools, one per replica address (created
+        # lazily on first dispatch, retired on eject/reload)
+        self._pools: Dict[str, _ReplicaPool] = {}
+        self._pools_lock = threading.Lock()
+        self.pool_size = int(getattr(self.opts, "pool_size", 8))
+        # live idle-connection gauge (last router bound wins, matching
+        # the serve-side queue_depth convention)
+        self._metrics.pool_idle.set_function(
+            lambda: sum(p.idle_count() for p in self._pool_list()))
         # mirror lane: bounded + lossy — shadow comparisons must never
         # apply backpressure to live traffic
         self._mirror_q: "queue.Queue[tuple]" = queue.Queue(maxsize=256)
@@ -282,25 +372,74 @@ class FleetRouter:
             return self._rng.random() < prob
 
     # ------------------------------------------------------------------
+    # connection pools
+    def _pool(self, r: Replica) -> _ReplicaPool:
+        with self._pools_lock:
+            p = self._pools.get(r.address)
+            if p is None:
+                p = _ReplicaPool(r.address, self.pool_size)
+                self._pools[r.address] = p
+            return p
+
+    def _pool_list(self) -> List[_ReplicaPool]:
+        with self._pools_lock:
+            return list(self._pools.values())
+
+    def retire_replica_pool(self, address: str) -> int:
+        """Close every parked connection to ``address`` — the fleet
+        calls this when the replica is ejected or reloaded, so no
+        dispatch ever rides a socket into a dead or swapped process."""
+        with self._pools_lock:
+            p = self._pools.get(address)
+        if p is None:
+            return 0
+        n = p.retire_all()
+        if n:
+            self._metrics.pool_retired.inc(n)
+        return n
+
+    def pool_stats(self) -> Dict[str, int]:
+        """Idle keep-alive connections per replica address."""
+        return {p.address: p.idle_count() for p in self._pool_list()}
+
+    # ------------------------------------------------------------------
     # dispatch
-    def _post_replica(self, r: Replica, path: str, obj: dict,
-                      timeout_s: float) -> Tuple[int, dict]:
-        req = urllib.request.Request(
-            f"http://{r.address}{path}",
-            data=json.dumps(obj).encode("utf-8"),
-            headers={"Content-Type": "application/json"},
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-                return resp.status, json.loads(resp.read().decode("utf-8"))
-        except urllib.error.HTTPError as e:
-            # a replica ERROR RESPONSE (429/500/504...) relays as-is —
-            # only network-layer failures trigger failover
+    def _post_replica(
+        self, r: Replica, path: str, body, timeout_s: float,
+        content_type: str = "application/json",
+    ) -> Tuple[int, bytes, str]:
+        """POST ``body`` bytes over a pooled keep-alive connection;
+        returns ``(status, raw_body, content_type)``.  A replica ERROR
+        RESPONSE (429/500/504...) relays as-is — only network-layer
+        failures raise (and trigger failover in the caller).  A pooled
+        connection that went stale while parked gets ONE retry on a
+        fresh connection to the same replica; ``/feedback`` never does
+        (the stale send may have reached the replica)."""
+        pool = self._pool(r)
+        for attempt in (0, 1):
+            conn, reused = pool.acquire(timeout_s)
+            if not reused:
+                self._metrics.pool_connects.inc()
             try:
-                body = json.loads(e.read().decode("utf-8"))
-            except Exception:  # noqa: BLE001 - non-JSON error body
-                body = {"error": str(e)}
-            return e.code, body
+                conn.request("POST", path, body=body,
+                             headers={"Content-Type": content_type})
+                resp = conn.getresponse()
+                raw = resp.read()
+            except _DISPATCH_ERRORS:
+                conn.close()
+                self._metrics.pool_retired.inc()
+                if reused and attempt == 0 and path != "/feedback":
+                    continue  # stale keep-alive: one fresh retry
+                raise
+            rtype = (resp.getheader("Content-Type") or
+                     "application/json").split(";")[0].strip()
+            if resp.will_close:
+                conn.close()
+                self._metrics.pool_retired.inc()
+            else:
+                pool.release(conn)
+            return resp.status, raw, rtype
+        raise ConnectionError("unreachable")  # loop always returns/raises
 
     def route(self, path: str, obj: dict,
               priority: str = "interactive") -> Tuple[int, dict]:
@@ -321,9 +460,31 @@ class FleetRouter:
             self.stats.leave()
             m.inflight.set(self.stats.inflight)
 
-    def _dispatch(self, path: str, obj: dict) -> Tuple[int, dict]:
-        t0 = time.monotonic()
+    def route_wire(self, path: str, frame, priority: str = "interactive",
+                   deadline_ms: float = 0.0) -> Tuple[int, bytes, str]:
+        """Binary twin of :meth:`route`: identical admission, priority
+        shedding, deadline budget, failover and canary accounting; the
+        frame relays opaquely (only its deadline field is patched per
+        attempt).  Returns ``(status, body_bytes, content_type)`` —
+        success bodies are ``CXR1`` frames straight off the replica,
+        error bodies stay JSON so any client can read them."""
         m = self._metrics
+        m.requests.labels(priority=priority).inc()
+        reason = self.admit(priority)
+        if reason is not None:
+            m.shed.labels(priority=priority).inc()
+            return 429, _jbody({"error": f"load shed: {reason}",
+                                "priority": priority}), "application/json"
+        m.inflight.set(self.stats.inflight)
+        try:
+            buf = frame if isinstance(frame, bytearray) else \
+                bytearray(frame)
+            return self._dispatch_wire(path, buf, deadline_ms)
+        finally:
+            self.stats.leave()
+            m.inflight.set(self.stats.inflight)
+
+    def _dispatch(self, path: str, obj: dict) -> Tuple[int, dict]:
         deadline_ms = obj.get("deadline_ms")
         if deadline_ms is None and self.default_deadline_ms > 0:
             deadline_ms = self.default_deadline_ms
@@ -333,6 +494,60 @@ class FleetRouter:
         except (TypeError, ValueError):
             # client-input error: 400, matching the single-engine server
             return 400, {"error": f"bad deadline_ms: {deadline_ms!r}"}
+        fwd = dict(obj)
+        fwd.pop("priority", None)
+
+        def make_body(remaining_ms: Optional[float]) -> bytes:
+            if remaining_ms is not None:
+                # the execute share of the budget: whatever routing and
+                # failover have not already consumed
+                fwd["deadline_ms"] = remaining_ms
+            return _jbody(fwd)
+
+        def account(r: Replica, raw: bytes, dt: float) -> None:
+            self._canary_account(r, dt, lambda: (
+                "json", obj.get("data"),
+                json.loads(raw.decode("utf-8")).get("pred")))
+
+        status, raw, _rtype = self._dispatch_loop(
+            path, deadline_val, make_body, "application/json", account)
+        try:
+            body = json.loads(raw.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            body = {"error": "replica returned a non-JSON body"}
+        return status, body
+
+    def _dispatch_wire(self, path: str, frame: bytearray,
+                       deadline_ms: float) -> Tuple[int, bytes, str]:
+        deadline_val = float(deadline_ms or 0.0)
+        if deadline_val <= 0 and self.default_deadline_ms > 0:
+            deadline_val = self.default_deadline_ms
+
+        def make_body(remaining_ms: Optional[float]):
+            if remaining_ms is not None:
+                wire.patch_deadline(frame, remaining_ms)
+            return frame
+
+        def account(r: Replica, raw: bytes, dt: float) -> None:
+            self._canary_account(
+                r, dt, lambda: ("wire", bytes(frame), bytes(raw)))
+
+        return self._dispatch_loop(path, deadline_val, make_body,
+                                   wire.CONTENT_TYPE, account)
+
+    def _dispatch_loop(
+        self, path: str, deadline_val: float,
+        make_body: Callable[[Optional[float]], object],
+        content_type: str,
+        account: Callable[[Replica, bytes, float], None],
+    ) -> Tuple[int, bytes, str]:
+        """The shared least-loaded + failover loop under both wire
+        formats.  ``make_body(remaining_ms)`` builds each attempt's
+        request body (JSON re-encodes the forwarded object; binary
+        patches the frame's deadline field in place); ``account`` runs
+        on a 200 ``/predict`` relay for canary latency/mirroring."""
+        t0 = time.monotonic()
+        m = self._metrics
         deadline_t = (t0 + deadline_val / 1e3
                       if deadline_val > 0 else None)
         is_predict = path == "/predict"
@@ -346,42 +561,45 @@ class FleetRouter:
                 remaining_ms = (deadline_t - time.monotonic()) * 1e3
                 if remaining_ms <= 0:
                     self.stats.count("expired")
-                    return 504, {"error": "deadline expired before a "
-                                          "replica could answer"}
+                    return 504, _jbody(
+                        {"error": "deadline expired before a replica "
+                                  "could answer"}), "application/json"
             r = self.pick_replica(exclude=tried, want_canary=want_canary)
             if r is None and want_canary:
                 want_canary = False  # canary unavailable: baseline serves
                 continue
             if r is None:
                 self.stats.count("unroutable")
-                return 503, {"error": "no healthy replica available"}
-            fwd = dict(obj)
-            fwd.pop("priority", None)
-            if remaining_ms is not None:
-                # the execute share of the budget: whatever routing and
-                # failover have not already consumed
-                fwd["deadline_ms"] = remaining_ms
+                return 503, _jbody(
+                    {"error": "no healthy replica available"}), \
+                    "application/json"
             timeout_s = self.opts.dispatch_timeout_s
             if remaining_ms is not None:
                 timeout_s = min(timeout_s, remaining_ms / 1e3 + 1.0)
+            body = make_body(remaining_ms)
             with self._lock:
                 r.inflight += 1
             t_send = time.monotonic()
             try:
-                status, body = self._post_replica(r, path, fwd, timeout_s)
+                status, raw, rtype = self._post_replica(
+                    r, path, body, timeout_s, content_type)
             except _DISPATCH_ERRORS as e:
                 tried.add(r)
                 failures += 1
                 self.sup.note_dispatch_failure(r)
                 if path == "/feedback":
                     # appends are not idempotent — never replayed
-                    return 502, {"error": f"replica dispatch failed "
-                                          f"({type(e).__name__}: {e}); "
-                                          "feedback is not retried"}
+                    return 502, _jbody(
+                        {"error": f"replica dispatch failed "
+                                  f"({type(e).__name__}: {e}); "
+                                  "feedback is not retried"}), \
+                        "application/json"
                 if failures > self.opts.dispatch_retries:
-                    return 502, {"error": f"dispatch failed on "
-                                          f"{failures} replica(s) "
-                                          f"({type(e).__name__}: {e})"}
+                    return 502, _jbody(
+                        {"error": f"dispatch failed on {failures} "
+                                  f"replica(s) "
+                                  f"({type(e).__name__}: {e})"}), \
+                        "application/json"
                 # only an actual retry counts as a failover
                 self.stats.count("failovers")
                 m.failovers.inc()
@@ -396,13 +614,17 @@ class FleetRouter:
             if status >= 500:
                 self.stats.count("relayed_5xx")
             if is_predict and status == 200:
-                self._canary_account(r, obj, body, dt)
-            return status, body
+                account(r, raw, dt)
+            return status, raw, rtype
 
     # ------------------------------------------------------------------
     # canary measurement
-    def _canary_account(self, r: Replica, obj: dict, body: dict,
-                        dt_s: float) -> None:
+    def _canary_account(self, r: Replica, dt_s: float,
+                        item_fn: Callable[[], Optional[tuple]]) -> None:
+        """Latency legs + mirror sampling for one 200 ``/predict``.
+        ``item_fn`` lazily builds the mirror-queue entry — ``("json",
+        data, base_pred)`` or ``("wire", frame_bytes, base_response)``
+        — so the baseline body is only decoded on a sampled draw."""
         c = self.fleet.canary
         if c is None or c.state != "evaluating":
             return
@@ -414,44 +636,80 @@ class FleetRouter:
         c.record_latency("baseline", dt_s)
         if self._draw(self.opts.canary_sample):
             try:
-                self._mirror_q.put_nowait((obj.get("data"),
-                                           body.get("pred")))
+                item = item_fn()
+            except Exception:  # noqa: BLE001 - shadow path never raises
+                return
+            if item is None:
+                return
+            try:
+                self._mirror_q.put_nowait(item)
             except queue.Full:
                 pass  # lossy by design: shadow work never backpressures
 
     def _mirror_loop(self) -> None:
         while not self._mirror_stop.is_set():
             try:
-                data, base_pred = self._mirror_q.get(timeout=0.2)
+                item = self._mirror_q.get(timeout=0.2)
             except queue.Empty:
                 continue
-            c = self.fleet.canary
-            if c is None or c.state != "evaluating" or base_pred is None:
-                continue
-            canary = self.pick_replica(want_canary=True)
-            if canary is None:
-                continue
-            m = self._metrics
-            t0 = time.monotonic()
             try:
-                status, body = self._post_replica(
-                    canary, "/predict", {"data": data},
-                    self.opts.dispatch_timeout_s)
-            except _DISPATCH_ERRORS:
-                self.sup.note_dispatch_failure(canary)
-                continue
-            m.canary_requests.labels(leg="mirror").inc()
-            if status != 200:
-                continue
-            c.record_latency("canary", time.monotonic() - t0)
-            can_pred = body.get("pred")
+                self._mirror_once(item)
+            except Exception:  # noqa: BLE001 - shadow lane never dies
+                pass
+
+    def _mirror_once(self, item: tuple) -> None:
+        """Replay one sampled baseline request against the canary and
+        record row-level agreement.  JSON entries compare ``pred``
+        lists; wire entries re-post the original frame (deadline
+        zeroed — shadow work has no budget) and compare the raw f32
+        response payloads row-wise."""
+        leg, payload, base = item
+        c = self.fleet.canary
+        if c is None or c.state != "evaluating" or base is None:
+            return
+        canary = self.pick_replica(want_canary=True)
+        if canary is None:
+            return
+        m = self._metrics
+        if leg == "wire":
+            frame = bytearray(payload)
+            wire.patch_deadline(frame, 0)
+            body, ctype = frame, wire.CONTENT_TYPE
+        else:
+            body, ctype = _jbody({"data": payload}), "application/json"
+        t0 = time.monotonic()
+        try:
+            status, raw, rtype = self._post_replica(
+                canary, "/predict", body, self.opts.dispatch_timeout_s,
+                content_type=ctype)
+        except _DISPATCH_ERRORS:
+            self.sup.note_dispatch_failure(canary)
+            return
+        m.canary_requests.labels(leg="mirror").inc()
+        if status != 200:
+            return
+        c.record_latency("canary", time.monotonic() - t0)
+        if leg == "wire":
+            try:
+                _k, _rid, can_rows = wire.decode_response(raw)
+                _k, _rid, base_rows = wire.decode_response(base)
+            except wire.WireError:
+                return
+            total = min(base_rows.shape[0], can_rows.shape[0])
+            b = np.asarray(base_rows[:total]).reshape(total, -1)
+            cn = np.asarray(can_rows[:total]).reshape(total, -1)
+            equal = int((b == cn).all(axis=1).sum())
+        else:
+            try:
+                can_pred = json.loads(raw.decode("utf-8")).get("pred")
+            except (ValueError, UnicodeDecodeError):
+                return
             if not isinstance(can_pred, list):
-                continue
-            base = list(base_pred) if isinstance(base_pred, list) \
-                else [base_pred]
-            total = min(len(base), len(can_pred))
-            equal = sum(1 for a, b in zip(base, can_pred) if a == b)
-            c.record_compare(equal, total)
+                return
+            base_l = list(base) if isinstance(base, list) else [base]
+            total = min(len(base_l), len(can_pred))
+            equal = sum(1 for a, b in zip(base_l, can_pred) if a == b)
+        c.record_compare(equal, total)
 
     # ------------------------------------------------------------------
     # HTTP surface
@@ -494,6 +752,51 @@ class FleetRouter:
                     self._reply(404,
                                 {"error": f"unknown route {self.path}"})
 
+            def _post_wire(self, length: int) -> None:
+                """Binary-frame data plane: read the frame into ONE
+                mutable buffer, classify from its header, relay through
+                the same admission machinery as JSON."""
+                frame = bytearray(length)
+                got, view = 0, memoryview(frame)
+                while got < length:
+                    n = self.rfile.readinto(view[got:])
+                    if not n:
+                        break
+                    got += n
+                del view
+                if got < length:
+                    # can't resync a half-read keep-alive stream
+                    self.close_connection = True
+                    self._reply(400, {"error": "frame body shorter than "
+                                               "Content-Length",
+                                      "reason": "truncated_body"})
+                    return
+                if self.path == "/feedback":
+                    self._reply(400, {
+                        "error": "binary frames are not accepted on "
+                                 "/feedback; use JSON",
+                        "reason": "wire_unsupported_route"})
+                    return
+                try:
+                    _kind, _model, priority, deadline_ms, _nbytes = \
+                        wire.peek_header(frame)
+                except wire.WireError as e:
+                    self._reply(400, {"error": str(e),
+                                      "reason": e.reason})
+                    return
+                try:
+                    status, body, rctype = router.route_wire(
+                        self.path, frame, priority, deadline_ms or 0.0)
+                except Exception as e:  # noqa: BLE001 - served as a 500
+                    status, body, rctype = 500, _jbody(
+                        {"error": f"{type(e).__name__}: {e}"}), \
+                        "application/json"
+                self.send_response(status)
+                self.send_header("Content-Type", rctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_POST(self):  # noqa: N802 - stdlib name
                 if self.path not in ("/predict", "/extract", "/feedback"):
                     self._reply(404,
@@ -504,8 +807,15 @@ class FleetRouter:
                 except ValueError:
                     length = 0
                 if length <= 0 or length > MAX_BODY_BYTES:
+                    # the unread body would desync the keep-alive stream
+                    self.close_connection = True
                     self._reply(400,
                                 {"error": "missing or oversized body"})
+                    return
+                ctype = (self.headers.get("Content-Type") or "") \
+                    .split(";")[0].strip().lower()
+                if ctype == wire.CONTENT_TYPE:
+                    self._post_wire(length)
                     return
                 try:
                     obj = json.loads(self.rfile.read(length)
@@ -532,8 +842,14 @@ class FleetRouter:
                         "error": f"{type(e).__name__}: {e}"}
                 self._reply(status, body)
 
-        httpd = ThreadingHTTPServer((host, port), Handler)
-        httpd.daemon_threads = True
+        class _FrontDoor(ThreadingHTTPServer):
+            daemon_threads = True
+            # a client fleet opening hundreds of keep-alive
+            # connections at once overflows the stdlib default listen
+            # backlog of 5 into connection-refused errors
+            request_queue_size = 128
+
+        httpd = _FrontDoor((host, port), Handler)
         obs_events.emit("fleet.router_up", host=host,
                         port=httpd.server_port)
         return httpd
@@ -547,3 +863,5 @@ class FleetRouter:
         if self._mirror_thread is not None:
             self._mirror_thread.join(timeout=5.0)
             self._mirror_thread = None
+        for p in self._pool_list():
+            p.retire_all()

@@ -9,6 +9,15 @@ the batching opportunity.  Endpoints:
   (add ``"raw": true`` for the full score rows)
 * ``POST /extract``  — ``{"data": ..., "node": "fc1"}`` →
   ``{"features": [[...], ...]}``
+
+Both data routes also negotiate the binary zero-copy wire
+(``serve/wire.py``; doc/serving.md "Binary wire protocol"): a request
+with ``Content-Type: application/x-cxb`` carries a ``CXB1`` frame whose
+payload is decoded with ``np.frombuffer`` straight into the
+micro-batcher, and the response streams raw f32 rows back as a ``CXR1``
+frame — no ``tolist()``, no ``json.dumps``.  JSON requests are
+byte-for-byte unchanged; malformed frames are 400 with a stable
+``reason`` token, and error bodies are always JSON.
 * ``POST /feedback`` — ``{"data": [[...], ...], "label": [...]}`` →
   ``{"appended": n}``: append labeled instances to the closed-loop
   feedback log (``task=serve_train``; doc/continuous_training.md).
@@ -70,8 +79,10 @@ from typing import Optional, Tuple
 import numpy as np
 
 from ..utils import faults
+from . import wire
 from .batcher import ServeError
 from .engine import Engine
+from .metrics import serve_metrics
 
 __all__ = ["make_server", "serve_forever", "replica_fault_probe"]
 
@@ -169,17 +180,38 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _read_json(self, rid: str) -> Optional[dict]:
+    def _read_body(self, rid: str) -> Optional[bytes]:
+        """Read the request body under the size bound, or reply 400 and
+        return None.  Every reject that leaves bytes unread (oversized,
+        or a framing we cannot drain) also closes the connection so the
+        unread bytes can never desync the next request on a kept-alive
+        HTTP/1.1 socket."""
+        if self.headers.get("Transfer-Encoding"):
+            # stdlib handlers do not decode chunked bodies; an undrained
+            # chunked stream would wedge keep-alive framing
+            self.close_connection = True
+            self._reply(400, {"error": "chunked bodies are not supported",
+                              "rid": rid})
+            return None
         try:
             length = int(self.headers.get("Content-Length", 0))
         except ValueError:
             length = 0
-        if length <= 0 or length > MAX_BODY_BYTES:
-            self._reply(400, {"error": "missing or oversized body",
-                              "rid": rid})
+        if length > MAX_BODY_BYTES:
+            self.close_connection = True
+            self._reply(400, {"error": "oversized body", "rid": rid})
+            return None
+        if length <= 0:
+            self._reply(400, {"error": "missing body", "rid": rid})
+            return None
+        return self.rfile.read(length)
+
+    def _read_json(self, rid: str) -> Optional[dict]:
+        body = self._read_body(rid)
+        if body is None:
             return None
         try:
-            obj = json.loads(self.rfile.read(length).decode("utf-8"))
+            obj = json.loads(body.decode("utf-8"))
         except (ValueError, UnicodeDecodeError) as e:
             self._reply(400, {"error": f"bad JSON: {e}", "rid": rid})
             return None
@@ -272,9 +304,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": f"unknown route {self.path}",
                               "rid": rid})
             return
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+        if ctype.strip().lower() == wire.CONTENT_TYPE:
+            self._do_post_wire(rid)
+            return
         obj = self._read_json(rid)
         if obj is None:
             return
+        if self.path != "/feedback":
+            serve_metrics().wire_requests.labels(wire="json").inc()
         engine, feedback = self.engine, self.feedback
         if self.router is not None:
             from .router import UnknownModelError
@@ -311,6 +349,93 @@ class _Handler(BaseHTTPRequestHandler):
                 if (self.capture_predict and feedback is not None
                         and kind == "predict"):
                     self._capture(obj["data"], out, feedback)
+        except ServeError as e:
+            self._reply(e.http_status, {"error": str(e), "rid": rid})
+        except (ValueError, TypeError) as e:
+            self._reply(400, {"error": str(e), "rid": rid})
+        except Exception as e:  # noqa: BLE001 - served as a 500
+            self._reply(500, {"error": f"{type(e).__name__}: {e}",
+                              "rid": rid})
+
+    def _do_post_wire(self, rid: str) -> None:
+        """The ``application/x-cxb`` data plane (doc/serving.md
+        "Binary wire protocol"): decode the frame with ``np.frombuffer``
+        straight over the request body (zero-copy into the
+        micro-batcher), stream raw f32 rows back.  Malformed frames are
+        400 with a stable ``reason`` token, never a 500; error bodies
+        stay JSON so a failing client can always read them."""
+        m = serve_metrics()
+        # drain the body BEFORE any reject: unread bytes would desync
+        # the next request on this kept-alive socket
+        body = self._read_body(rid)
+        if body is None:
+            return
+        m.wire_requests.labels(wire="binary").inc()
+        m.wire_bytes.labels(dir="in").inc(len(body))
+        if self.path == "/feedback":
+            self._reply(400, {
+                "error": "binary wire covers /predict and /extract; "
+                         "/feedback stays JSON",
+                "reason": "wire_unsupported_route", "rid": rid})
+            return
+        try:
+            req = wire.decode_request(body)
+        except wire.WireError as e:
+            self._reply(400, {"error": str(e), "reason": e.reason,
+                              "rid": rid})
+            return
+        engine, feedback = self.engine, self.feedback
+        if self.router is not None:
+            from .router import UnknownModelError
+
+            try:
+                _name, engine, feedback = self.router.resolve(
+                    req.model or None)
+            except UnknownModelError as e:
+                self._reply(404, {"error": str(e), "reason": e.reason,
+                                  "models": e.known, "rid": rid})
+                return
+        if getattr(engine, "wire", "binary") != "binary":
+            self._reply(400, {
+                "error": "binary wire is disabled (wire = json)",
+                "reason": "wire_disabled", "rid": rid})
+            return
+        try:
+            if self.path == "/extract":
+                if req.kind != "extract" or not req.node:
+                    self._reply(400, {
+                        "error": "extract frames need kind=extract and "
+                                 "a node name", "reason": "bad_kind",
+                        "rid": rid})
+                    return
+                kind = "extract"
+                out = engine.extract(req.data, req.node,
+                                     deadline_ms=req.deadline_ms)
+            else:
+                if req.kind not in ("predict", "scores"):
+                    self._reply(400, {
+                        "error": f"/predict frames carry kind predict "
+                                 f"or scores, not {req.kind}",
+                        "reason": "bad_kind", "rid": rid})
+                    return
+                kind = req.kind
+                out = engine.submit(req.data, kind=kind,
+                                    deadline_ms=req.deadline_ms)
+            head, payload = wire.encode_response_header(
+                np.asarray(out), kind, rid)
+            self.send_response(200)
+            self.send_header("Content-Type", wire.CONTENT_TYPE)
+            self.send_header("Content-Length",
+                             str(len(head) + payload.nbytes))
+            self.end_headers()
+            # header then the array's own buffer: the scores leave the
+            # process without a tolist() or a joined-body copy
+            self.wfile.write(head)
+            self.wfile.write(memoryview(payload).cast("B"))
+            m.wire_bytes.labels(dir="out").inc(len(head) + payload.nbytes)
+            if (self.capture_predict and feedback is not None
+                    and kind == "predict"):
+                self._capture(req.data, out, feedback)
         except ServeError as e:
             self._reply(e.http_status, {"error": str(e), "rid": rid})
         except (ValueError, TypeError) as e:
@@ -397,8 +522,13 @@ def make_server(
          "rid_token": os.urandom(3).hex(),
          "rid_counter": itertools.count(1)},
     )
-    httpd = ThreadingHTTPServer((host, port), handler)
-    httpd.daemon_threads = True
+    class _ServeHTTPServer(ThreadingHTTPServer):
+        daemon_threads = True
+        # survive a client fleet connecting at once (the stdlib
+        # default listen backlog of 5 refuses the overflow)
+        request_queue_size = 128
+
+    httpd = _ServeHTTPServer((host, port), handler)
     httpd.inflight = gauge
     return httpd
 

@@ -31,14 +31,27 @@ Predictions are a pure function of the input row (sum of the row,
 scaled, mod 7, plus the disagree offset) so two healthy stubs always
 agree and a ``--disagree`` stub never does.
 
+``/predict`` also speaks the binary wire format (``Content-Type:
+application/x-cxb``, doc/serving.md "Binary wire protocol") with a
+stdlib mirror of ``serve/wire.py`` (``struct`` + ``array`` — still no
+numpy), so router relay/failover/canary tests can exercise binary
+frames without a real replica.
+
 Run directly (NOT ``-m``): ``python cxxnet_tpu/serve/stub.py --port N``.
 """
 
 import argparse
 import json
+import struct
+import sys
 import threading
 import time
+from array import array
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+# CXB1 / CXR1 header layouts (keep in lock-step with serve/wire.py)
+_REQ = struct.Struct("<4sBBBBIHH")
+_RESP = struct.Struct("<4sBBBBHH")
 
 
 def main() -> int:
@@ -124,14 +137,82 @@ def main() -> int:
             else:
                 self._reply(404, {"error": f"unknown route {self.path}"})
 
+        def _predict_wire(self, raw):
+            """Binary /predict: parse a CXB1 frame, answer a CXR1
+            frame with the same sum-mod-7 prediction as JSON."""
+            if len(raw) < _REQ.size:
+                self._reply(400, {"error": "short frame",
+                                  "reason": "truncated_frame"})
+                return
+            magic, kind, dtype, ndim, _prio, deadline, mlen, nlen = \
+                _REQ.unpack_from(raw, 0)
+            if magic != b"CXB1":
+                self._reply(400, {"error": "bad frame magic",
+                                  "reason": "bad_magic"})
+                return
+            if dtype != 1 or not 1 <= ndim <= 8:
+                self._reply(400, {"error": "unsupported frame encoding",
+                                  "reason": "bad_dtype"})
+                return
+            dims = struct.unpack_from("<%dI" % ndim, raw, _REQ.size)
+            ofs = _REQ.size + 4 * ndim + mlen + nlen
+            count = 1
+            for d in dims:
+                count *= d
+            if len(raw) != ofs + 4 * count:
+                self._reply(400, {"error": "payload length mismatch",
+                                  "reason": "truncated_body"})
+                return
+            deadline_ms = float(deadline) if deadline else None
+            if args.delay_ms > 0:
+                time.sleep(args.delay_ms / 1e3)
+            if (deadline_ms is not None
+                    and args.delay_ms >= deadline_ms):
+                self._reply(504, {"error": "deadline expired"})
+                return
+            vals = array("f")
+            vals.frombytes(raw[ofs:])
+            if sys.byteorder == "big":
+                vals.byteswap()
+            rows = dims[0]
+            per = count // rows if rows else 0
+            pred = array("f", (
+                float((int(round(sum(vals[i * per:(i + 1) * per])
+                                 * 1e3)) % 7) + args.disagree)
+                for i in range(rows)))
+            if sys.byteorder == "big":
+                pred.byteswap()
+            with lock:
+                state["predicts"] += 1
+            rid = b"stub"
+            head = _RESP.pack(b"CXR1", kind, 1, 1, 0, len(rid), 0)
+            head += struct.pack("<I", rows) + rid
+            body = head + pred.tobytes()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-cxb")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_POST(self):  # noqa: N802 - stdlib name
             self._enter()
             try:
                 n = int(self.headers.get("Content-Length", 0))
             except ValueError:
                 n = 0
+            raw = self.rfile.read(n) if n > 0 else b""
+            ctype = (self.headers.get("Content-Type") or "") \
+                .split(";")[0].strip().lower()
+            if ctype == "application/x-cxb":
+                if self.path != "/predict":
+                    self._reply(400, {
+                        "error": "binary frames only on /predict",
+                        "reason": "wire_unsupported_route"})
+                    return
+                self._predict_wire(raw)
+                return
             try:
-                obj = json.loads(self.rfile.read(n) or b"{}")
+                obj = json.loads(raw or b"{}")
             except ValueError:
                 obj = {}
             if self.path == "/wedge":
@@ -181,8 +262,11 @@ def main() -> int:
             else:
                 self._reply(404, {"error": f"unknown route {self.path}"})
 
-    httpd = ThreadingHTTPServer(("127.0.0.1", args.port), Handler)
-    httpd.daemon_threads = True
+    class _StubHTTPServer(ThreadingHTTPServer):
+        daemon_threads = True
+        request_queue_size = 128
+
+    httpd = _StubHTTPServer(("127.0.0.1", args.port), Handler)
     print(f"STUB READY {httpd.server_port}", flush=True)
     try:
         httpd.serve_forever(poll_interval=0.5)

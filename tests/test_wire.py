@@ -1,0 +1,524 @@
+"""Binary wire protocol tests (doc/serving.md "Binary wire protocol").
+
+Four layers, outermost first: the pure codec (frame round-trip, every
+malformed-frame reason token), the single-engine HTTP surface
+(cross-wire parity — binary scores must be BITWISE equal to what the
+JSON path serves — plus fuzzing that can never 500), the stdlib stub
+replica's binary branch, and the fleet router (opaque relay, pooled
+keep-alive dispatch, admission/deadline parity with JSON).
+"""
+
+import http.client
+import json
+import os
+import re
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import serve
+from cxxnet_tpu.serve import wire
+from test_fleet import make_opts, start_stub_fleet
+from test_serve import make_trainer, toy_rows
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RID_RE = re.compile(r"[0-9a-f]{6}-\d+")
+
+
+def post_raw(port, path, body, ctype, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": ctype})
+        r = conn.getresponse()
+        return r.status, r.read(), (r.getheader("Content-Type") or "")
+    finally:
+        conn.close()
+
+
+# ----------------------------------------------------------------------
+# codec
+def test_codec_roundtrip():
+    x = np.arange(24, dtype="<f4").reshape(2, 3, 4)
+    frame = wire.encode_request(x, kind="extract", model="m", node="fc1",
+                                priority="batch", deadline_ms=250)
+    kind, model, priority, dl, nbytes = wire.peek_header(frame)
+    assert (kind, model, priority, dl) == ("extract", "m", "batch", 250.0)
+    assert nbytes == x.nbytes
+    req = wire.decode_request(bytes(frame))
+    assert (req.kind, req.model, req.node) == ("extract", "m", "fc1")
+    assert req.priority == "batch" and req.deadline_ms == 250.0
+    np.testing.assert_array_equal(req.data, x)
+    # zero-copy: the array is a read-only view over the frame bytes
+    assert not req.data.flags.writeable
+
+    # the router's in-place deadline patch (no re-encode)
+    before = bytes(frame)
+    wire.patch_deadline(frame, 17.4)
+    assert wire.peek_header(frame)[3] == 17.0
+    wire.patch_deadline(frame, 0)
+    assert wire.peek_header(frame)[3] is None
+    # only the 4 deadline bytes moved
+    after = bytes(frame)
+    assert before[:wire.DEADLINE_OFFSET] == after[:wire.DEADLINE_OFFSET]
+    assert before[wire.DEADLINE_OFFSET + 4:] == \
+        after[wire.DEADLINE_OFFSET + 4:]
+
+    out = np.linspace(0, 1, 8, dtype="<f4").reshape(2, 4)
+    blob = wire.encode_response(out, "scores", "rid-1")
+    k, rid, rows = wire.decode_response(blob)
+    assert (k, rid) == ("scores", "rid-1")
+    np.testing.assert_array_equal(rows, out)
+
+
+def test_codec_malformed_reasons():
+    """Every reason token is reachable and stable."""
+    x = np.ones((2, 4), dtype="<f4")
+    good = bytes(wire.encode_request(x))
+
+    def reason(buf):
+        with pytest.raises(wire.WireError) as e:
+            wire.decode_request(buf)
+        return e.value.reason
+
+    assert reason(b"EVIL" + good[4:]) == "bad_magic"
+    assert reason(good[:10]) == "truncated_frame"
+    assert reason(good[:-3]) == "truncated_body"
+    assert reason(good + b"\x00") == "trailing_bytes"
+    assert reason(good[:4] + b"\x09" + good[5:]) == "bad_kind"
+    assert reason(good[:5] + b"\x07" + good[6:]) == "bad_dtype"
+    assert reason(good[:6] + b"\x00" + good[7:]) == "bad_ndim"
+    assert reason(good[:7] + b"\x05" + good[8:]) == "bad_priority"
+    big = bytearray(good)
+    struct.pack_into("<I", big, 16, 0x40000000)  # dim0 -> 2**30 rows
+    assert reason(big) == "oversize_shape"
+    with pytest.raises(wire.WireError):
+        wire.encode_request(x, kind="nope")
+    with pytest.raises(wire.WireError):
+        wire.encode_request(x, priority="urgent")
+
+
+# ----------------------------------------------------------------------
+# single-engine HTTP surface
+@pytest.fixture(scope="module")
+def served():
+    tr = make_trainer()
+    eng = serve.Engine(trainer=tr, max_batch_size=32, batch_timeout_ms=1)
+    httpd = serve.make_server(eng, port=0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield eng, httpd.server_port
+    httpd.shutdown()
+    httpd.server_close()
+    eng.close()
+
+
+def test_http_cross_wire_parity(served):
+    """Binary answers must be BITWISE equal to JSON answers — same
+    engine, same rows, both planes; same rid minting scheme."""
+    eng, port = served
+    x = toy_rows(6)
+
+    sj, bj, _ = post_raw(
+        port, "/predict",
+        json.dumps({"data": x.tolist(), "raw": True}).encode(),
+        "application/json")
+    assert sj == 200
+    jbody = json.loads(bj)
+    jscores = np.asarray(jbody["scores"], dtype=np.float32)
+
+    sb, bb, ct = post_raw(port, "/predict",
+                          bytes(wire.encode_request(x, kind="scores")),
+                          wire.CONTENT_TYPE)
+    assert sb == 200 and ct == wire.CONTENT_TYPE
+    k, rid, wscores = wire.decode_response(bb)
+    assert k == "scores" and wscores.shape == jscores.shape
+    # tolist() of f32 round-trips through float64 repr exactly, so the
+    # two planes must agree to the bit
+    assert np.asarray(wscores, np.float32).tobytes() == jscores.tobytes()
+    assert RID_RE.fullmatch(rid), rid
+    assert RID_RE.fullmatch(jbody["rid"]), jbody["rid"]
+
+    # predict kind: class ids (as f32 on the wire)
+    sp, bp, _ = post_raw(port, "/predict",
+                         bytes(wire.encode_request(x, kind="predict")),
+                         wire.CONTENT_TYPE)
+    assert sp == 200
+    _k, _r, pred = wire.decode_response(bp)
+    jp = json.loads(post_raw(
+        port, "/predict", json.dumps({"data": x.tolist()}).encode(),
+        "application/json")[1])["pred"]
+    np.testing.assert_array_equal(np.asarray(pred).astype(np.int64),
+                                  np.asarray(jp))
+
+    # extract parity
+    se, be, _ = post_raw(
+        port, "/extract",
+        bytes(wire.encode_request(x, kind="extract", node="fc1")),
+        wire.CONTENT_TYPE)
+    assert se == 200
+    _k, _r, feats = wire.decode_response(be)
+    jf = np.asarray(json.loads(post_raw(
+        port, "/extract",
+        json.dumps({"data": x.tolist(), "node": "fc1"}).encode(),
+        "application/json")[1])["features"], np.float32)
+    assert np.asarray(feats, np.float32).tobytes() == jf.tobytes()
+
+
+def test_http_malformed_frames_never_500(served):
+    """Fuzzed frames: always a JSON 400 with the stable reason token,
+    never a 500, and the kept-alive socket survives every reject."""
+    _eng, port = served
+    x = toy_rows(2)
+    good = bytes(wire.encode_request(x))
+    big = bytearray(good)
+    struct.pack_into("<I", big, 16, 0x7FFFFFF0)
+    cases = [
+        ("bad_magic", b"EVIL" + good[4:]),
+        ("bad_kind", good[:4] + b"\x09" + good[5:]),
+        ("bad_dtype", good[:5] + b"\x07" + good[6:]),
+        ("bad_priority", good[:7] + b"\x05" + good[8:]),
+        ("truncated_frame", good[:8]),
+        ("truncated_body", good[:-4]),
+        ("trailing_bytes", good + b"\x00\x00"),
+        ("oversize_shape", bytes(big)),
+    ]
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        for want, payload in cases:
+            conn.request("POST", "/predict", body=payload,
+                         headers={"Content-Type": wire.CONTENT_TYPE})
+            r = conn.getresponse()
+            body = json.loads(r.read())
+            assert r.status == 400, (want, r.status, body)
+            assert body["reason"] == want, (want, body)
+        # wrong kind for the route
+        conn.request("POST", "/extract", body=good,
+                     headers={"Content-Type": wire.CONTENT_TYPE})
+        r = conn.getresponse()
+        assert (r.status, json.loads(r.read())["reason"]) == \
+            (400, "bad_kind")
+        # /feedback refuses binary with its own token
+        conn.request("POST", "/feedback", body=good,
+                     headers={"Content-Type": wire.CONTENT_TYPE})
+        r = conn.getresponse()
+        assert (r.status, json.loads(r.read())["reason"]) == \
+            (400, "wire_unsupported_route")
+        # the SAME socket still serves a clean request: no desync
+        conn.request("POST", "/predict", body=good,
+                     headers={"Content-Type": wire.CONTENT_TYPE})
+        r = conn.getresponse()
+        assert r.status == 200
+        wire.decode_response(r.read())
+    finally:
+        conn.close()
+
+
+def test_http_keepalive_socket_reuse(served):
+    """Satellite regression: the serving endpoints speak HTTP/1.1 with
+    correct Content-Length — two sequential requests (JSON then
+    binary) ride ONE socket, and the server never asks to close."""
+    _eng, port = served
+    x = toy_rows(3)
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        for body, ctype in (
+                (json.dumps({"data": x.tolist()}).encode(),
+                 "application/json"),
+                (bytes(wire.encode_request(x)), wire.CONTENT_TYPE),
+                (json.dumps({"data": x.tolist()}).encode(),
+                 "application/json")):
+            conn.request("POST", "/predict", body=body,
+                         headers={"Content-Type": ctype})
+            r = conn.getresponse()
+            assert r.version == 11 and r.status == 200
+            assert not r.will_close, "server dropped keep-alive"
+            r.read()
+    finally:
+        conn.close()
+
+
+def test_http_wire_disabled_and_cfg_validation():
+    tr = make_trainer()
+    eng = serve.Engine(trainer=tr, cfg=[("wire", "json")],
+                       max_batch_size=8, batch_timeout_ms=1)
+    httpd = serve.make_server(eng, port=0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        x = toy_rows(2)
+        s, b, _ = post_raw(httpd.server_port, "/predict",
+                           bytes(wire.encode_request(x)),
+                           wire.CONTENT_TYPE)
+        assert s == 400 and json.loads(b)["reason"] == "wire_disabled"
+        # JSON is untouched by the gate
+        s, b, _ = post_raw(httpd.server_port, "/predict",
+                           json.dumps({"data": x.tolist()}).encode(),
+                           "application/json")
+        assert s == 200 and "pred" in json.loads(b)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        eng.close()
+    with pytest.raises(ValueError, match="wire must be"):
+        serve.Engine(trainer=make_trainer(), cfg=[("wire", "msgpack")])
+
+
+def test_http_binary_shed_and_deadline_match_json(served):
+    """429 (queue full) and 504 (deadline) surface identically on both
+    wire formats — same status, JSON error body either way."""
+    eng, port = served
+    x = toy_rows(1)
+    release = threading.Event()
+    orig = eng.batcher._runner
+
+    def slow(kind, node, data):
+        release.wait(10.0)
+        return orig(kind, node, data)
+
+    eng.batcher._runner = slow
+    old_limit = eng.batcher.queue_limit
+    eng.batcher.queue_limit = 1
+    bg = []
+    try:
+        # occupy the worker, then fill the 1-slot queue
+        for _ in range(2):
+            t = threading.Thread(
+                target=lambda: post_raw(
+                    port, "/predict",
+                    json.dumps({"data": x.tolist()}).encode(),
+                    "application/json"), daemon=True)
+            t.start()
+            bg.append(t)
+            time.sleep(0.2)
+        for body, ctype in (
+                (json.dumps({"data": x.tolist()}).encode(),
+                 "application/json"),
+                (bytes(wire.encode_request(x)), wire.CONTENT_TYPE)):
+            s, b, rt = post_raw(port, "/predict", body, ctype)
+            assert s == 429, (ctype, s, b)
+            assert "error" in json.loads(b)
+        # deadline expiry while the worker is still held
+        for body, ctype in (
+                (json.dumps({"data": x.tolist(),
+                             "deadline_ms": 1}).encode(),
+                 "application/json"),
+                (bytes(wire.encode_request(x, deadline_ms=1)),
+                 wire.CONTENT_TYPE)):
+            s, b, _ = post_raw(port, "/predict", body, ctype)
+            assert s in (429, 504), (ctype, s, b)
+    finally:
+        release.set()
+        eng.batcher._runner = orig
+        eng.batcher.queue_limit = old_limit
+        for t in bg:
+            t.join(timeout=15)
+
+
+# ----------------------------------------------------------------------
+# micro-batcher staging assembly
+def test_batcher_staging_assembly():
+    from cxxnet_tpu.serve.batcher import _Request
+
+    def runner(kind, node, data):
+        return data * 2.0
+
+    mb = serve.MicroBatcher(runner, max_batch_size=64,
+                            batch_timeout_ms=20.0, queue_limit=128)
+    try:
+        reqs = [_Request(kind="out", node=None,
+                         data=np.full((2, 3), i, np.float32),
+                         enqueue_t=0.0, deadline_t=None)
+                for i in range(3)]
+        out = mb._assemble(reqs)
+        np.testing.assert_array_equal(
+            out, np.concatenate([r.data for r in reqs]))
+        # the staging buffer is REUSED, not reallocated per batch
+        buf = mb._staging[((3,), "<f4")]
+        assert buf.shape[0] == mb.max_batch_size
+        mb._assemble(reqs)
+        assert mb._staging[((3,), "<f4")] is buf
+        # concurrent submits through the worker stay row-aligned
+        xs = [np.full((i + 1, 3), float(i), np.float32)
+              for i in range(8)]
+        outs = [None] * len(xs)
+
+        def go(i):
+            outs[i] = np.array(mb.submit(xs[i]))
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(len(xs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, x in enumerate(xs):
+            np.testing.assert_array_equal(outs[i], x * 2.0)
+    finally:
+        mb.close()
+
+
+# ----------------------------------------------------------------------
+# stub replica binary branch
+def test_stub_binary_predict_and_keepalive():
+    from cxxnet_tpu.parallel.elastic import free_port
+
+    port = free_port()
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(REPO, "cxxnet_tpu", "serve", "stub.py"),
+         "--port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                c = http.client.HTTPConnection("127.0.0.1", port,
+                                               timeout=1)
+                c.request("GET", "/healthz")
+                c.getresponse().read()
+                c.close()
+                break
+            except OSError:
+                time.sleep(0.05)
+        x = np.round(np.random.RandomState(0).rand(3, 4), 3) \
+            .astype(np.float32)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            # JSON leg
+            conn.request("POST", "/predict",
+                         body=json.dumps({"data": x.tolist()}).encode(),
+                         headers={"Content-Type": "application/json"})
+            r = conn.getresponse()
+            assert r.status == 200 and not r.will_close
+            jpred = json.loads(r.read())["pred"]
+            # binary leg on the SAME socket — stub agrees bit-for-bit
+            conn.request("POST", "/predict",
+                         body=bytes(wire.encode_request(x)),
+                         headers={"Content-Type": wire.CONTENT_TYPE})
+            r = conn.getresponse()
+            assert r.status == 200 and not r.will_close
+            k, rid, pred = wire.decode_response(r.read())
+            assert (k, rid) == ("predict", "stub")
+            np.testing.assert_array_equal(
+                np.asarray(pred).astype(int), np.asarray(jpred))
+            # malformed frame: 400 + reason, socket still in sync
+            conn.request("POST", "/predict",
+                         body=b"EVIL" + bytes(wire.encode_request(x))[4:],
+                         headers={"Content-Type": wire.CONTENT_TYPE})
+            r = conn.getresponse()
+            assert r.status == 400
+            assert json.loads(r.read())["reason"] == "bad_magic"
+            conn.request("GET", "/healthz")
+            assert conn.getresponse().status == 200
+        finally:
+            conn.close()
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+# ----------------------------------------------------------------------
+# fleet router: opaque relay + pooled dispatch
+def test_fleet_pool_size_cfg():
+    from cxxnet_tpu.serve import FleetOptions
+
+    opts = FleetOptions.from_cfg([("replicas", "2"),
+                                  ("fleet_pool_size", "3")])
+    assert opts.pool_size == 3
+    assert FleetOptions.from_cfg([("replicas", "2")]).pool_size == 8
+    with pytest.raises(ValueError, match="fleet_pool_size"):
+        FleetOptions.from_cfg([("replicas", "2"),
+                               ("fleet_pool_size", "0")])
+
+
+def test_router_binary_relay_pool_and_admission():
+    fleet = start_stub_fleet(make_opts())
+    try:
+        x = np.ones((2, 4), np.float32)
+        status, body, ctype = fleet.router.route_wire(
+            "/predict", wire.encode_request(x, deadline_ms=5000),
+            "interactive", 5000)
+        assert status == 200 and ctype == wire.CONTENT_TYPE
+        k, rid, pred = wire.decode_response(body)
+        assert (k, rid) == ("predict", "stub") and pred.shape == (2,)
+        # the JSON plane through the same router agrees
+        sj, bj = fleet.router.route("/predict", {"data": x.tolist()})
+        assert sj == 200
+        np.testing.assert_array_equal(
+            np.asarray(pred).astype(int), np.asarray(bj["pred"]))
+        # pooled dispatch parked the keep-alive connections
+        stats = fleet.router.pool_stats()
+        assert sum(stats.values()) >= 1, stats
+        # eject/reload hook surface: retiring empties the pool
+        addr = max(stats, key=stats.get)
+        assert fleet.router.retire_replica_pool(addr) >= 1
+        assert fleet.router.pool_stats()[addr] == 0
+        # binary admission: zero capacity sheds with a JSON 429 body
+        old = fleet.opts.replica_inflight
+        fleet.opts.replica_inflight = 0
+        s429, b429, ct429 = fleet.router.route_wire(
+            "/predict", wire.encode_request(x), "batch")
+        assert s429 == 429 and ct429 == "application/json"
+        assert "load shed" in json.loads(b429)["error"]
+        fleet.opts.replica_inflight = old
+        # expired budget before any dispatch: same 504 as JSON
+        s504, b504, _ = fleet.router.route_wire(
+            "/predict", wire.encode_request(x), "interactive", 1e-6)
+        assert s504 == 504 and "deadline" in json.loads(b504)["error"]
+    finally:
+        fleet.close(drain_timeout_s=0.0)
+
+
+def test_router_httpd_binary_front_door():
+    """End-to-end through the router's OWN HTTP surface: binary frames
+    negotiate, relay, and fail safely on one kept-alive socket."""
+    fleet = start_stub_fleet(make_opts())
+    httpd = fleet.router.make_httpd("127.0.0.1", 0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    port = httpd.server_port
+    try:
+        x = np.ones((3, 4), np.float32)
+        frame = bytes(wire.encode_request(x, deadline_ms=5000))
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            conn.request("POST", "/predict", body=frame,
+                         headers={"Content-Type": wire.CONTENT_TYPE})
+            r = conn.getresponse()
+            body = r.read()
+            assert r.status == 200 and not r.will_close
+            _k, _rid, pred = wire.decode_response(body)
+            assert pred.shape == (3,)
+            # malformed at the front door: 400 + token, socket survives
+            conn.request("POST", "/predict", body=b"EVIL" + frame[4:],
+                         headers={"Content-Type": wire.CONTENT_TYPE})
+            r = conn.getresponse()
+            assert r.status == 400
+            assert json.loads(r.read())["reason"] == "bad_magic"
+            # binary to /feedback: refused with the stable token
+            conn.request("POST", "/feedback", body=frame,
+                         headers={"Content-Type": wire.CONTENT_TYPE})
+            r = conn.getresponse()
+            assert r.status == 400
+            assert json.loads(r.read())["reason"] == \
+                "wire_unsupported_route"
+            # same socket, JSON plane: still in sync
+            conn.request("POST", "/predict",
+                         body=json.dumps({"data": x.tolist()}).encode(),
+                         headers={"Content-Type": "application/json"})
+            r = conn.getresponse()
+            assert r.status == 200
+            assert json.loads(r.read())["pred"]
+        finally:
+            conn.close()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        fleet.close(drain_timeout_s=0.0)

@@ -16,11 +16,21 @@ replica mid-run and assert:
 * **front door** — aggregate ``/healthz`` degrades while the replica
   is down and returns to ``ok`` after the restart.
 
+``--no-kill`` turns the same harness into the pure load story (the
+ISSUE-19 acceptance): no SIGKILL, the burst runs to completion —
+``--total-requests 1000000`` for the million-request proof — and the
+acceptance is zero non-shed protocol errors end to end.  ``--wire
+binary`` drives CXB1 frames over the pooled keep-alive client
+(doc/serving.md "Binary wire protocol") instead of JSON; ``--rows``
+sets rows per request and ``--progress-s`` streams running p50/p99.
+
 Prints one JSON verdict on stdout; exit 0 on pass, 1 on fail.
 
 Usage::
 
     python tools/fleet_smoke.py --out /tmp/_fleet_smoke [--replicas 3]
+    python tools/fleet_smoke.py --out /tmp/_wire_burst --no-kill \
+        --wire binary --total-requests 1000000 --clients 128
 """
 
 from __future__ import annotations
@@ -96,6 +106,26 @@ def main(argv=None) -> int:
     ap.add_argument("--restart-budget", type=float, default=120.0,
                     help="max seconds from kill to healthy again")
     ap.add_argument("--start-timeout", type=float, default=300.0)
+    ap.add_argument("--wire", default="json",
+                    choices=("json", "binary"),
+                    help="wire format for the load client (binary = "
+                         "CXB1 frames, doc/serving.md)")
+    ap.add_argument("--rows", type=int, default=1,
+                    help="rows per request")
+    ap.add_argument("--total-requests", type=int, default=0,
+                    help="stop the burst after this many arrivals "
+                         "instead of the duration window")
+    ap.add_argument("--clients", type=int, default=32,
+                    help="burst-driver worker pool size")
+    ap.add_argument("--progress-s", type=float, default=0.0,
+                    help="stream running burst counts + p50/p99 to "
+                         "stderr every N seconds (0 = off)")
+    ap.add_argument("--no-kill", action="store_true",
+                    help="pure load story: run the burst to completion "
+                         "with no replica SIGKILL (the >= 10^6-request "
+                         "acceptance)")
+    ap.add_argument("--burst-timeout", type=float, default=3600.0,
+                    help="--no-kill: max seconds to wait for the burst")
     args = ap.parse_args(argv)
 
     os.makedirs(args.out, exist_ok=True)
@@ -160,50 +190,64 @@ def main(argv=None) -> int:
         import numpy as np
         import serve_bench
 
-        x = np.full((1, 16), 0.5, np.float32)
-        fire = serve_bench.make_url_fire(f"http://127.0.0.1:{port}", x)
+        x = np.full((args.rows, 16), 0.5, np.float32)
+        verdict["wire"] = args.wire
+        fire = serve_bench.make_url_fire(f"http://127.0.0.1:{port}", x,
+                                         wire_fmt=args.wire)
         burst_box = {}
 
         def _load():
             burst_box["burst"] = serve_bench.open_loop_burst(
                 fire, args.base_rate, args.burst_rate, args.phase,
                 duration_s=args.load_before_kill + args.restart_budget,
-                clients=32)
+                total_requests=args.total_requests,
+                clients=args.clients, progress_s=args.progress_s)
 
         load_thread = threading.Thread(target=_load, daemon=True)
         load_thread.start()
-        time.sleep(args.load_before_kill)
 
-        # 4. SIGKILL one serving replica mid-load
-        st = _get(port, "/statsz")
-        victim = next(rep for rep in st["replicas"]
-                      if rep["role"] == "serve"
-                      and rep["state"] == "healthy" and rep["pid"])
-        os.kill(victim["pid"], signal.SIGKILL)
-        t_kill = time.monotonic()
-        verdict["killed"] = {"idx": victim["idx"], "pid": victim["pid"]}
-
-        # 5. wait for detection + restart back to full rotation
-        degraded_seen = False
         restart_wall = None
-        while time.monotonic() - t_kill < args.restart_budget:
-            h = _get(port, "/healthz")
-            if h["status"] != "ok":
-                degraded_seen = True
-            if degraded_seen and h["replicas"]["healthy"] == args.replicas:
-                st = _get(port, "/statsz")
-                restart_wall = st["last_restart_wall_s"]
-                break
-            time.sleep(0.25)
-        if restart_wall is None:
-            raise RuntimeError(
-                f"replica not restarted within {args.restart_budget:g}s "
-                f"(degraded_seen={degraded_seen})")
-        verdict["restart_wall_s"] = restart_wall
-        verdict["kill_to_healthy_s"] = time.monotonic() - t_kill
-        verdict["degraded_seen"] = degraded_seen
+        if args.no_kill:
+            # the pure load story: let the burst run to completion
+            load_thread.join(timeout=args.burst_timeout)
+            if load_thread.is_alive():
+                raise RuntimeError(
+                    f"burst still running after {args.burst_timeout:g}s")
+        else:
+            time.sleep(args.load_before_kill)
 
-        load_thread.join(timeout=args.restart_budget + 60)
+            # 4. SIGKILL one serving replica mid-load
+            st = _get(port, "/statsz")
+            victim = next(rep for rep in st["replicas"]
+                          if rep["role"] == "serve"
+                          and rep["state"] == "healthy" and rep["pid"])
+            os.kill(victim["pid"], signal.SIGKILL)
+            t_kill = time.monotonic()
+            verdict["killed"] = {"idx": victim["idx"],
+                                 "pid": victim["pid"]}
+
+            # 5. wait for detection + restart back to full rotation
+            degraded_seen = False
+            while time.monotonic() - t_kill < args.restart_budget:
+                h = _get(port, "/healthz")
+                if h["status"] != "ok":
+                    degraded_seen = True
+                if (degraded_seen
+                        and h["replicas"]["healthy"] == args.replicas):
+                    st = _get(port, "/statsz")
+                    restart_wall = st["last_restart_wall_s"]
+                    break
+                time.sleep(0.25)
+            if restart_wall is None:
+                raise RuntimeError(
+                    f"replica not restarted within "
+                    f"{args.restart_budget:g}s "
+                    f"(degraded_seen={degraded_seen})")
+            verdict["restart_wall_s"] = restart_wall
+            verdict["kill_to_healthy_s"] = time.monotonic() - t_kill
+            verdict["degraded_seen"] = degraded_seen
+            load_thread.join(timeout=args.restart_budget + 60)
+
         burst = burst_box.get("burst") or {}
         verdict["burst"] = burst
         st = _get(port, "/statsz")
@@ -211,8 +255,19 @@ def main(argv=None) -> int:
                              ("requests", "shed", "failovers",
                               "relayed_5xx", "unroutable", "expired")}
         verdict["restarts_total"] = st["restarts_total"]
+        lat = burst.get("latency_ms", {})
+        print(f"bench[fleet_burst:{args.wire}] sent "
+              f"{burst.get('sent', 0)} ok {burst.get('completed', 0)} "
+              f"shed {burst.get('shed', 0)} "
+              f"expired {burst.get('expired', 0)} "
+              f"err {burst.get('errors', 1)} "
+              f"achieved {burst.get('achieved_req_per_sec', 0.0):.1f} "
+              f"req/s p50 {lat.get('p50', float('nan')):.2f} ms "
+              f"p99 {lat.get('p99', float('nan')):.2f} ms",
+              file=sys.stderr, flush=True)
 
-        # 6. the acceptance: zero non-shed failures, restart in budget
+        # 6. the acceptance: zero non-shed failures (and, in the kill
+        # variant, a restart inside the budget)
         problems = []
         if burst.get("errors", 1) != 0:
             problems.append(f"burst errors {burst.get('errors')}")
@@ -222,11 +277,12 @@ def main(argv=None) -> int:
             problems.append(f"relayed_5xx {st['relayed_5xx']}")
         if st["unroutable"] != 0:
             problems.append(f"unroutable {st['unroutable']}")
-        if restart_wall > args.restart_budget:
-            problems.append(f"restart_wall_s {restart_wall:.1f} > "
-                            f"budget {args.restart_budget:g}")
-        if st["restarts_total"] < 1:
-            problems.append("no restart recorded")
+        if not args.no_kill:
+            if restart_wall > args.restart_budget:
+                problems.append(f"restart_wall_s {restart_wall:.1f} > "
+                                f"budget {args.restart_budget:g}")
+            if st["restarts_total"] < 1:
+                problems.append("no restart recorded")
         verdict["problems"] = problems
         verdict["ok"] = not problems
     except Exception as e:  # noqa: BLE001 - verdict carries the failure

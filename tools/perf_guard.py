@@ -121,6 +121,31 @@ def _flatten_burst(burst: dict, out: Dict[str, float]) -> None:
     _walk_numbers("burst.latency_ms", burst.get("latency_ms", {}), out)
 
 
+def flatten_wire_bench(doc: dict) -> Dict[str, float]:
+    """The WIRE lane's series (``serve_bench --wire-ab``): both wire
+    formats' HTTP closed-loop throughput and latency, the binary/JSON
+    speedup, and the bitwise score-parity bit (1.0 = equal).  A change
+    that quietly erodes the zero-copy win — an extra decode copy, a
+    lost keep-alive — drifts the speedup down here even while the hard
+    >= 1.5x lane assertion still passes."""
+    out: Dict[str, float] = {}
+    ab = doc.get("wire_ab", {})
+    for leg in ("json", "binary"):
+        d = ab.get(leg, {})
+        for key in ("req_per_sec", "rows_per_sec"):
+            v = d.get(key)
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                out[f"{leg}.{key}"] = float(v)
+        _walk_numbers(f"{leg}.latency_ms", d.get("latency_ms", {}), out)
+    v = ab.get("speedup")
+    if isinstance(v, (int, float)) and math.isfinite(v):
+        out["speedup"] = float(v)
+    out["bitwise_equal_scores"] = float(
+        bool(ab.get("bitwise_equal_scores")))
+    _flatten_burst(doc.get("open_loop_burst", {}), out)
+    return out
+
+
 def flatten_mesh_parity(doc: dict) -> Dict[str, float]:
     """Wall time + compile/program counts from a ``tools/mesh_parity.py``
     verdict — the one-program claim as a banded series: a change that
@@ -337,6 +362,13 @@ FLATTENERS = {"io_bench": flatten_io_bench,
               "crash_audit": flatten_crash_audit,
               "elastic_crash": flatten_elastic_crash,
               "serve_bench": flatten_serve_bench,
+              "wire_bench": flatten_wire_bench,
+              # the >= 10^6-request binary burst verdict
+              # (fleet_smoke --no-kill --wire binary) shares the
+              # fleet verdict shape but is its own series — mixing it
+              # into fleet_bench would band the kill-lane numbers
+              # against a different config
+              "wire_burst": flatten_fleet_bench,
               "mesh_parity": flatten_mesh_parity,
               "quant_bench": flatten_quant_bench,
               "elastic": flatten_elastic,

@@ -99,6 +99,17 @@
 #                                     # profile; both land in a
 #                                     # perf_guard history
 #                                     # (fleet_bench / serve_bench)
+#        WIRE=1 tools/run_tier1.sh    # also run the binary wire-format
+#                                     # A/B: serve_bench --wire-ab
+#                                     # drives JSON and CXB1-frame
+#                                     # closed-loop legs over real HTTP
+#                                     # (pooled keep-alive clients) and
+#                                     # the binary plane must be
+#                                     # >= 1.5x JSON req/s with BITWISE
+#                                     # equal scores (doc/serving.md
+#                                     # "Binary wire protocol"); the
+#                                     # report appends to a perf_guard
+#                                     # history (wire_bench flattener)
 #        ASYNC=1 tools/run_tier1.sh   # also run the async data-parallel
 #                                     # lane: a 4-process CPU-mesh CLI
 #                                     # train with async_overlap=1,
@@ -315,6 +326,31 @@ if [ "${FLEET:-0}" = "1" ]; then
       --input "$fleet_out/burst.json" \
       --history "$fleet_out/bench_history.jsonl" > /dev/null || rc=1
   echo "FLEET lane verdict: $fleet_out/fleet_smoke.json"
+fi
+if [ "${WIRE:-0}" = "1" ]; then
+  echo "=== opt-in binary wire-format A/B (WIRE=1) ==="
+  wire_out=/tmp/_wire_ab
+  rm -rf "$wire_out"; mkdir -p "$wire_out"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python tools/serve_bench.py --wire-ab --rows 32 --concurrency 8 \
+      --requests 60 --max-batch 256 --timeout-ms 1 \
+      --json "$wire_out/wire_ab.json" > /dev/null || rc=1
+  # the hard acceptance bar: binary >= 1.5x JSON req/s, bitwise-equal
+  # scores (the parity bit is also serve_bench's own exit status)
+  python - "$wire_out/wire_ab.json" <<'PYEOF' || rc=1
+import json, sys
+ab = json.load(open(sys.argv[1]))["wire_ab"]
+ok = ab["bitwise_equal_scores"] and ab["speedup"] >= 1.5
+print(f"WIRE lane: speedup {ab['speedup']:.3f} (bar 1.5) parity "
+      f"{'ok' if ab['bitwise_equal_scores'] else 'FAIL'}"
+      f" -> {'OK' if ok else 'FAIL'}")
+sys.exit(0 if ok else 1)
+PYEOF
+  timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python tools/perf_guard.py --bench wire_bench \
+      --input "$wire_out/wire_ab.json" \
+      --history "$wire_out/bench_history.jsonl" > /dev/null || rc=1
+  echo "WIRE lane verdict: $wire_out/wire_ab.json"
 fi
 if [ "${ASYNC:-0}" = "1" ]; then
   echo "=== opt-in async data-parallel lane (ASYNC=1) ==="

@@ -21,7 +21,14 @@ micro-batcher + compiled-predict-cache data path:
   p50/p99 plus explicit shed (429) / expired (504) / error counts, so
   admission-control behavior under burst pressure is a first-class
   series.  ``--url`` points the same harness at a running HTTP front
-  end (e.g. the serving fleet) instead of the in-process engine.
+  end (e.g. the serving fleet) instead of the in-process engine; the
+  URL client keeps one keep-alive connection per worker thread, and
+  ``--wire binary`` posts CXB1 frames (doc/serving.md "Binary wire
+  protocol") instead of JSON.
+* **wire A/B** (``--wire-ab``): JSON-vs-binary closed-loop throughput
+  over real HTTP against an in-process server — interleaved best-of-2
+  legs plus a bitwise score-parity check (the WIRE=1 lane's >= 1.5x
+  acceptance bar and the ``wire_bench`` perf-guard series).
 
 Prints one JSON document on stdout.
 
@@ -173,7 +180,7 @@ def open_loop(eng, x, rate, duration):
 
 
 def open_loop_burst(fire, base_rate, burst_rate, phase_s, duration_s,
-                    total_requests=0, clients=64):
+                    total_requests=0, clients=64, progress_s=0.0):
     """Square-wave open-loop driver: arrivals alternate between
     ``base_rate`` and ``burst_rate`` req/s every ``phase_s`` seconds.
 
@@ -183,7 +190,9 @@ def open_loop_burst(fire, base_rate, burst_rate, phase_s, duration_s,
     arrival queue, so arrivals are never blocked by completions; if the
     pool cannot keep up the queue overflows into ``client_drop``
     (reported — a silent cap would read as 'covered the offered load'
-    when it didn't)."""
+    when it didn't).  ``progress_s > 0`` streams running counts and
+    p50/p99 to stderr every that-many seconds — the >= 10^6-request
+    story's live telemetry."""
     import queue as _q
 
     lat = []
@@ -209,10 +218,25 @@ def open_loop_burst(fire, base_rate, burst_rate, phase_s, duration_s,
         t.start()
     t0 = time.perf_counter()
     t_next = t0
+    t_report = t0 + progress_s
     sent = 0
     while True:
         now = time.perf_counter()
         elapsed = now - t0
+        if progress_s > 0 and now >= t_report:
+            t_report = now + progress_s
+            with lock:
+                snap = sorted(lat)
+                done = dict(counts)
+            n = len(snap)
+            p50 = snap[n // 2] * 1e3 if n else float("nan")
+            p99 = snap[min(n - 1, int(n * 0.99))] * 1e3 if n \
+                else float("nan")
+            print(f"burst[{elapsed:.0f}s] sent {sent} ok {n} "
+                  f"shed {done['shed']} expired {done['expired']} "
+                  f"err {done['error']} p50 {p50:.2f} ms "
+                  f"p99 {p99:.2f} ms",
+                  file=sys.stderr, flush=True)
         if total_requests and sent >= total_requests:
             break
         if not total_requests and elapsed >= duration_s:
@@ -279,37 +303,194 @@ def make_engine_fire(eng, x, deadline_ms=0.0):
     return fire
 
 
-def make_url_fire(url, x, deadline_ms=0.0, priority=""):
-    """Burst-driver fire() over a running HTTP front end (single
-    engine or fleet router) — POST /predict per request."""
-    import urllib.error
-    import urllib.request
+def make_url_fire(url, x, deadline_ms=0.0, priority="", wire_fmt="json"):
+    """fire() over a running HTTP front end (single engine or fleet
+    router) — POST /predict per request on a **per-thread pooled
+    keep-alive connection** (``http.client``), not a fresh socket per
+    request: the old ``urlopen``-per-request client spent most of its
+    budget on TCP setup and measured the connect path, not the server.
 
-    body = {"data": x.tolist()}
-    if deadline_ms:
-        body["deadline_ms"] = deadline_ms
-    if priority:
-        body["priority"] = priority
-    payload = json.dumps(body).encode("utf-8")
+    ``wire_fmt="binary"`` posts one pre-encoded CXB1 frame per request
+    (doc/serving.md "Binary wire protocol") instead of JSON.  A stale
+    pooled connection (server restarted, idle timeout) gets one
+    fresh-socket retry; /predict is idempotent."""
+    import http.client
+    import urllib.parse
+
+    u = urllib.parse.urlsplit(url if "//" in url else "http://" + url)
+    host = u.hostname or "127.0.0.1"
+    port = u.port or 80
+    path = u.path.rstrip("/") + "/predict"
+    if wire_fmt == "binary":
+        from cxxnet_tpu.serve import wire as _wire
+
+        payload = bytes(_wire.encode_request(
+            x, kind="predict", priority=priority or "interactive",
+            deadline_ms=deadline_ms))
+        ctype = _wire.CONTENT_TYPE
+    else:
+        body = {"data": x.tolist()}
+        if deadline_ms:
+            body["deadline_ms"] = deadline_ms
+        if priority:
+            body["priority"] = priority
+        payload = json.dumps(body).encode("utf-8")
+        ctype = "application/json"
+    tls = threading.local()
 
     def fire():
         t0 = time.perf_counter()
-        req = urllib.request.Request(
-            url.rstrip("/") + "/predict", data=payload,
-            headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(req, timeout=30) as r:
+        status = None
+        for attempt in (0, 1):
+            conn = getattr(tls, "conn", None)
+            fresh = conn is None
+            if fresh:
+                conn = http.client.HTTPConnection(host, port, timeout=30)
+                tls.conn = conn
+            try:
+                conn.request("POST", path, body=payload,
+                             headers={"Content-Type": ctype})
+                r = conn.getresponse()
                 r.read()
-        except urllib.error.HTTPError as e:
-            e.read()
-            kind = ("shed" if e.code == 429
-                    else "expired" if e.code == 504 else "error")
-            return kind, time.perf_counter() - t0
-        except Exception:  # noqa: BLE001 - network errors counted
-            return "error", time.perf_counter() - t0
-        return "ok", time.perf_counter() - t0
+                status = r.status
+                if r.will_close:
+                    conn.close()
+                    tls.conn = None
+                break
+            except (http.client.HTTPException, OSError):
+                conn.close()
+                tls.conn = None
+                if fresh or attempt:
+                    return "error", time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        if status == 200:
+            return "ok", dt
+        if status == 429:
+            return "shed", dt
+        if status == 504:
+            return "expired", dt
+        return "error", dt
 
     return fire
+
+
+def closed_loop_http(fire, concurrency, requests, rows):
+    """Closed loop over a pooled HTTP fire(): each worker reuses ONE
+    keep-alive connection for all its requests."""
+    lat = []
+    errs = [0]
+    lock = threading.Lock()
+
+    def worker():
+        mine = []
+        for _ in range(requests):
+            outcome, dt = fire()
+            if outcome == "ok":
+                mine.append(dt)
+            else:
+                with lock:
+                    errs[0] += 1
+        with lock:
+            lat.extend(mine)
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    lat.sort()
+    n = len(lat)
+    out = {
+        "concurrency": concurrency,
+        "requests": n,
+        "errors": errs[0],
+        "wall_sec": wall,
+        "req_per_sec": n / wall if wall > 0 else 0.0,
+        "rows_per_sec": n * rows / wall if wall > 0 else 0.0,
+    }
+    if n:
+        out["latency_ms"] = {
+            "p50": lat[n // 2] * 1e3,
+            "p95": lat[min(n - 1, int(n * 0.95))] * 1e3,
+            "p99": lat[min(n - 1, int(n * 0.99))] * 1e3,
+        }
+    return out
+
+
+def check_wire_parity(url, x):
+    """One row batch through both planes: binary scores must be
+    BITWISE equal to the JSON scores (tolist() of f32 round-trips
+    through float64 repr exactly)."""
+    import urllib.request
+
+    from cxxnet_tpu.serve import wire as _wire
+
+    base = url.rstrip("/")
+    req = urllib.request.Request(
+        base + "/predict",
+        data=json.dumps({"data": x.tolist(), "raw": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        jscores = np.asarray(json.loads(r.read())["scores"], np.float32)
+    req = urllib.request.Request(
+        base + "/predict",
+        data=bytes(_wire.encode_request(x, kind="scores")),
+        headers={"Content-Type": _wire.CONTENT_TYPE})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        _k, _rid, wscores = _wire.decode_response(r.read())
+    return bool(np.asarray(wscores, np.float32).tobytes()
+                == jscores.tobytes())
+
+
+def run_wire_ab(args) -> dict:
+    """JSON-vs-binary wire A/B over real HTTP (the WIRE=1 lane's
+    measurement and the ``wire_bench`` perf-guard series): the engine
+    behind its own stdlib server, pooled keep-alive clients on both
+    formats, interleaved best-of-2 closed-loop legs — back to back, so
+    machine-load drift hits both equally (the autotune discipline) —
+    plus the bitwise score-parity bit."""
+    from cxxnet_tpu import serve
+
+    eng, x = build_engine(args)
+    httpd = serve.make_server(eng, port=0)
+    th = threading.Thread(target=httpd.serve_forever, daemon=True)
+    th.start()
+    url = f"http://127.0.0.1:{httpd.server_port}"
+    fire_j = make_url_fire(url, x, wire_fmt="json")
+    fire_b = make_url_fire(url, x, wire_fmt="binary")
+    try:
+        for _ in range(8):
+            fire_j()
+            fire_b()
+        parity = check_wire_parity(url, x)
+        half = max(8, args.requests // 2)
+        j_runs, b_runs = [], []
+        for _ in range(2):
+            b_runs.append(closed_loop_http(
+                fire_b, args.concurrency, half, x.shape[0]))
+            j_runs.append(closed_loop_http(
+                fire_j, args.concurrency, half, x.shape[0]))
+        jbest = max(j_runs, key=lambda r: r["req_per_sec"])
+        bbest = max(b_runs, key=lambda r: r["req_per_sec"])
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        eng.close()
+    return {
+        "model": args.model,
+        "dev": args.dev,
+        "rows_per_request": args.rows,
+        "max_batch_size": args.max_batch,
+        "wire_ab": {
+            "json": jbest,
+            "binary": bbest,
+            "speedup": (bbest["req_per_sec"] / jbest["req_per_sec"]
+                        if jbest["req_per_sec"] > 0 else 0.0),
+            "bitwise_equal_scores": parity,
+        },
+    }
 
 
 def run_open_loop_burst(args) -> dict:
@@ -319,7 +500,8 @@ def run_open_loop_burst(args) -> dict:
     if args.url:
         row = [0.5] * 16
         x = np.asarray([row] * args.rows, np.float32)
-        fire = make_url_fire(args.url, x, deadline_ms=args.deadline_ms)
+        fire = make_url_fire(args.url, x, deadline_ms=args.deadline_ms,
+                             wire_fmt=args.wire)
     else:
         eng, x = build_engine(args)
         for _ in range(8):
@@ -328,7 +510,7 @@ def run_open_loop_burst(args) -> dict:
     burst = open_loop_burst(
         fire, args.base_rate, args.burst_rate, args.phase,
         args.open_duration, total_requests=args.total_requests,
-        clients=args.clients)
+        clients=args.clients, progress_s=args.progress_s)
     result = {
         "model": args.model,
         "dev": args.dev,
@@ -545,12 +727,23 @@ def main(argv=None):
                          "--duration (the >= 10^6-request story)")
     ap.add_argument("--clients", type=int, default=64,
                     help="burst-driver worker pool size")
+    ap.add_argument("--progress-s", type=float, default=0.0,
+                    help="stream running burst counts + p50/p99 to "
+                         "stderr every N seconds (0 = off)")
     ap.add_argument("--deadline-ms", type=float, default=0.0,
                     help="per-request deadline for the burst driver")
     ap.add_argument("--url", default="",
                     help="drive a running HTTP front end (fleet router "
                          "or single server) instead of the in-process "
                          "engine")
+    ap.add_argument("--wire", default="json",
+                    choices=("json", "binary"),
+                    help="wire format for the --url client (binary = "
+                         "CXB1 frames, doc/serving.md)")
+    ap.add_argument("--wire-ab", action="store_true",
+                    help="JSON-vs-binary closed-loop A/B over HTTP "
+                         "(WIRE=1 lane); exits 1 if the score parity "
+                         "check fails")
     ap.add_argument("--json", dest="json_path", default="",
                     help="also write the JSON report here")
     ap.add_argument("--quant", default="",
@@ -582,6 +775,23 @@ def main(argv=None):
               f"p99 {lat.get('p99', float('nan')):.2f} ms",
               file=sys.stderr, flush=True)
         return 0 if b["errors"] == 0 else 1
+
+    if args.wire_ab:
+        result = run_wire_ab(args)
+        ab = result["wire_ab"]
+        print(json.dumps(result, indent=1))
+        if args.json_path:
+            with open(args.json_path, "w", encoding="utf-8") as f:
+                json.dump(result, f, indent=1)
+        print(f"bench[wire_ab:{args.model}] json "
+              f"{ab['json']['req_per_sec']:.1f} req/s vs binary "
+              f"{ab['binary']['req_per_sec']:.1f} req/s speedup "
+              f"{ab['speedup']:.3f} parity "
+              f"{'ok' if ab['bitwise_equal_scores'] else 'FAIL'} "
+              f"p99 {ab['json']['latency_ms']['p99']:.2f} -> "
+              f"{ab['binary']['latency_ms']['p99']:.2f} ms",
+              flush=True)
+        return 0 if ab["bitwise_equal_scores"] else 1
 
     if args.quant:
         result = run_quant_ab(args)

@@ -112,6 +112,18 @@ sweep() {
   run 2700 python tools/serve_bench.py --model mnist_mlp --dev tpu \
     --open-loop --burst --base-rate 2000 --burst-rate 8000 --phase 5 \
     --total-requests 1000000 --clients 128 --rows 8 --max-batch 128
+  # binary wire data plane (ISSUE 19 / serve/wire.py): the same
+  # >= 10^6-request burst story over CXB1 frames + pooled keep-alive
+  # clients against a REAL 3-replica fleet front end (doc/serving.md
+  # "Binary wire protocol"), plus the JSON-vs-binary closed-loop A/B
+  # at serving scale; the scaled-down twin runs in the WIRE=1 tier-1
+  # lane and the CPU fleet numbers are committed in bench_history.jsonl
+  run 900 python tools/serve_bench.py --model mnist_mlp --dev tpu \
+    --wire-ab --rows 32 --concurrency 16 --requests 200 --max-batch 256
+  run 2700 python tools/fleet_smoke.py --out /tmp/_wire_burst \
+    --no-kill --wire binary --replicas 3 --total-requests 1000000 \
+    --base-rate 2000 --burst-rate 8000 --phase 5 --clients 128 \
+    --rows 8 --progress-s 30
   # async data-parallel overlap bench (ROADMAP item 5 / PR 13): the
   # on-chip step-wall measurement — per-step fence (sync) vs one
   # round-boundary fence (async_overlap=1, staleness=1) over the same
