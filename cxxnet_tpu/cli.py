@@ -87,6 +87,16 @@ class LearnTask:
         self.reload_breaker_threshold = 3
         self.reload_breaker_cooldown_s = 30.0
         self.watchdog_timeout_s = 600.0  # serve batcher stall guard
+        # disaggregated input-data service (task=data_service,
+        # io/dataservice/, doc/io.md "Data service"): a shared decode/
+        # augment fleet member serving CXD1 batch streams
+        self.data_service_host = "127.0.0.1"
+        self.data_service_port = 0  # 0 picks an ephemeral port
+        self.data_service_http_port = 0
+        self.data_service_max_sessions = 64
+        self.data_service_cache_mb = 256.0
+        self.data_service_window = 4
+        self.data_service_ready_file = ""
         self.telemetry = 0  # per-round JSONL records (doc/observability.md)
         self.telemetry_path = "telemetry.jsonl"
         # self-tuning knob controller (cxxnet_tpu/tune/,
@@ -277,6 +287,20 @@ class LearnTask:
             self.feedback_retain_shards = int(val)
         elif name == "feedback_retain_bytes":
             self.feedback_retain_bytes = int(val)
+        elif name == "data_service_host":
+            self.data_service_host = val
+        elif name == "data_service_port":
+            self.data_service_port = int(val)
+        elif name == "data_service_http_port":
+            self.data_service_http_port = int(val)
+        elif name == "data_service_max_sessions":
+            self.data_service_max_sessions = int(val)
+        elif name == "data_service_cache_mb":
+            self.data_service_cache_mb = float(val)
+        elif name == "data_service_window":
+            self.data_service_window = int(val)
+        elif name == "data_service_ready_file":
+            self.data_service_ready_file = val
         elif name == "quant":
             self.quant = "" if val in ("0", "off", "none") else val
         elif name == "quant_min_agreement":
@@ -329,7 +353,7 @@ class LearnTask:
         if self.task not in ("train", "finetune", "pred", "pred_raw",
                              "extract", "generate", "summary", "serve",
                              "serve_train", "loop_fleet",
-                             "export_quant"):
+                             "export_quant", "data_service"):
             raise ValueError(f"unknown task {self.task!r}")
         if self.elastic_opts.join:
             # a rejoining process has no mesh yet: admission, backend
@@ -360,6 +384,8 @@ class LearnTask:
             self.task_serve_train()
         elif self.task == "loop_fleet":
             self.task_loop_fleet()
+        elif self.task == "data_service":
+            self.task_data_service()
         else:
             raise ValueError(f"unknown task {self.task!r}")
         return 0
@@ -374,6 +400,10 @@ class LearnTask:
         if self.task == "serve":
             # the serving engine owns model discovery/validation and
             # needs no data iterators — see task_serve
+            return
+        if self.task == "data_service":
+            # the server builds the conf's data chain itself (inside
+            # BatchPlant) and has no model — see task_data_service
             return
         if self.task == "export_quant":
             # the exporter loads its own trainers (f32 reference +
@@ -2020,6 +2050,60 @@ class LearnTask:
                 tuner.stop()
             engine.close()
         print("serve: shutdown complete", flush=True)
+
+    def task_data_service(self) -> None:
+        """``task=data_service``: run the shared decode/augment server
+        (doc/io.md "Data service").
+
+        Hosts the conf's ``data`` section iterator chain behind the
+        ``CXD1`` batch protocol on ``data_service_host:
+        data_service_port`` (0 picks an ephemeral port; the bound
+        address lands in ``data_service_ready_file`` for discovery) and
+        a ``/healthz``/``/statsz``/``/metricsz`` HTTP sidecar on
+        ``data_service_http_port``.  SIGTERM/SIGINT stop both planes
+        and close the chain."""
+        import signal as _signal
+        import threading
+
+        from .io.dataservice.server import DataServiceServer
+
+        split = cfgmod.split_sections(self.cfg)
+        data_secs = split.find("data")
+        if not data_secs:
+            raise ValueError(
+                "task=data_service needs a 'data = train ... iter = "
+                "end' section (the chain this server deals)")
+        if len(data_secs) > 1:
+            raise ValueError("task=data_service serves exactly one "
+                             "data section")
+        server = DataServiceServer(
+            data_secs[0].entries,
+            split.global_entries,
+            host=self.data_service_host,
+            port=self.data_service_port,
+            http_port=self.data_service_http_port,
+            max_sessions=self.data_service_max_sessions,
+            cache_bytes=int(self.data_service_cache_mb * (1 << 20)),
+            window=self.data_service_window,
+            ready_file=self.data_service_ready_file,
+            silent=bool(self.silent),
+        )
+
+        def _stop(signum, frame):
+            print("data_service: shutdown requested", flush=True)
+            # shutdown() joins serve_forever loops — never run it on
+            # the thread blocked inside serve_forever
+            threading.Thread(target=server.shutdown, daemon=True).start()
+
+        prev = {s: _signal.signal(s, _stop)
+                for s in (_signal.SIGTERM, _signal.SIGINT)}
+        try:
+            server.serve_forever()
+        finally:
+            for s, p in prev.items():
+                _signal.signal(s, p)
+            server.close()
+        print("data_service: shutdown complete", flush=True)
 
     def task_serve_train(self) -> None:
         """``task=serve_train``: the closed loop — serve, collect

@@ -192,6 +192,14 @@ def create_iterator(cfg: Sequence[ConfigEntry]) -> DataIter:
                 if it is not None:
                     raise ValueError("libsvm cannot chain over another iterator")
                 it = LibSVMIterator()
+            elif val == "service":
+                if it is not None:
+                    raise ValueError("service cannot chain over another iterator")
+                # network base iterator: streams blocks from a shared
+                # task=data_service decode fleet (io/dataservice/)
+                from .dataservice.client import ServiceIterator
+
+                it = ServiceIterator()
             elif val == "threadbuffer":
                 if it is None:
                     raise ValueError("must specify input of threadbuffer")

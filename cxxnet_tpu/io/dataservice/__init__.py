@@ -1,0 +1,19 @@
+"""Disaggregated input-data service (doc/io.md "Data service").
+
+A standalone decode/augment fleet: ``task=data_service`` hosts a conf's
+iterator chain behind the binary ``CXD1`` batch protocol
+(:mod:`.wire`); ``iter = service`` (:class:`.client.ServiceIterator`)
+is the drop-in chain base that streams from it.  The stream is
+addressed by ``(dataset fingerprint, epoch, block)`` and therefore
+bitwise-deterministic across cache hits, reconnects, and server
+restarts — the property the DSVC parity lane pins with checkpoint CRCs.
+"""
+
+from .cache import ChunkCache
+from .client import ServiceIterator
+from .server import BatchPlant, DataServiceServer, dataset_fingerprint
+
+__all__ = [
+    "ChunkCache", "ServiceIterator", "BatchPlant", "DataServiceServer",
+    "dataset_fingerprint",
+]

@@ -121,6 +121,14 @@ SITES: Dict[str, Tuple[str, ...]] = {
     # (the process exits; the supervisor must restart it with backoff)
     # — doc/robustness.md, doc/serving.md "Serving fleet"
     "serve.replica": ("hang", "ioerror"),
+    # data-service RPC (io/dataservice/client.py, the client end of the
+    # shared decode fleet): ioerror = transport loss — the client must
+    # reconnect, re-OPEN, and resume its (epoch, block) cursor with a
+    # bitwise-identical stream (the same path a server SIGKILL takes);
+    # latency = a slow service host (the stream completes, slower);
+    # hang = a wedged server — the consumer's watchdog must fail fast
+    # with WatchdogError instead of stalling the train loop forever
+    "dataservice.rpc": ("ioerror", "latency", "hang"),
     # live train state (nnet/trainer.py::start_round): bitflip = a real
     # single-bit flip in a live parameter tensor on THIS process — the
     # silent data corruption the integrity plane's fingerprint vote

@@ -1,0 +1,417 @@
+"""Data service (io/dataservice/): CXD1 wire, chunk cache, and the
+server/client determinism contract — bitwise stream parity vs the
+local chain, multi-tenant cache sharing, reconnect-resume across a
+server restart, admission shed, and session teardown."""
+
+import json
+import socket
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import create_iterator
+from cxxnet_tpu.io.dataservice import wire
+from cxxnet_tpu.io.dataservice.cache import CachedBlock, ChunkCache
+from cxxnet_tpu.io.dataservice.server import (DataServiceServer,
+                                              dataset_fingerprint)
+from cxxnet_tpu.io.mnist import write_idx_images, write_idx_labels
+
+
+# ----------------------------------------------------------------------
+# wire
+def test_wire_json_roundtrip():
+    for frame, kind, doc in [
+        (wire.encode_open(32, 1, 4, 2), wire.OPEN,
+         {"batch_size": 32, "rank": 1, "nworker": 4, "window": 2}),
+        (wire.encode_opened(7, "cafe0123", 4), wire.OPENED,
+         {"session": 7, "fingerprint": "cafe0123", "window": 4}),
+        (wire.encode_err("overloaded", "full"), wire.ERR,
+         {"reason": "overloaded", "detail": "full"}),
+    ]:
+        k, payload = wire.decode_kind(frame)
+        assert k == kind
+        assert wire.decode_json(payload) == doc
+
+
+def test_wire_fixed_roundtrip():
+    k, p = wire.decode_kind(wire.encode_get(3, 17))
+    assert k == wire.GET and wire.decode_get(p) == (3, 17)
+    k, p = wire.decode_kind(wire.encode_eoe(2, 50))
+    assert k == wire.EOE and wire.decode_eoe(p) == (2, 50)
+    k, p = wire.decode_kind(wire.encode_close())
+    assert k == wire.CLOSE and len(p) == 0
+
+
+@pytest.mark.parametrize("with_inst", [True, False])
+def test_wire_batch_roundtrip(with_inst):
+    rng = np.random.RandomState(0)
+    data = rng.rand(4, 2, 2, 3).astype(np.float32)
+    label = rng.rand(4, 2).astype(np.float32)
+    inst = np.arange(4, dtype=np.uint32) if with_inst else None
+    parts = wire.encode_batch(data, label, inst, 1, epoch=5, block=9,
+                              cache_hit=True)
+    body = b"".join(bytes(p) for p in parts)
+    k, payload = wire.decode_kind(body)
+    assert k == wire.BATCH
+    ep, blk, hit, d, lab, i, padd = wire.decode_batch(payload)
+    assert (ep, blk, hit, padd) == (5, 9, True, 1)
+    assert np.array_equal(d, data) and d.dtype == np.float32
+    assert np.array_equal(lab, label)
+    if with_inst:
+        assert np.array_equal(i, inst)
+    else:
+        assert i is None
+
+
+def _batch_body():
+    parts = wire.encode_batch(np.zeros((2, 3), np.float32),
+                              np.zeros((2, 1), np.float32),
+                              None, 0, 0, 0, False)
+    return bytearray(b"".join(bytes(p) for p in parts))
+
+
+@pytest.mark.parametrize("mutate,reason", [
+    (lambda b: b"XXXX" + bytes(b[4:]), "bad_magic"),
+    (lambda b: bytes(b[:4]) + b"\x63" + bytes(b[5:]), "bad_kind"),
+    (lambda b: bytes(b[:-4]), "truncated_body"),
+    (lambda b: bytes(b) + b"\x00\x00", "trailing_bytes"),
+    (lambda b: bytes(b[:5]), "truncated_body"),
+])
+def test_wire_malformed_batch(mutate, reason):
+    body = mutate(_batch_body())
+    with pytest.raises(wire.WireError) as ei:
+        k, payload = wire.decode_kind(body)
+        assert k == wire.BATCH
+        wire.decode_batch(payload)
+    assert ei.value.reason == reason
+
+
+def test_wire_bad_json():
+    frame = wire._HDR.pack(wire.MAGIC, wire.OPEN) + b"not json"
+    k, payload = wire.decode_kind(frame)
+    with pytest.raises(wire.WireError) as ei:
+        wire.decode_json(payload)
+    assert ei.value.reason == "bad_json"
+
+
+# ----------------------------------------------------------------------
+# fingerprint + cache
+def test_dataset_fingerprint(tmp_path):
+    p = tmp_path / "d.bin"
+    p.write_bytes(b"x" * 64)
+    ent = [("iter", "mnist"), ("path_img", str(p))]
+    fp = dataset_fingerprint(ent)
+    assert fp == dataset_fingerprint(list(ent))  # stable
+    assert fp != dataset_fingerprint(ent + [("shuffle", "1")])
+    p.write_bytes(b"x" * 65)  # same conf, regenerated file
+    assert fp != dataset_fingerprint(ent)
+
+
+def _blk(nrows=4, ncol=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return CachedBlock(rng.rand(nrows, ncol).astype(np.float32),
+                       rng.rand(nrows, 1).astype(np.float32),
+                       np.arange(nrows, dtype=np.uint32), 0)
+
+
+def test_chunk_cache_lru_and_accounting():
+    one = _blk().nbytes
+    c = ChunkCache(max_bytes=3 * one)
+    for i in range(3):
+        c.put(("fp", 0, i), _blk(seed=i))
+    assert len(c) == 3 and c.bytes == 3 * one
+    assert c.get(("fp", 0, 0)) is not None       # 0 becomes MRU
+    c.put(("fp", 0, 3), _blk(seed=3))            # evicts 1 (LRU)
+    assert c.get(("fp", 0, 1)) is None
+    assert c.get(("fp", 0, 0)) is not None
+    st = c.stats()
+    assert st["evictions"] == 1 and st["bytes"] == 3 * one
+    assert st["hits"] == 2 and st["misses"] == 1
+    assert 0 < st["hit_rate"] < 1
+
+
+def test_chunk_cache_disabled_and_immutable():
+    c = ChunkCache(max_bytes=0)
+    c.put(("fp", 0, 0), _blk())
+    assert c.get(("fp", 0, 0)) is None
+    blk = _blk()
+    with pytest.raises(ValueError):
+        blk.data[0, 0] = 1.0  # cached rows are immutable
+
+
+# ----------------------------------------------------------------------
+# server + client integration
+def make_dataset(tmp_path, n=96, seed=3):
+    rng = np.random.RandomState(seed)
+    imgs = rng.randint(0, 255, size=(n, 4, 4), dtype=np.uint8)
+    labs = (imgs.reshape(n, -1).mean(axis=1) > 127).astype(np.uint8)
+    pi, pl = str(tmp_path / "img.idx"), str(tmp_path / "lab.idx")
+    write_idx_images(pi, imgs)
+    write_idx_labels(pl, labs)
+    sec = [("iter", "mnist"), ("path_img", pi), ("path_label", pl),
+           ("shuffle", "1"), ("input_flat", "1")]
+    glob = [("batch_size", "16"), ("silent", "1"), ("seed_data", "5")]
+    return sec, glob
+
+
+def make_server(sec, glob, **kw):
+    kw.setdefault("max_sessions", 8)
+    kw.setdefault("cache_bytes", 16 << 20)
+    kw.setdefault("silent", True)
+    srv = DataServiceServer(sec, glob, **kw)
+    srv.start()
+    return srv
+
+
+def make_client(port, glob, **params):
+    it = create_iterator([
+        ("iter", "service"),
+        ("data_service_addr", f"127.0.0.1:{port}"),
+        ("data_service_retry_delay_s", "0.05"),
+        ("watchdog_timeout_s", "20"),
+    ] + [(k, str(v)) for k, v in params.items()])
+    for n, v in glob:
+        it.set_param(n, v)
+    it.init()
+    return it
+
+
+def collect(it, epoch=None):
+    it.before_first()
+    if epoch is not None:
+        it.set_param("augment_epoch", str(epoch))
+    out = []
+    while it.next():
+        b = it.value()
+        out.append((b.data.copy(), b.label.copy(), b.num_batch_padd))
+    return out
+
+
+def assert_streams_equal(a, b):
+    assert len(a) == len(b)
+    for (da, la, pa), (db, lb, pb) in zip(a, b):
+        assert np.array_equal(da, db)
+        assert np.array_equal(la, lb)
+        assert pa == pb
+
+
+def test_service_stream_parity_multi_epoch(tmp_path):
+    sec, glob = make_dataset(tmp_path)
+    srv = make_server(sec, glob)
+    ref = create_iterator(sec)
+    for n, v in glob:
+        ref.set_param(n, v)
+    ref.init()
+    it = make_client(srv.port, glob)
+    try:
+        # epoch pinning out of order: the stream is addressed, so any
+        # epoch is servable at any time, bitwise
+        for epoch in (0, 2, 1, 2):
+            assert_streams_equal(collect(ref, epoch), collect(it, epoch))
+    finally:
+        it.close()
+        ref.close()
+        srv.close()
+
+
+def test_two_clients_share_cache_and_agree(tmp_path):
+    sec, glob = make_dataset(tmp_path)
+    srv = make_server(sec, glob)
+    a = make_client(srv.port, glob)
+    b = make_client(srv.port, glob)
+    try:
+        sa = collect(a, 0)
+        sb = collect(b, 0)  # same epoch: all warm
+        assert_streams_equal(sa, sb)
+        st = srv.plant.cache.stats()
+        assert st["hits"] >= len(sb)   # the second pass hit the cache
+        assert st["hit_rate"] > 0
+    finally:
+        a.close()
+        b.close()
+        srv.close()
+
+
+def test_block_shard_deal_matches_local_stream(tmp_path):
+    """Two rank clients reassemble exactly the local global stream in
+    dist_shard=block order: rank r's k-th block is global block
+    k*nworker + r."""
+    sec, glob = make_dataset(tmp_path)
+    srv = make_server(sec, glob)
+    ref = create_iterator(sec)
+    for n, v in glob:
+        ref.set_param(n, v)
+    ref.init()
+    r0 = make_client(srv.port, glob, dist_num_worker=2,
+                     dist_worker_rank=0)
+    r1 = make_client(srv.port, glob, dist_num_worker=2,
+                     dist_worker_rank=1)
+    try:
+        local = collect(ref, 0)
+        s0, s1 = collect(r0, 0), collect(r1, 0)
+        assert len(s0) == len(s1) == len(local) // 2
+        for k in range(len(s0)):
+            assert np.array_equal(s0[k][0], local[2 * k][0])
+            assert np.array_equal(s1[k][0], local[2 * k + 1][0])
+    finally:
+        r0.close()
+        r1.close()
+        ref.close()
+        srv.close()
+
+
+def test_reconnect_resumes_identical_stream(tmp_path):
+    """Kill the server mid-epoch; a replacement on the same port serves
+    the client's re-requested cursor bitwise — the consumer sees one
+    uninterrupted, locally-identical stream."""
+    sec, glob = make_dataset(tmp_path)
+    ref = create_iterator(sec)
+    for n, v in glob:
+        ref.set_param(n, v)
+    ref.init()
+    local = collect(ref, 0)
+    srv = make_server(sec, glob)
+    port = srv.port
+    it = make_client(port, glob)
+    try:
+        it.before_first()
+        it.set_param("augment_epoch", "0")
+        got = []
+        for _ in range(2):
+            assert it.next()
+            b = it.value()
+            got.append((b.data.copy(), b.label.copy(), b.num_batch_padd))
+        srv.close()  # SIGKILL analog: every connection drops dead
+        srv = make_server(sec, glob, port=port)
+        while it.next():
+            b = it.value()
+            got.append((b.data.copy(), b.label.copy(), b.num_batch_padd))
+        assert_streams_equal(got, local)
+        assert it.reconnects >= 1
+    finally:
+        it.close()
+        ref.close()
+        srv.close()
+
+
+def test_reconnect_refuses_changed_fingerprint(tmp_path):
+    sec, glob = make_dataset(tmp_path)
+    srv = make_server(sec, glob)
+    port = srv.port
+    it = make_client(port, glob)
+    try:
+        it.before_first()
+        it.set_param("augment_epoch", "0")
+        assert it.next()
+        srv.close()
+        # same port, DIFFERENT dataset (fresh paths — the fingerprint
+        # keys on entries + file sizes): the client must refuse to
+        # splice it into the run rather than resume
+        alt = tmp_path / "alt"
+        alt.mkdir()
+        sec2, _ = make_dataset(alt, seed=11)
+        srv = make_server(sec2, glob, port=port)
+        with pytest.raises(RuntimeError, match="fingerprint changed"):
+            while it.next():
+                pass
+    finally:
+        it.close()
+        srv.close()
+
+
+def _raw_open(port, batch_size=16, rank=0, nworker=1, window=2):
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    wire.write_frame(s, wire.encode_open(batch_size, rank, nworker,
+                                         window))
+    body = wire.read_frame(s)
+    kind, payload = wire.decode_kind(body)
+    return s, kind, wire.decode_json(payload)
+
+
+def test_admission_shed_and_batch_size_gate(tmp_path):
+    sec, glob = make_dataset(tmp_path)
+    srv = make_server(sec, glob, max_sessions=1)
+    try:
+        s1, kind, doc = _raw_open(srv.port)
+        assert kind == wire.OPENED
+        assert doc["fingerprint"] == srv.plant.fingerprint
+        # the max_sessions+1-th OPEN is shed 429-style
+        s2, kind2, doc2 = _raw_open(srv.port)
+        assert kind2 == wire.ERR and doc2["reason"] == "overloaded"
+        s2.close()
+        from cxxnet_tpu.obs.registry import registry
+        shed = registry().counter("dataservice_shed_total", "",
+                                  labelnames=("reason",))
+        assert shed.labels(reason="overloaded").value >= 1
+        s1.close()
+        # wrong block size is a refusal, not a silently different deal
+        _wait_sessions(srv, 0)
+        s3, kind3, doc3 = _raw_open(srv.port, batch_size=8)
+        assert kind3 == wire.ERR
+        assert doc3["reason"] == "batch_size_mismatch"
+        s3.close()
+    finally:
+        srv.close()
+
+
+def _wait_sessions(srv, n, timeout=5.0):
+    import time
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if len(srv._sessions) == n:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"server still has {len(srv._sessions)} sessions, want {n}")
+
+
+def test_close_tears_down_session_and_threads(tmp_path):
+    import threading
+    sec, glob = make_dataset(tmp_path)
+    srv = make_server(sec, glob)
+    before = set(threading.enumerate())
+    it = make_client(srv.port, glob)
+    try:
+        it.before_first()
+        it.set_param("augment_epoch", "0")
+        assert it.next()
+        _wait_sessions(srv, 1)
+    finally:
+        it.close()
+    _wait_sessions(srv, 0)  # EOF teardown reached the server
+    from cxxnet_tpu.obs.registry import registry
+    assert registry().gauge("dataservice_sessions", "").get() == 0.0
+    leaked = [t for t in set(threading.enumerate()) - before
+              if t.is_alive() and t.name == "dataservice-client"]
+    assert not leaked  # the client worker joined
+    it.close()  # idempotent
+    srv.close()
+    srv.close()  # idempotent
+
+
+def test_health_and_stats_planes(tmp_path):
+    sec, glob = make_dataset(tmp_path)
+    srv = make_server(sec, glob)
+    it = make_client(srv.port, glob)
+    try:
+        collect(it, 0)
+        h = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.http_port}/healthz",
+            timeout=5).read())
+        assert h["status"] == "ok"
+        st = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.http_port}/statsz",
+            timeout=5).read())
+        assert st["fingerprint"] == srv.plant.fingerprint
+        assert st["blocks_produced"] == 6
+        assert st["epoch_lens"] == {"0": 6}
+        assert st["cache"]["misses"] >= 6
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.http_port}/metricsz",
+            timeout=5).read().decode()
+        assert "dataservice_batches_total" in text
+        assert "dataservice_cache_bytes" in text
+    finally:
+        it.close()
+        srv.close()

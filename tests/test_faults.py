@@ -1134,6 +1134,78 @@ def _scn_device_state(kind, tmp_path):
     assert [f["tensor"] for f in v2["findings"]] == named
 
 
+def _dataservice_fixture(tmp_path):
+    """A live in-process data-service server over tiny MNIST idx files,
+    plus the section/global entries a client or local chain builds
+    from."""
+    from cxxnet_tpu.io.dataservice.server import DataServiceServer
+    from cxxnet_tpu.io.mnist import write_idx_images, write_idx_labels
+
+    rng = np.random.RandomState(3)
+    imgs = rng.randint(0, 255, size=(96, 4, 4), dtype=np.uint8)
+    labs = (imgs.reshape(96, -1).mean(axis=1) > 127).astype(np.uint8)
+    pi, pl = str(tmp_path / "img.idx"), str(tmp_path / "lab.idx")
+    write_idx_images(pi, imgs)
+    write_idx_labels(pl, labs)
+    sec = [("iter", "mnist"), ("path_img", pi), ("path_label", pl),
+           ("shuffle", "1"), ("input_flat", "1")]
+    glob = [("batch_size", "16"), ("silent", "1"), ("seed_data", "5")]
+    srv = DataServiceServer(sec, glob, max_sessions=4,
+                            cache_bytes=16 << 20, silent=True)
+    srv.start()
+    return srv, sec, glob
+
+
+def _scn_dataservice_rpc(kind, tmp_path):
+    srv, sec, glob = _dataservice_fixture(tmp_path)
+    client_entries = [
+        ("iter", "service"),
+        ("data_service_addr", f"127.0.0.1:{srv.port}"),
+        ("data_service_retry_delay_s", "0.05"),
+        ("watchdog_timeout_s", "0.8"),
+    ]
+    it = create_iterator(client_entries)
+    for n, v in glob:
+        it.set_param(n, v)
+    it.init()
+    try:
+        if kind == "hang":
+            # a wedged server: the consumer's watchdog fails fast
+            faults.install("dataservice.rpc:hang:1:1")
+            with pytest.raises(WatchdogError, match="data service client"):
+                it.before_first()
+                while it.next():
+                    pass
+            faults.reset()  # release the hung worker so close() joins
+            return
+        # ioerror: transport loss → the client reconnects and resumes
+        # its cursor; the stream must complete AND be bitwise equal to
+        # the local chain (the reconnect-resume determinism contract).
+        # latency: a slow host — slower, complete, still bitwise equal.
+        faults.install(f"dataservice.rpc:{kind}:1:2")
+        ref = create_iterator(sec)
+        for n, v in glob:
+            ref.set_param(n, v)
+        ref.init()
+        it.before_first()
+        ref.before_first()
+        n_blocks = 0
+        while it.next():
+            assert ref.next()
+            a, b = ref.value(), it.value()
+            assert np.array_equal(a.data, b.data)
+            assert np.array_equal(a.label, b.label)
+            n_blocks += 1
+        assert not ref.next()
+        assert n_blocks == 6  # 96 rows / 16
+        if kind == "ioerror":
+            assert it.reconnects >= 1  # the resume path actually ran
+        ref.close()
+    finally:
+        it.close()
+        srv.close()
+
+
 MATRIX = [
     pytest.param(site, kind, id=f"{site}-{kind}",
                  marks=[pytest.mark.chaos])
@@ -1177,5 +1249,7 @@ def test_fault_matrix(site, kind, tmp_path):
         _scn_serve_replica(kind, tmp_path)
     elif site == "device.state":
         _scn_device_state(kind, tmp_path)
+    elif site == "dataservice.rpc":
+        _scn_dataservice_rpc(kind, tmp_path)
     else:  # a new site without a scenario must fail the matrix
         pytest.fail(f"no chaos scenario for registered site {site!r}")

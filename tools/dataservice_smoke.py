@@ -1,0 +1,353 @@
+"""DSVC=1 lane: service-fed training is bitwise-identical to local.
+
+The data-service determinism claim (ISSUE 20), proven end to end
+through the real CLI on the MNIST MLP conf:
+
+* **parity** — a trainer whose data section is ``iter = service``
+  (streaming every batch from a real ``task=data_service`` process)
+  must write checkpoints with manifest CRC32s IDENTICAL to a trainer
+  running the same conf on its local decode chain.  The stream is
+  addressed ``(epoch, block)``, the server rewinds per epoch exactly as
+  the CLI does locally, so the batch bytes — and every weight bit —
+  cannot depend on where decoding runs;
+* **kill/resume** — the server is SIGKILLed mid-training and a
+  replacement started on the SAME port; the client reconnects,
+  re-requests its cursor, and the finished run's CRCs still equal the
+  local run's.  A leg that only kills after training completed is
+  counted as a FAILURE (vacuous kill), not a pass;
+* **shared fleet** — two trainers run concurrently against ONE server;
+  both must hold bitwise parity, and the server's ``/statsz`` chunk
+  cache must show ``hit_rate > 0`` (the second tenant reads decoded
+  blocks from memory, which is the reason the service exists).
+
+Usage::
+
+    python tools/dataservice_smoke.py --out /tmp/_dsvc
+
+Exit code: 0 when every leg holds; 1 otherwise (a hard gate, not
+weather).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NUM_ROUND = 4
+BATCH = 32
+N_IMAGES = 512
+
+ENV = {
+    **os.environ,
+    "PYTHONPATH": REPO,
+    "JAX_PLATFORMS": "cpu",
+}
+
+
+def _free_port() -> int:
+    from cxxnet_tpu.parallel.elastic import free_port
+
+    return free_port()
+
+
+def make_data(out_dir: str) -> None:
+    import numpy as np
+
+    from cxxnet_tpu.io.mnist import write_idx_images, write_idx_labels
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (N_IMAGES, 4, 4)).astype(np.uint8)
+    labels = (imgs.reshape(N_IMAGES, -1).mean(1) > 127).astype(np.uint8)
+    write_idx_images(os.path.join(out_dir, "img.idx"), imgs)
+    write_idx_labels(os.path.join(out_dir, "lab.idx"), labels)
+
+
+def make_confs(out_dir: str):
+    """Two confs differing ONLY in the data section: the local decode
+    chain vs ``iter = service`` (the addr rides in as a CLI override).
+    Everything downstream of the batch stream is shared — that is the
+    parity claim."""
+    head = f"""
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.1
+layer[fc1->out] = fullc:fc2
+  nhidden = 2
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = {BATCH}
+dev = cpu
+num_round = {NUM_ROUND}
+eval_train = 0
+eta = 0.1
+momentum = 0.9
+seed = 7
+metric = error
+silent = 1
+"""
+    local = os.path.join(out_dir, "local.conf")
+    with open(local, "w", encoding="utf-8") as f:
+        f.write(f"""
+data = train
+iter = mnist
+  path_img = "{out_dir}/img.idx"
+  path_label = "{out_dir}/lab.idx"
+  shuffle = 1
+iter = end
+{head}""")
+    service = os.path.join(out_dir, "service.conf")
+    with open(service, "w", encoding="utf-8") as f:
+        f.write(f"""
+data = train
+iter = service
+iter = end
+{head}""")
+    return local, service
+
+
+def start_server(conf: str, out_dir: str, port: int, tag: str,
+                 timeout: float = 60.0):
+    """Launch a real ``task=data_service`` process hosting the local
+    conf's data section; returns ``(proc, ready_doc)`` once the ready
+    file lands."""
+    ready = os.path.join(out_dir, f"ready_{tag}_{time.time_ns()}.json")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cxxnet_tpu", conf,
+         "task=data_service",
+         f"data_service_port={port}",
+         "data_service_http_port=0",
+         f"data_service_ready_file={ready}",
+         "silent=1"],
+        env=ENV, cwd=out_dir,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if os.path.exists(ready):
+            with open(ready, "r", encoding="utf-8") as f:
+                return proc, json.load(f)
+        if proc.poll() is not None:
+            out = proc.communicate()[0].decode()
+            raise RuntimeError(
+                f"data_service exited rc={proc.returncode} before "
+                f"ready:\n{out[-4000:]}")
+        time.sleep(0.05)
+    proc.kill()
+    raise RuntimeError(f"data_service not ready within {timeout}s")
+
+
+def start_train(conf: str, workdir: str, overrides):
+    os.makedirs(workdir, exist_ok=True)
+    return subprocess.Popen(
+        [sys.executable, "-m", "cxxnet_tpu", conf] + list(overrides),
+        env=ENV, cwd=workdir,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+
+
+def wait_train(proc, timeout: float) -> None:
+    try:
+        out = proc.communicate(timeout=timeout)[0]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    if proc.returncode != 0:
+        raise RuntimeError(f"trainer failed (rc={proc.returncode}):\n"
+                           f"{out.decode()[-4000:]}")
+
+
+def read_crcs(workdir: str) -> dict:
+    """{round: manifest crc32} for every checkpoint a run wrote."""
+    from cxxnet_tpu.utils import checkpoint as ckpt
+
+    out = {}
+    for round_, path in ckpt.list_checkpoints(
+            os.path.join(workdir, "models")):
+        man = ckpt.read_manifest(path)
+        if man is not None:
+            out[round_] = man["crc32"]
+    return out
+
+
+def count_ckpts(workdir: str) -> int:
+    from cxxnet_tpu.utils import checkpoint as ckpt
+
+    return len(ckpt.list_checkpoints(os.path.join(workdir, "models")))
+
+
+def service_overrides(port: int):
+    # retries x delay must outlast a server replacement (python + jax
+    # startup), or the kill leg's client gives up before resuming
+    return [f"data_service_addr=127.0.0.1:{port}",
+            "data_service_retries=600",
+            "data_service_retry_delay_s=0.05"]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="/tmp/_dsvc_smoke",
+                    help="scratch + verdict directory")
+    ap.add_argument("--timeout", type=float, default=240.0,
+                    help="per-leg wall-clock budget (seconds)")
+    ap.add_argument("--json", dest="json_path", default="",
+                    help="verdict path (default <out>/dataservice_"
+                         "smoke.json)")
+    args = ap.parse_args()
+
+    os.makedirs(args.out, exist_ok=True)
+    make_data(args.out)
+    local_conf, service_conf = make_confs(args.out)
+    problems = []
+
+    # --- leg 0: the local-chain reference ------------------------------
+    t0 = time.time()
+    local_dir = os.path.join(args.out, "local")
+    wait_train(start_train(local_conf, local_dir, []), args.timeout)
+    local_s = time.time() - t0
+    local_crcs = read_crcs(local_dir)
+    if len(local_crcs) != NUM_ROUND + 1:
+        problems.append(
+            f"local run wrote {sorted(local_crcs)} rounds, expected "
+            f"{NUM_ROUND + 1} checkpoints")
+
+    # --- leg 1: service-fed parity -------------------------------------
+    port = _free_port()
+    srv, _ready = start_server(local_conf, args.out, port, "parity")
+    t1 = time.time()
+    try:
+        svc_dir = os.path.join(args.out, "service")
+        wait_train(start_train(service_conf, svc_dir,
+                               service_overrides(port)), args.timeout)
+    finally:
+        srv.kill()
+        srv.wait()
+    service_s = time.time() - t1
+    svc_crcs = read_crcs(svc_dir)
+    if svc_crcs != local_crcs:
+        problems.append(
+            f"BITWISE PARITY FAILED: service-fed CRCs {svc_crcs} != "
+            f"local CRCs {local_crcs}")
+
+    # --- leg 2: SIGKILL the server mid-training, resume on a fresh one -
+    port2 = _free_port()
+    srv, _ready = start_server(local_conf, args.out, port2, "kill_a")
+    t2 = time.time()
+    kill_dir = os.path.join(args.out, "kill")
+    trainer = start_train(service_conf, kill_dir,
+                          service_overrides(port2))
+    killed_at = -1
+    try:
+        t_poll = time.monotonic()
+        while time.monotonic() - t_poll < args.timeout:
+            if count_ckpts(kill_dir) >= 2 or trainer.poll() is not None:
+                break
+            time.sleep(0.05)
+        killed_at = count_ckpts(kill_dir)
+        srv.send_signal(signal.SIGKILL)
+        srv.wait()
+        srv2, _ready = start_server(local_conf, args.out, port2,
+                                    "kill_b")
+        try:
+            wait_train(trainer, args.timeout)
+        finally:
+            srv2.kill()
+            srv2.wait()
+    finally:
+        if trainer.poll() is None:
+            trainer.kill()
+        if srv.poll() is None:
+            srv.kill()
+    kill_s = time.time() - t2
+    kill_crcs = read_crcs(kill_dir)
+    if killed_at >= NUM_ROUND + 1:
+        problems.append(
+            f"kill leg vacuous: all {killed_at} checkpoints existed "
+            "before the SIGKILL landed — nothing was resumed")
+    if kill_crcs != local_crcs:
+        problems.append(
+            f"KILL/RESUME PARITY FAILED: post-SIGKILL CRCs {kill_crcs} "
+            f"!= local CRCs {local_crcs}")
+
+    # --- leg 3: two concurrent tenants on one server -------------------
+    port3 = _free_port()
+    srv, ready = start_server(local_conf, args.out, port3, "shared")
+    t3 = time.time()
+    hit_rate = -1.0
+    try:
+        tenants = [
+            start_train(service_conf,
+                        os.path.join(args.out, f"tenant{i}"),
+                        service_overrides(port3))
+            for i in range(2)
+        ]
+        errs = []
+        for p in tenants:
+            try:
+                wait_train(p, args.timeout)
+            except RuntimeError as e:
+                errs.append(str(e))
+        if errs:
+            problems.append("shared-fleet trainers failed: "
+                            + " | ".join(errs))
+        stats = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{ready['http_port']}/statsz",
+            timeout=10).read())
+        hit_rate = float(stats["cache"]["hit_rate"])
+    finally:
+        srv.kill()
+        srv.wait()
+    shared_s = time.time() - t3
+    for i in range(2):
+        crcs = read_crcs(os.path.join(args.out, f"tenant{i}"))
+        if crcs != local_crcs:
+            problems.append(
+                f"SHARED-FLEET PARITY FAILED: tenant{i} CRCs {crcs} != "
+                f"local CRCs {local_crcs}")
+    if not hit_rate > 0:
+        problems.append(
+            f"shared fleet cache hit_rate {hit_rate} is not > 0 — the "
+            "second tenant re-decoded every block")
+
+    doc = {
+        "bench": "dataservice_smoke",
+        "ts": time.time(),
+        "rounds": NUM_ROUND,
+        "batch": BATCH,
+        "n_images": N_IMAGES,
+        "crc_equal": svc_crcs == local_crcs,
+        "kill_crc_equal": kill_crcs == local_crcs,
+        "ckpts_at_kill": killed_at,
+        "cache_hit_rate": hit_rate,
+        "crcs": {str(k): f"{v:#010x}" for k, v in
+                 sorted(local_crcs.items())},
+        "local_wall_sec": round(local_s, 3),
+        "service_wall_sec": round(service_s, 3),
+        "kill_wall_sec": round(kill_s, 3),
+        "shared_wall_sec": round(shared_s, 3),
+        "problems": problems,
+        "verdict": "ok" if not problems else "fail",
+    }
+    json_path = args.json_path or os.path.join(args.out,
+                                               "dataservice_smoke.json")
+    with open(json_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc, indent=1))
+    for p in problems:
+        print(f"FAIL {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -316,6 +316,118 @@ def validate_autotune(doc: dict) -> None:
                 raise ValueError(f"autotune report: result missing {key!r}")
 
 
+def _drive_epoch(it, epoch: int) -> int:
+    """One anchored pass (the CLI's before_first + augment_epoch
+    sequence); rows consumed."""
+    it.before_first()
+    it.set_param("augment_epoch", str(epoch))
+    rows = 0
+    while it.next():
+        rows += it.value().data.shape[0]
+    return rows
+
+
+def run_service_ab(workdir: str, size: int) -> dict:
+    """Local vs data-service A/B over the same imgbin decode chain:
+
+    * **local** — the in-process chain, one warm timed epoch;
+    * **service_1c** — one ``iter = service`` client against an
+      in-process :class:`DataServiceServer`, timed on the warm (cached)
+      epoch — the steady state a shared tenant sees;
+    * **service_2c** — two concurrent clients on the warm cache,
+      aggregate rows/sec: the multi-tenant amortization the service
+      exists for (decode once, serve N).
+
+    The verdict carries the server's cache stats; the DSVC lane asserts
+    ``hit_rate > 0`` (a service that re-decodes per client is broken)."""
+    import threading
+
+    from cxxnet_tpu.io.data import create_iterator
+    from cxxnet_tpu.io.dataservice.server import DataServiceServer
+
+    sec = [("iter", "imgbin")] + _iter_params(workdir, size, 2, 0, 1)
+    local = create_iterator(sec)
+    local.init()
+    _drive_epoch(local, 0)  # warm (page cache, pool spin-up)
+    t0 = time.perf_counter()
+    rows = _drive_epoch(local, 0)
+    local_rate = rows / (time.perf_counter() - t0)
+    local.close()
+
+    srv = DataServiceServer(sec, [], cache_bytes=512 << 20, silent=True)
+    srv.start()
+
+    def make_client():
+        it = create_iterator([
+            ("iter", "service"),
+            ("data_service_addr", f"127.0.0.1:{srv.port}"),
+            ("batch_size", "32"),
+            ("silent", "1"),
+        ])
+        it.init()
+        return it
+
+    try:
+        c = make_client()
+        _drive_epoch(c, 0)  # cold pass: the server decodes + caches
+        t0 = time.perf_counter()
+        rows = _drive_epoch(c, 0)
+        svc1 = rows / (time.perf_counter() - t0)
+        c.close()
+        clients = [make_client() for _ in range(2)]
+        totals = [0, 0]
+
+        def consume(i):
+            totals[i] = _drive_epoch(clients[i], 0)
+
+        threads = [threading.Thread(target=consume, args=(i,))
+                   for i in range(2)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        svc2 = sum(totals) / dt
+        for it2 in clients:
+            it2.close()
+        stats = srv.statsz()
+    finally:
+        srv.close()
+    return {
+        "local_img_per_sec": local_rate,
+        "service_1c_img_per_sec": svc1,
+        "service_2c_img_per_sec": svc2,
+        "blocks_produced": stats["blocks_produced"],
+        "cache": stats["cache"],
+    }
+
+
+def validate_service(doc: dict) -> None:
+    """Schema check for the ``--service`` verdict (the DSVC lane's
+    contract); raises ValueError — including on a zero cache hit rate,
+    which means the shared fleet re-decoded for every client."""
+    sv = doc.get("service")
+    if not isinstance(sv, dict):
+        raise ValueError("service report: missing service section")
+    for key in ("local_img_per_sec", "service_1c_img_per_sec",
+                "service_2c_img_per_sec"):
+        v = sv.get(key)
+        if not (isinstance(v, (int, float)) and math.isfinite(v)
+                and v > 0):
+            raise ValueError(f"service report: bad {key}: {v!r}")
+    cache = sv.get("cache")
+    if not isinstance(cache, dict):
+        raise ValueError("service report: missing cache stats")
+    hr = cache.get("hit_rate")
+    if not (isinstance(hr, (int, float)) and math.isfinite(hr)):
+        raise ValueError(f"service report: bad hit_rate: {hr!r}")
+    if hr <= 0:
+        raise ValueError(
+            "service report: cache hit_rate is 0 — the warm service "
+            "epochs never hit the chunk cache")
+
+
 def validate_report(doc: dict) -> None:
     """Schema check for the JSON report; raises ValueError on drift.
     This is what the CI smoke lane asserts — not throughput."""
@@ -376,6 +488,10 @@ def main() -> None:
                     help="additionally sweep the native C++ decoder")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny set + schema validation (CI lane)")
+    ap.add_argument("--service", action="store_true",
+                    help="A/B the data service: local chain vs 1 and 2 "
+                         "service clients on a shared decode fleet "
+                         "(DSVC lane)")
     ap.add_argument("--autotune", action="store_true",
                     help="bad-knobs recovery via the tune controller "
                          "(TUNE=1 lane); exits 1 below --recovery")
@@ -415,6 +531,34 @@ def main() -> None:
                 json.dump(doc, f, indent=2)
             print(f"# report -> {args.json_path}", flush=True)
         sys.exit(0 if at["ok"] else 1)
+
+    if args.service:
+        import tempfile
+
+        if args.smoke:
+            args.n_images, args.size = 48, 48
+        with tempfile.TemporaryDirectory() as workdir:
+            t0 = time.perf_counter()
+            generate_imgbin(workdir, args.n_images, args.size)
+            print(f"# generated {args.n_images} JPEGs "
+                  f"({args.size}x{args.size}) in "
+                  f"{time.perf_counter() - t0:.1f}s", flush=True)
+            doc = {"n_images": args.n_images, "size": args.size,
+                   "service": run_service_ab(workdir, args.size)}
+        validate_service(doc)
+        sv = doc["service"]
+        print(f"# data service: local {sv['local_img_per_sec']:.1f} "
+              f"img/s, 1 client {sv['service_1c_img_per_sec']:.1f} "
+              f"img/s, 2 clients {sv['service_2c_img_per_sec']:.1f} "
+              f"img/s aggregate, cache hit rate "
+              f"{sv['cache']['hit_rate']:.2f}", flush=True)
+        if args.json_path:
+            with open(args.json_path, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=2)
+            print(f"# report -> {args.json_path}", flush=True)
+        if args.smoke:
+            print("io_bench service smoke: schema OK", flush=True)
+        sys.exit(0)
 
     if args.smoke:
         args.n_images, args.size, args.workers = 48, 48, "0,2"

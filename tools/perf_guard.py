@@ -357,7 +357,29 @@ def flatten_kernel_bench(doc: dict) -> Dict[str, float]:
     return out
 
 
+def flatten_dataservice_bench(doc: dict) -> Dict[str, float]:
+    """The DSVC lane's series (``tools/io_bench.py --service``): the
+    local-chain baseline and both service legs as img/sec (the 2-client
+    aggregate is the multi-tenant amortization claim — a fall back
+    toward the 1-client rate means clients stopped sharing decodes),
+    plus the chunk-cache hit rate, which the lane pins > 0."""
+    out: Dict[str, float] = {}
+    sv = doc.get("service")
+    if not isinstance(sv, dict):
+        return out
+    for key in ("local_img_per_sec", "service_1c_img_per_sec",
+                "service_2c_img_per_sec", "blocks_produced"):
+        v = sv.get(key)
+        if isinstance(v, (int, float)) and math.isfinite(v):
+            out[key] = float(v)
+    hr = (sv.get("cache") or {}).get("hit_rate")
+    if isinstance(hr, (int, float)) and math.isfinite(hr):
+        out["cache_hit_rate"] = float(hr)
+    return out
+
+
 FLATTENERS = {"io_bench": flatten_io_bench,
+              "dataservice_bench": flatten_dataservice_bench,
               "kernel_bench": flatten_kernel_bench,
               "crash_audit": flatten_crash_audit,
               "elastic_crash": flatten_elastic_crash,

@@ -175,6 +175,25 @@
 #                                     # overhead bound; verdict JSON
 #                                     # appends to a perf_guard history
 #                                     # (integrity_bench flattener)
+#        DSVC=1 tools/run_tier1.sh    # also run the data-service lane:
+#                                     # a REAL task=data_service process
+#                                     # feeds a CLI trainer whose data
+#                                     # section is iter=service; its
+#                                     # checkpoint CRCs must be BITWISE
+#                                     # equal to the local-chain run,
+#                                     # INCLUDING after the server is
+#                                     # SIGKILLed mid-training and a
+#                                     # replacement on the same port
+#                                     # resumes the stream; two
+#                                     # concurrent tenants must both
+#                                     # hold parity with the shared
+#                                     # chunk cache showing hit_rate > 0
+#                                     # (tools/dataservice_smoke.py),
+#                                     # plus the local-vs-service A/B
+#                                     # (io_bench --service --smoke);
+#                                     # both verdicts append to a
+#                                     # perf_guard history
+#                                     # (dataservice_bench flattener)
 #        OBS=1 tools/run_tier1.sh     # also run the observability smoke:
 #                                     # short telemetry=1 train + serve
 #                                     # scrape of /metricsz + /alertz
@@ -393,6 +412,25 @@ if [ "${SDC:-0}" = "1" ]; then
       --input "$sdc_out/sdc.json" \
       --history "$sdc_out/bench_history.jsonl" > /dev/null || rc=1
   echo "SDC lane verdict: $sdc_out/sdc.json"
+fi
+if [ "${DSVC:-0}" = "1" ]; then
+  echo "=== opt-in data-service lane (DSVC=1) ==="
+  dsvc_out=/tmp/_dsvc_lane
+  rm -rf "$dsvc_out"; mkdir -p "$dsvc_out"
+  # outer budget > the tool's per-leg --timeout (240 s) x four legs
+  # (local, service, kill/resume, 2-tenant) plus server startup slack;
+  # the full run takes ~30 s on a healthy machine
+  timeout -k 10 1000 env JAX_PLATFORMS=cpu \
+    python tools/dataservice_smoke.py --out "$dsvc_out" \
+      > /dev/null || rc=1
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python tools/io_bench.py --service --smoke \
+      --json "$dsvc_out/dsvc_bench.json" || rc=1
+  timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python tools/perf_guard.py --bench dataservice_bench \
+      --input "$dsvc_out/dsvc_bench.json" \
+      --history "$dsvc_out/bench_history.jsonl" > /dev/null || rc=1
+  echo "DSVC lane verdict: $dsvc_out/dataservice_smoke.json $dsvc_out/dsvc_bench.json"
 fi
 if [ "${OBS:-0}" = "1" ]; then
   echo "=== opt-in observability smoke (OBS=1) ==="

@@ -152,6 +152,14 @@ sweep() {
   run 900 python tools/sdc_smoke.py --overhead-only --dev tpu \
     --hidden 4096 --out /tmp/_sdc_tpu \
     --json /tmp/sdc_overhead_tpu.json
+  # data-service A/B at full size (ISSUE 20 / io/dataservice/): the
+  # local-vs-shared-fleet amortization measured where decode bandwidth
+  # actually costs — full-resolution JPEGs, 2 clients on one warm
+  # chunk cache (the CPU lane's 48x48 smoke proves schema + hit-rate
+  # mechanics only; these are the real img/s numbers the perf history
+  # bands)
+  run 900 python tools/io_bench.py 2000 256 --service \
+    --json /tmp/dsvc_bench_full.json
   # TPU-backend HLO fusion audit (compile-only; doc/performance.md)
   run 900 python tools/hlo_inspect.py googlenet 128
   run 900 python tools/hlo_inspect.py googlenet 128 conv_branch_embed=1
